@@ -1,0 +1,79 @@
+"""Benchmark: BERT-Base training throughput (samples/sec) on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference commits no absolute numbers (BASELINE.md), so vs_baseline is
+reported against a recorded reference point when BASELINE.json gains one;
+until then it is 1.0 by definition.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from flexflow_tpu import (
+        AdamOptimizer,
+        FFConfig,
+        FFModel,
+        LossType,
+        MachineMesh,
+    )
+    from flexflow_tpu.models.transformer import BERT_BASE, transformer_encoder
+
+    on_tpu = jax.default_backend() != "cpu"
+    batch = 16 if on_tpu else 4
+    seq = 512 if on_tpu else 64
+    cfg_model = BERT_BASE if on_tpu else dict(hidden=128, heads=8, ff_dim=256, num_layers=2)
+
+    cfg = FFConfig(batch_size=batch)
+    model = FFModel(cfg)
+    transformer_encoder(
+        model,
+        batch=batch,
+        seq=seq,
+        num_classes=64,
+        raw_input=True,
+        **cfg_model,
+    )
+    model.compile(
+        optimizer=AdamOptimizer(alpha=1e-4),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        mesh=MachineMesh((1, 1), ("data", "model")),
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, seq, cfg_model["hidden"])).astype(np.float32)
+    y = rng.integers(0, 64, size=(batch, 1)).astype(np.int32)
+
+    # warmup (compile)
+    loss, _ = model.executor.train_step([x], y)
+    jax.block_until_ready(loss)
+
+    steps = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, _ = model.executor.train_step([x], y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = steps * batch / dt
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_train_throughput",
+                "value": round(samples_per_sec, 2),
+                "unit": "samples/s",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,166 @@
+"""Runtime configuration and CLI flag parsing.
+
+TPU-native analog of the reference's ``FFConfig``
+(``include/flexflow/config.h:92-160``) and ``FFModel::parse_args``
+(``src/runtime/model.cc:3566-3730``).  Flag spellings are kept compatible
+where they still make sense on TPU; Legion ``-ll:*`` flags are replaced by
+mesh-shape flags.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+
+
+@dataclasses.dataclass
+class FFConfig:
+    """Global runtime config.
+
+    Reference field map (``include/flexflow/config.h:92-160``):
+      * ``batchSize``       -> :attr:`batch_size`
+      * ``workersPerNode``  -> derived from the mesh (devices per host)
+      * ``numNodes``        -> ``jax.process_count()``
+      * ``epochs``          -> :attr:`epochs`
+      * ``learningRate / weightDecay`` -> :attr:`learning_rate` / :attr:`weight_decay`
+      * search flags (``search_budget``, ``search_alpha``, ``only_data_parallel``,
+        ``enable_parameter_parallel`` ...) -> same names, ``model.cc:3566-3730``.
+    """
+
+    batch_size: int = 64
+    epochs: int = 1
+    learning_rate: float = 0.01
+    weight_decay: float = 0.0001
+    # --- search / strategy flags (reference model.cc:3596-3680) ---
+    search_budget: int = -1
+    search_alpha: float = 1.2
+    only_data_parallel: bool = False
+    enable_parameter_parallel: bool = False
+    enable_attribute_parallel: bool = False
+    enable_inplace_optimizations: bool = True
+    search_overlap_backward_update: bool = False
+    export_strategy_file: Optional[str] = None
+    import_strategy_file: Optional[str] = None
+    # --- observability (reference model.cc:3650-3670) ---
+    profiling: bool = False
+    perform_fusion: bool = True
+    export_strategy_computation_graph_file: Optional[str] = None
+    taskgraph_file: Optional[str] = None
+    # --- simulator (reference config.h:127-136) ---
+    simulator_work_space_size: int = 2 * 1024 * 1024 * 1024
+    machine_model_version: int = 0
+    machine_model_file: Optional[str] = None
+    # --- TPU-specific (replaces Legion -ll:gpu etc.) ---
+    mesh_shape: Optional[Tuple[int, ...]] = None  # e.g. (2, 4)
+    mesh_axis_names: Tuple[str, ...] = ("data", "model")
+    compute_dtype: str = "float32"  # params/compute dtype; "bfloat16" for perf
+    rng_seed: int = 0
+    memory_search_budget: int = -1  # lambda search iterations (graph.cc:2075)
+
+    def __post_init__(self) -> None:
+        self._devices = None
+
+    # --- device/mesh topology ---------------------------------------------
+    @property
+    def devices(self):
+        if self._devices is None:
+            self._devices = jax.devices()
+        return self._devices
+
+    @property
+    def num_devices(self) -> int:
+        """Reference ``workersPerNode * numNodes``."""
+        return len(self.devices)
+
+    @property
+    def num_nodes(self) -> int:
+        return jax.process_count()
+
+    @property
+    def workers_per_node(self) -> int:
+        return max(1, self.num_devices // max(1, self.num_nodes))
+
+    def parse_args(self, argv: Optional[Sequence[str]] = None) -> List[str]:
+        """Parse reference-compatible CLI flags (``model.cc:3566-3730``).
+
+        Returns unconsumed args (the reference silently ignores unknown
+        flags; we hand them back for app-level parsing).
+        """
+        if argv is None:
+            argv = sys.argv[1:]
+        rest: List[str] = []
+        it = iter(range(len(argv)))
+        args = list(argv)
+        i = 0
+
+        def take() -> str:
+            nonlocal i
+            i += 1
+            return args[i]
+
+        while i < len(args):
+            a = args[i]
+            if a in ("-b", "--batch-size"):
+                self.batch_size = int(take())
+            elif a in ("-e", "--epochs"):
+                self.epochs = int(take())
+            elif a in ("--lr", "--learning-rate"):
+                self.learning_rate = float(take())
+            elif a in ("--wd", "--weight-decay"):
+                self.weight_decay = float(take())
+            elif a == "--budget" or a == "--search-budget":
+                self.search_budget = int(take())
+            elif a == "--alpha" or a == "--search-alpha":
+                self.search_alpha = float(take())
+            elif a == "--only-data-parallel":
+                self.only_data_parallel = True
+            elif a == "--enable-parameter-parallel":
+                self.enable_parameter_parallel = True
+            elif a == "--enable-attribute-parallel":
+                self.enable_attribute_parallel = True
+            elif a == "--profiling":
+                self.profiling = True
+            elif a == "--fusion":
+                self.perform_fusion = True
+            elif a == "--no-fusion":
+                self.perform_fusion = False
+            elif a == "--export-strategy" or a == "--export":
+                self.export_strategy_file = take()
+            elif a == "--import-strategy" or a == "--import":
+                self.import_strategy_file = take()
+            elif a == "--taskgraph":
+                self.taskgraph_file = take()
+            elif a == "--compgraph":
+                self.export_strategy_computation_graph_file = take()
+            elif a == "--machine-model-version":
+                self.machine_model_version = int(take())
+            elif a == "--machine-model-file":
+                self.machine_model_file = take()
+            elif a == "--simulator-workspace-size":
+                self.simulator_work_space_size = int(take())
+            elif a == "--mesh-shape":
+                self.mesh_shape = tuple(int(x) for x in take().split("x"))
+            elif a == "--dtype":
+                self.compute_dtype = take()
+            elif a == "--seed":
+                self.rng_seed = int(take())
+            else:
+                rest.append(a)
+            i += 1
+        return rest
+
+
+def cpu_mesh_env(n: int = 8) -> None:
+    """Force an ``n``-device CPU platform for sharding tests.
+
+    Must run before jax initializes its backends (used by tests/conftest.py).
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    opt = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + opt).strip()

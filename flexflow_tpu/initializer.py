@@ -1,0 +1,85 @@
+"""Weight initializers.
+
+Reference: ``include/flexflow/initializer.h:26-100`` (Glorot/Zero/Uniform/
+Normal/Constant, each a Legion init task with kernels in
+``src/runtime/initializer_kernel.cu``).  TPU-native: pure functions of a
+``jax.random`` key — initialization happens inside a jitted, sharded init
+program so weights are born on-device with their final sharding (no host
+round-trip, unlike the reference's CPU-side task dispatch).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Initializer:
+    def __call__(self, key: jax.Array, shape: Tuple[int, ...], dtype) -> jax.Array:
+        raise NotImplementedError
+
+
+class GlorotUniform(Initializer):
+    """``GlorotUniform`` (reference ``initializer.h:37-49``): limit =
+    sqrt(6/(fan_in+fan_out)).  Fan computation matches the reference's
+    ``init_task`` convention: last dim = fan_in, second-to-last = fan_out
+    for 2-D weights; conv weights use receptive-field scaling."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self.seed = seed
+
+    def __call__(self, key, shape, dtype):
+        if len(shape) == 2:
+            fan_in, fan_out = shape[0], shape[1]
+        elif len(shape) == 4:
+            rf = shape[0] * shape[1]  # HWIO layout
+            fan_in, fan_out = shape[2] * rf, shape[3] * rf
+        else:
+            fan_in = fan_out = int(math.sqrt(max(1, math.prod(shape))))
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, minval=-limit, maxval=limit)
+
+
+class ZeroInitializer(Initializer):
+    def __call__(self, key, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+class OnesInitializer(Initializer):
+    def __call__(self, key, shape, dtype):
+        return jnp.ones(shape, dtype)
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value: float) -> None:
+        self.value = value
+
+    def __call__(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, seed: int = 0, minv: float = -0.05, maxv: float = 0.05) -> None:
+        self.minv, self.maxv = minv, maxv
+
+    def __call__(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype, minval=self.minv, maxval=self.maxv)
+
+
+class NormInitializer(Initializer):
+    def __init__(self, seed: int = 0, mean: float = 0.0, stddev: float = 0.05) -> None:
+        self.mean, self.stddev = mean, stddev
+
+    def __call__(self, key, shape, dtype):
+        return self.mean + self.stddev * jax.random.normal(key, shape, dtype)
+
+
+def default_kernel_initializer() -> Initializer:
+    return GlorotUniform()
+
+
+def default_bias_initializer() -> Initializer:
+    return ZeroInitializer()

@@ -1,0 +1,104 @@
+"""Training metrics.
+
+Reference: ``src/metrics_functions/metrics_functions.cc`` (+ ``.cu``) —
+``Metrics::compute`` launches a per-shard METRICS_COMP task producing
+``PerfMetrics`` that are future-chain reduced (``FFModel::update_metrics_task``,
+``src/runtime/model.cc:3388+``) and printed as throughput every 1000 steps
+(``metrics_functions.cc:213-216``).
+
+TPU-native: metrics are computed inside the jitted step (scalar outputs);
+cross-device reduction is a ``jnp.sum`` the compiler turns into a psum.
+``PerfMetrics`` accumulates on host across steps, mirroring the reference
+struct (``include/flexflow/metrics_functions.h:19-42``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.fftype import LossType, MetricsType
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    """Host-side accumulator (reference ``metrics_functions.h:19-42``)."""
+
+    train_all: int = 0
+    train_correct: int = 0
+    cce_loss: float = 0.0
+    sparse_cce_loss: float = 0.0
+    mse_loss: float = 0.0
+    rmse_loss: float = 0.0
+    mae_loss: float = 0.0
+    start_time: float = dataclasses.field(default_factory=time.time)
+
+    def update(self, batch_metrics: Dict[str, float], batch_size: int) -> None:
+        self.train_all += batch_size
+        if "accuracy" in batch_metrics:
+            self.train_correct += int(batch_metrics["accuracy"] * batch_size + 0.5)
+        self.cce_loss += batch_metrics.get("categorical_crossentropy", 0.0) * batch_size
+        self.sparse_cce_loss += (
+            batch_metrics.get("sparse_categorical_crossentropy", 0.0) * batch_size
+        )
+        self.mse_loss += batch_metrics.get("mean_squared_error", 0.0) * batch_size
+        self.rmse_loss += batch_metrics.get("root_mean_squared_error", 0.0) * batch_size
+        self.mae_loss += batch_metrics.get("mean_absolute_error", 0.0) * batch_size
+
+    @property
+    def accuracy(self) -> float:
+        return self.train_correct / max(1, self.train_all)
+
+    def throughput(self) -> float:
+        """samples/s since construction (reference print at
+        ``metrics_functions.cc:213-216``)."""
+        dt = time.time() - self.start_time
+        return self.train_all / dt if dt > 0 else 0.0
+
+
+class Metrics:
+    def __init__(self, loss_type: LossType, metrics: Sequence[MetricsType]) -> None:
+        self.loss_type = loss_type
+        self.metrics = list(metrics)
+
+    def compute(self, logits: jax.Array, labels: jax.Array) -> Dict[str, jax.Array]:
+        """Traced inside the step program. logits = final op output
+        (post-softmax for CCE losses, matching the reference's contract)."""
+        out: Dict[str, jax.Array] = {}
+        sparse = self.loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY
+        for m in self.metrics:
+            if m is MetricsType.ACCURACY:
+                if sparse:
+                    lab = labels.reshape(labels.shape[0]).astype(jnp.int32)
+                    pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    lab = jnp.argmax(labels, axis=-1)
+                    pred = jnp.argmax(logits, axis=-1)
+                out["accuracy"] = jnp.mean((pred == lab).astype(jnp.float32))
+            elif m is MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY:
+                from flexflow_tpu.loss import sparse_categorical_crossentropy
+
+                out["sparse_categorical_crossentropy"] = sparse_categorical_crossentropy(
+                    logits, labels
+                )
+            elif m is MetricsType.CATEGORICAL_CROSSENTROPY:
+                from flexflow_tpu.loss import categorical_crossentropy
+
+                out["categorical_crossentropy"] = categorical_crossentropy(logits, labels)
+            elif m is MetricsType.MEAN_SQUARED_ERROR:
+                out["mean_squared_error"] = jnp.mean(
+                    jnp.sum(jnp.square(logits - labels), axis=-1)
+                )
+            elif m is MetricsType.ROOT_MEAN_SQUARED_ERROR:
+                out["root_mean_squared_error"] = jnp.sqrt(
+                    jnp.mean(jnp.sum(jnp.square(logits - labels), axis=-1))
+                )
+            elif m is MetricsType.MEAN_ABSOLUTE_ERROR:
+                out["mean_absolute_error"] = jnp.mean(
+                    jnp.sum(jnp.abs(logits - labels), axis=-1)
+                )
+        return out

@@ -1,0 +1,622 @@
+"""FFModel — the model orchestrator.
+
+TPU-native re-design of the reference god object ``FFModel``
+(``include/flexflow/model.h:326-958``, ``src/runtime/model.cc`` 5,541 LoC):
+the layer-builder API (``model.h:336-554``), ``compile()``
+(``model.cc:2803-3169``), the training drivers, and the ``fit`` loop
+(``python/flexflow/core/flexflow_cffi.py:2062-2104``).
+
+What compile() does here vs the reference:
+  reference                                   this build
+  -----------------------------------------  -------------------------------
+  create_operators_from_layers               layer list IS the PCG (1:1)
+  GRAPH_OPTIMIZE task (Unity search)         flexflow_tpu.search (strategy)
+  convert_graph_to_operators                 Strategy object
+  map tensors / create partitions            NamedShardings on mesh
+  apply_fusion                               XLA fusion (free)
+  label tensor co-sharding (model.cc:3086)   Executor._label_pspec
+  NCCL communicator setup (model.cc:3129)    none needed (GSPMD collectives)
+  optimizer->init()                          Executor.init_params
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.dataloader import BatchIterator, SingleDataLoader
+from flexflow_tpu.fftype import (
+    ActiMode,
+    AggrMode,
+    DataType,
+    LossType,
+    MetricsType,
+    OperatorType,
+    PoolType,
+)
+from flexflow_tpu.initializer import Initializer
+from flexflow_tpu.metrics import Metrics, PerfMetrics
+from flexflow_tpu.ops.base import get_op_def
+from flexflow_tpu.optimizer import AdamOptimizer, Optimizer, SGDOptimizer
+from flexflow_tpu.parallel.machine import MachineMesh, default_mesh
+from flexflow_tpu.parallel.strategy import (
+    Strategy,
+    data_parallel_strategy,
+    tensor_parallel_strategy,
+)
+from flexflow_tpu.runtime.executor import Executor
+from flexflow_tpu.tensor import Layer, Tensor
+
+
+class FFModel:
+    def __init__(self, config: Optional[FFConfig] = None) -> None:
+        self.config = config or FFConfig()
+        self.layers: List[Layer] = []
+        self.graph_inputs: List[Tensor] = []
+        self._name_counts: Dict[str, int] = {}
+        self.executor: Optional[Executor] = None
+        self.strategy: Optional[Strategy] = None
+        self.label_tensor: Optional[Tensor] = None
+        self._optimizer: Optional[Optimizer] = None
+
+    # ------------------------------------------------------------------ util
+    def _name(self, base: str, name: Optional[str]) -> str:
+        if name:
+            return name
+        n = self._name_counts.get(base, 0)
+        self._name_counts[base] = n + 1
+        return f"{base}_{n}"
+
+    def _add_layer(
+        self,
+        op_type: OperatorType,
+        name: str,
+        inputs: List[Tensor],
+        attrs: Dict[str, Any],
+    ) -> List[Tensor]:
+        layer = Layer(op_type, name, inputs, attrs)
+        outs = get_op_def(op_type).infer(layer)
+        for i, (shape, dtype) in enumerate(outs):
+            layer.outputs.append(
+                Tensor(shape, dtype, owner_layer=layer, owner_idx=i, name=f"{name}:{i}")
+            )
+        self.layers.append(layer)
+        return layer.outputs
+
+    # ---------------------------------------------------------- input tensors
+    def create_tensor(
+        self,
+        shape: Sequence[int],
+        dtype: DataType = DataType.FLOAT,
+        name: Optional[str] = None,
+    ) -> Tensor:
+        """Reference ``FFModel::create_tensor`` (``model.cc``); shape
+        includes the batch dim (dim 0, row-major — the reference's Legion
+        dims are reversed)."""
+        t = Tensor(tuple(shape), dtype, name=name or f"input_{len(self.graph_inputs)}")
+        self.graph_inputs.append(t)
+        return t
+
+    # ------------------------------------------------------------- layer API
+    # signatures follow include/flexflow/model.h:336-554
+    def dense(
+        self,
+        input: Tensor,
+        out_dim: int,
+        activation: ActiMode = ActiMode.NONE,
+        use_bias: bool = True,
+        kernel_initializer: Optional[Initializer] = None,
+        bias_initializer: Optional[Initializer] = None,
+        name: Optional[str] = None,
+    ) -> Tensor:
+        return self._add_layer(
+            OperatorType.LINEAR,
+            self._name("dense", name),
+            [input],
+            dict(
+                out_dim=out_dim,
+                activation=activation,
+                use_bias=use_bias,
+                kernel_initializer=kernel_initializer,
+                bias_initializer=bias_initializer,
+            ),
+        )[0]
+
+    def conv2d(
+        self,
+        input: Tensor,
+        out_channels: int,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int,
+        stride_w: int,
+        padding_h: int,
+        padding_w: int,
+        activation: ActiMode = ActiMode.NONE,
+        groups: int = 1,
+        use_bias: bool = True,
+        kernel_initializer: Optional[Initializer] = None,
+        bias_initializer: Optional[Initializer] = None,
+        name: Optional[str] = None,
+    ) -> Tensor:
+        return self._add_layer(
+            OperatorType.CONV2D,
+            self._name("conv2d", name),
+            [input],
+            dict(
+                out_channels=out_channels,
+                kernel_h=kernel_h,
+                kernel_w=kernel_w,
+                stride_h=stride_h,
+                stride_w=stride_w,
+                padding_h=padding_h,
+                padding_w=padding_w,
+                activation=activation,
+                groups=groups,
+                use_bias=use_bias,
+                kernel_initializer=kernel_initializer,
+                bias_initializer=bias_initializer,
+            ),
+        )[0]
+
+    def pool2d(
+        self,
+        input: Tensor,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int,
+        stride_w: int,
+        padding_h: int,
+        padding_w: int,
+        pool_type: PoolType = PoolType.MAX,
+        activation: ActiMode = ActiMode.NONE,
+        name: Optional[str] = None,
+    ) -> Tensor:
+        return self._add_layer(
+            OperatorType.POOL2D,
+            self._name("pool2d", name),
+            [input],
+            dict(
+                kernel_h=kernel_h,
+                kernel_w=kernel_w,
+                stride_h=stride_h,
+                stride_w=stride_w,
+                padding_h=padding_h,
+                padding_w=padding_w,
+                pool_type=pool_type,
+                activation=activation,
+            ),
+        )[0]
+
+    def batch_norm(self, input: Tensor, relu: bool = True, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(
+            OperatorType.BATCHNORM, self._name("batch_norm", name), [input], dict(relu=relu)
+        )[0]
+
+    def layer_norm(
+        self,
+        input: Tensor,
+        axes: Sequence[int],
+        elementwise_affine: bool = True,
+        eps: float = 1e-5,
+        name: Optional[str] = None,
+    ) -> Tensor:
+        return self._add_layer(
+            OperatorType.LAYERNORM,
+            self._name("layer_norm", name),
+            [input],
+            dict(axes=tuple(a % input.ndim for a in axes), elementwise_affine=elementwise_affine, eps=eps),
+        )[0]
+
+    def rms_norm(self, input: Tensor, eps: float = 1e-6, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(
+            OperatorType.RMS_NORM, self._name("rms_norm", name), [input], dict(eps=eps)
+        )[0]
+
+    def embedding(
+        self,
+        input: Tensor,
+        num_entries: int,
+        out_dim: int,
+        aggr: AggrMode = AggrMode.NONE,
+        dtype: DataType = DataType.FLOAT,
+        kernel_initializer: Optional[Initializer] = None,
+        name: Optional[str] = None,
+    ) -> Tensor:
+        return self._add_layer(
+            OperatorType.EMBEDDING,
+            self._name("embedding", name),
+            [input],
+            dict(
+                num_entries=num_entries,
+                out_dim=out_dim,
+                aggr=aggr,
+                dtype=dtype,
+                kernel_initializer=kernel_initializer,
+            ),
+        )[0]
+
+    def multihead_attention(
+        self,
+        query: Tensor,
+        key: Tensor,
+        value: Tensor,
+        embed_dim: int,
+        num_heads: int,
+        kdim: int = 0,
+        vdim: int = 0,
+        dropout: float = 0.0,
+        causal: bool = False,
+        use_flash: bool = True,
+        kernel_initializer: Optional[Initializer] = None,
+        name: Optional[str] = None,
+    ) -> Tensor:
+        return self._add_layer(
+            OperatorType.MULTIHEAD_ATTENTION,
+            self._name("attention", name),
+            [query, key, value],
+            dict(
+                embed_dim=embed_dim,
+                num_heads=num_heads,
+                kdim=kdim or None,
+                vdim=vdim or None,
+                dropout=dropout,
+                causal=causal,
+                use_flash=use_flash,
+                kernel_initializer=kernel_initializer,
+            ),
+        )[0]
+
+    def softmax(self, input: Tensor, dim: int = -1, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(
+            OperatorType.SOFTMAX, self._name("softmax", name), [input], dict(dim=dim)
+        )[0]
+
+    def dropout(self, input: Tensor, rate: float, seed: int = 0, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(
+            OperatorType.DROPOUT, self._name("dropout", name), [input], dict(rate=rate, seed=seed)
+        )[0]
+
+    def flat(self, input: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(OperatorType.FLAT, self._name("flat", name), [input], {})[0]
+
+    def concat(self, tensors: Sequence[Tensor], axis: int, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(
+            OperatorType.CONCAT, self._name("concat", name), list(tensors), dict(axis=axis)
+        )[0]
+
+    def split(
+        self, input: Tensor, sizes: Union[int, Sequence[int]], axis: int, name: Optional[str] = None
+    ) -> List[Tensor]:
+        if isinstance(sizes, int):
+            assert input.shape[axis] % sizes == 0
+            sizes = [input.shape[axis] // sizes] * sizes
+        return self._add_layer(
+            OperatorType.SPLIT,
+            self._name("split", name),
+            [input],
+            dict(sizes=tuple(sizes), axis=axis),
+        )
+
+    def reshape(self, input: Tensor, shape: Sequence[int], name: Optional[str] = None) -> Tensor:
+        return self._add_layer(
+            OperatorType.RESHAPE, self._name("reshape", name), [input], dict(shape=tuple(shape))
+        )[0]
+
+    def transpose(self, input: Tensor, perm: Sequence[int], name: Optional[str] = None) -> Tensor:
+        return self._add_layer(
+            OperatorType.TRANSPOSE, self._name("transpose", name), [input], dict(perm=tuple(perm))
+        )[0]
+
+    def reverse(self, input: Tensor, axis: int, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(
+            OperatorType.REVERSE, self._name("reverse", name), [input], dict(axis=axis)
+        )[0]
+
+    def reduce_sum(
+        self, input: Tensor, axes: Sequence[int], keepdims: bool = False, name: Optional[str] = None
+    ) -> Tensor:
+        return self._add_layer(
+            OperatorType.REDUCE_SUM,
+            self._name("reduce_sum", name),
+            [input],
+            dict(axes=tuple(axes), keepdims=keepdims),
+        )[0]
+
+    def reduce_mean(
+        self, input: Tensor, axes: Sequence[int], keepdims: bool = False, name: Optional[str] = None
+    ) -> Tensor:
+        return self._add_layer(
+            OperatorType.REDUCE_MEAN,
+            self._name("reduce_mean", name),
+            [input],
+            dict(axes=tuple(axes), keepdims=keepdims),
+        )[0]
+
+    def batch_matmul(self, a: Tensor, b: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(
+            OperatorType.BATCHMATMUL, self._name("batch_matmul", name), [a, b], {}
+        )[0]
+
+    def gather(self, data: Tensor, index: Tensor, dim: int = 0, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(
+            OperatorType.GATHER, self._name("gather", name), [data, index], dict(dim=dim)
+        )[0]
+
+    def cast(self, input: Tensor, dtype: DataType, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(
+            OperatorType.CAST, self._name("cast", name), [input], dict(dtype=dtype)
+        )[0]
+
+    def top_k(self, input: Tensor, k: int, sorted: bool = True, name: Optional[str] = None) -> List[Tensor]:
+        return self._add_layer(
+            OperatorType.TOPK, self._name("topk", name), [input], dict(k=k, sorted=sorted)
+        )
+
+    def group_by(
+        self, data: Tensor, assign: Tensor, n_experts: int, alpha: float = 1.0, name: Optional[str] = None
+    ) -> List[Tensor]:
+        return self._add_layer(
+            OperatorType.GROUP_BY,
+            self._name("group_by", name),
+            [data, assign],
+            dict(n_experts=n_experts, alpha=alpha),
+        )
+
+    def aggregate(
+        self, inputs: Sequence[Tensor], n: int, lambda_bal: float = 0.0, name: Optional[str] = None
+    ) -> Tensor:
+        return self._add_layer(
+            OperatorType.AGGREGATE,
+            self._name("aggregate", name),
+            list(inputs),
+            dict(n=n, lambda_bal=lambda_bal),
+        )[0]
+
+    def aggregate_spec(
+        self, inputs: Sequence[Tensor], n: int, lambda_bal: float = 0.0, name: Optional[str] = None
+    ) -> Tensor:
+        return self._add_layer(
+            OperatorType.AGGREGATE_SPEC,
+            self._name("aggregate_spec", name),
+            list(inputs),
+            dict(n=n, lambda_bal=lambda_bal),
+        )[0]
+
+    def moe(
+        self,
+        input: Tensor,
+        num_exp: int,
+        num_select: int,
+        expert_hidden_size: int,
+        alpha: float = 2.0,
+        lambda_bal: float = 0.04,
+        name: Optional[str] = None,
+    ) -> Tensor:
+        """Composite MoE — mirrors ``FFModel::moe`` (``src/ops/moe.cc:20-44``):
+        gate -> topk -> group_by -> experts -> aggregate."""
+        gate = self.dense(input, num_exp, ActiMode.NONE, name=self._name("moe_gate", name))
+        gate = self.softmax(gate)
+        topk_out, topk_idx = self.top_k(gate, num_select)
+        grouped = self.group_by(input, topk_idx, num_exp, alpha)
+        experts = [
+            self.dense(
+                self.dense(g, expert_hidden_size, ActiMode.RELU),
+                input.shape[-1],
+            )
+            for g in grouped
+        ]
+        return self.aggregate(
+            [topk_out, topk_idx, topk_idx, gate] + experts, num_exp, lambda_bal
+        )
+
+    # elementwise builders (model.h unary/binary API)
+    def add(self, x: Tensor, y: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(OperatorType.EW_ADD, self._name("add", name), [x, y], {})[0]
+
+    def subtract(self, x: Tensor, y: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(OperatorType.EW_SUB, self._name("sub", name), [x, y], {})[0]
+
+    def multiply(self, x: Tensor, y: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(OperatorType.EW_MUL, self._name("mul", name), [x, y], {})[0]
+
+    def divide(self, x: Tensor, y: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(OperatorType.EW_DIV, self._name("div", name), [x, y], {})[0]
+
+    def max(self, x: Tensor, y: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(OperatorType.EW_MAX, self._name("max", name), [x, y], {})[0]
+
+    def min(self, x: Tensor, y: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._add_layer(OperatorType.EW_MIN, self._name("min", name), [x, y], {})[0]
+
+    def _unary(self, op: OperatorType, x: Tensor, name: Optional[str], **attrs) -> Tensor:
+        return self._add_layer(op, self._name(op.value, name), [x], attrs)[0]
+
+    def relu(self, x: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.RELU, x, name)
+
+    def sigmoid(self, x: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.SIGMOID, x, name)
+
+    def tanh(self, x: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.TANH, x, name)
+
+    def elu(self, x: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.ELU, x, name)
+
+    def gelu(self, x: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.GELU, x, name)
+
+    def exp(self, x: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.EXP, x, name)
+
+    def sin(self, x: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.SIN, x, name)
+
+    def cos(self, x: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.COS, x, name)
+
+    def rsqrt(self, x: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.RSQRT, x, name)
+
+    def pow(self, x: Tensor, exponent: float, name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.POW, x, name, exponent=exponent)
+
+    def identity(self, x: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.IDENTITY, x, name)
+
+    def scalar_multiply(self, x: Tensor, scalar: float, name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.SCALAR_MULTIPLY, x, name, scalar=scalar)
+
+    def scalar_add(self, x: Tensor, scalar: float, name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.SCALAR_ADD, x, name, scalar=scalar)
+
+    def scalar_sub(self, x: Tensor, scalar: float, name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.SCALAR_SUB, x, name, scalar=scalar)
+
+    def scalar_true_divide(self, x: Tensor, scalar: float, name: Optional[str] = None) -> Tensor:
+        return self._unary(OperatorType.SCALAR_TRUE_DIV, x, name, scalar=scalar)
+
+    # --------------------------------------------------------------- compile
+    def compile(
+        self,
+        optimizer: Optional[Optimizer] = None,
+        loss_type: LossType = LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics: Sequence[MetricsType] = (),
+        mesh: Optional[MachineMesh] = None,
+        strategy: Optional[Strategy] = None,
+        seed: Optional[int] = None,
+    ) -> None:
+        """Pick/search a strategy, build the jitted step, init params.
+
+        Reference: ``FFModel::compile`` (``src/runtime/model.cc:2803-3169``).
+        Strategy resolution order: explicit arg > --import-strategy file >
+        Unity search (if --search-budget set) > default data-parallel.
+        """
+        assert self.layers, "empty model"
+        cfg = self.config
+        self._optimizer = optimizer or SGDOptimizer(
+            lr=cfg.learning_rate, weight_decay=cfg.weight_decay
+        )
+        logits = self.layers[-1].outputs[0]
+
+        if mesh is None:
+            if cfg.mesh_shape is not None:
+                mesh = MachineMesh(cfg.mesh_shape, cfg.mesh_axis_names[: len(cfg.mesh_shape)])
+            else:
+                mesh = default_mesh()
+        if strategy is None:
+            if cfg.import_strategy_file:
+                with open(cfg.import_strategy_file) as f:
+                    strategy = Strategy.from_json(f.read())
+            elif cfg.search_budget > 0 and not cfg.only_data_parallel:
+                from flexflow_tpu.search import unity_search
+
+                strategy = unity_search(
+                    self.layers, mesh, budget=cfg.search_budget, alpha=cfg.search_alpha
+                )
+            else:
+                strategy = data_parallel_strategy(self.layers, mesh)
+        self.strategy = strategy
+        if cfg.export_strategy_file:
+            with open(cfg.export_strategy_file, "w") as f:
+                f.write(strategy.to_json())
+
+        self.executor = Executor(
+            layers=self.layers,
+            graph_inputs=self.graph_inputs,
+            logits=logits,
+            strategy=strategy,
+            optimizer=self._optimizer,
+            loss_type=loss_type,
+            metrics=Metrics(loss_type, metrics),
+            seed=seed if seed is not None else cfg.rng_seed,
+        )
+        self.executor.init_params()
+
+    # ------------------------------------------------------------------- fit
+    def fit(
+        self,
+        x: Union[np.ndarray, Sequence[np.ndarray]],
+        y: np.ndarray,
+        batch_size: Optional[int] = None,
+        epochs: Optional[int] = None,
+        verbose: bool = True,
+    ) -> PerfMetrics:
+        """Canonical training loop (reference ``FFModel.fit``,
+        ``flexflow_cffi.py:2062-2104``).  Each iteration is one cached jit
+        call — the analog of replaying a Legion trace."""
+        assert self.executor is not None, "call compile() first"
+        bs = batch_size or self.config.batch_size
+        epochs = epochs or self.config.epochs
+        xs = list(x) if isinstance(x, (list, tuple)) else [x]
+
+        loaders = [
+            SingleDataLoader(a, bs, None, None) for a in xs
+        ] + [SingleDataLoader(y, bs, None, None)]
+        it = BatchIterator(loaders)
+        if it.num_batches == 0:
+            raise ValueError(
+                f"dataset has {len(xs[0])} samples < batch_size {bs}: zero batches"
+            )
+
+        pm = PerfMetrics()
+        for epoch in range(epochs):
+            it.reset()
+            for batch in it:
+                *bx, by = batch
+                loss, m = self.executor.train_step(bx, by)
+                pm.update({k: float(v) for k, v in m.items()}, bs)
+            if verbose:
+                print(
+                    f"epoch {epoch}: loss={float(loss):.4f} "
+                    + " ".join(f"{k}={float(v):.4f}" for k, v in m.items())
+                    + f" throughput={pm.throughput():.2f} samples/s"
+                )
+        return pm
+
+    def eval_batch(self, x: Sequence[np.ndarray]) -> jax.Array:
+        assert self.executor is not None
+        xs = list(x) if isinstance(x, (list, tuple)) else [x]
+        return self.executor.forward(xs)
+
+    # ------------------------------------------------- weight access (R3 API)
+    def get_weights(self) -> Dict[str, Dict[str, np.ndarray]]:
+        """Host copy of all weights, trainable AND stateful (BN running
+        stats) — reference ``ParallelTensorBase::get_tensor``
+        (``parallel_tensor.h:168``)."""
+        assert self.executor is not None
+        out: Dict[str, Dict[str, np.ndarray]] = jax.tree.map(
+            np.asarray, self.executor.params
+        )
+        for lname, ws in jax.tree.map(np.asarray, self.executor.state).items():
+            out.setdefault(lname, {}).update(ws)
+        return out
+
+    def set_weights(self, weights: Dict[str, Dict[str, np.ndarray]]) -> None:
+        """Reference ``set_tensor``/numpy attach
+        (``examples/python/native/mnist_mlp_attach.py`` pattern)."""
+        assert self.executor is not None
+        ex = self.executor
+        for lname, ws in weights.items():
+            for wname, arr in ws.items():
+                bucket = (
+                    ex.params
+                    if lname in ex.params and wname in ex.params[lname]
+                    else ex.state
+                )
+                cur = bucket[lname][wname]
+                bucket[lname][wname] = jax.device_put(
+                    np.asarray(arr, dtype=np.asarray(cur).dtype), cur.sharding
+                )
+
+    @property
+    def num_parameters(self) -> int:
+        assert self.executor is not None
+        return sum(
+            int(np.prod(w.shape)) for lw in self.executor.params.values() for w in lw.values()
+        )

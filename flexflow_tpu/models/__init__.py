@@ -1,0 +1,7 @@
+"""Built-in model builders (reference ``examples/cpp/*`` apps as library
+functions): Transformer/BERT, MLP, AlexNet, ResNet, DLRM, MoE."""
+
+from flexflow_tpu.models.transformer import transformer_encoder
+from flexflow_tpu.models.mlp import mlp
+
+__all__ = ["transformer_encoder", "mlp"]

@@ -1,0 +1,24 @@
+"""MLP builder (reference ``examples/cpp/MLP_Unify`` / python mnist_mlp)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from flexflow_tpu.fftype import ActiMode
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.tensor import Tensor
+
+
+def mlp(
+    model: FFModel,
+    batch: int,
+    in_dim: int,
+    hidden_dims: Sequence[int],
+    num_classes: int,
+    activation: ActiMode = ActiMode.RELU,
+) -> Tensor:
+    t = model.create_tensor((batch, in_dim))
+    for h in hidden_dims:
+        t = model.dense(t, h, activation)
+    t = model.dense(t, num_classes)
+    return model.softmax(t)

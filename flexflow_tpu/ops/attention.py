@@ -1,0 +1,127 @@
+"""MultiHeadAttention.
+
+Reference: ``src/ops/attention.cc`` (926 LoC) wrapping
+``cudnnMultiHeadAttnForward/BackwardData/BackwardWeights``
+(``src/ops/attention.cu:35,105,128``); weights live in one packed region,
+head-parallelism comes from replicate/partition xfers
+(``create_partition_attention_combine``, ``substitution.cc:1769``).
+
+TPU-native: four projection matmuls + scaled-dot-product core.  The core
+can run through the Pallas flash-attention kernel
+(``flexflow_tpu/ops/pallas/flash_attention.py``) — O(seq) memory, MXU-tiled
+— or a plain jnp einsum path (useful on CPU test meshes).  Head parallelism
+is just sharding the head dim of the projection weights (``tp_dim``), and
+sequence parallelism shards the (batch, seq) activations; both are strategy
+choices, not separate code paths.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.fftype import DataType, OperatorType
+from flexflow_tpu.initializer import default_kernel_initializer
+from flexflow_tpu.ops.base import OpContext, OpDef, ShapeDtype, WeightSpec, register_op
+from flexflow_tpu.tensor import Layer
+
+
+def sdpa(q, k, v, *, causal: bool = False, dropout_rate: float = 0.0, rng=None):
+    """Scaled dot-product attention over (B, H, S, D) tensors."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0 and rng is not None:
+        keep = 1.0 - dropout_rate
+        probs = probs * jax.random.bernoulli(rng, keep, probs.shape) / keep
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+class MultiHeadAttention(OpDef):
+    """Inputs: query (B, Sq, E), key (B, Sk, Ek), value (B, Sk, Ev).
+    Output: (B, Sq, E).  Attrs: embed_dim, num_heads, kdim, vdim, dropout,
+    causal, use_flash."""
+
+    op_type = OperatorType.MULTIHEAD_ATTENTION
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        q = layer.inputs[0]
+        e = layer.attrs["embed_dim"]
+        return [(q.shape[:-1] + (e,), q.dtype)]
+
+    def weights(self, layer: Layer) -> List[WeightSpec]:
+        q, k, v = layer.inputs[:3]
+        a = layer.attrs
+        e, h = a["embed_dim"], a["num_heads"]
+        kd = a.get("kdim") or e // h
+        vd = a.get("vdim") or e // h
+        init = a.get("kernel_initializer") or default_kernel_initializer()
+        dt = q.dtype
+        # Layouts put the head(*head_dim) axis last => TP shards the lane dim.
+        return [
+            WeightSpec("wq", (q.shape[-1], h * kd), dt, init, tp_dim=1),
+            WeightSpec("wk", (k.shape[-1], h * kd), dt, init, tp_dim=1),
+            WeightSpec("wv", (v.shape[-1], h * vd), dt, init, tp_dim=1),
+            WeightSpec("wo", (h * vd, e), dt, init, tp_dim=0),
+        ]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        q_in, k_in, v_in = inputs[:3]
+        a = layer.attrs
+        e, h = a["embed_dim"], a["num_heads"]
+        kd = a.get("kdim") or e // h
+        vd = a.get("vdim") or e // h
+        b, sq, _ = q_in.shape
+        sk = k_in.shape[1]
+
+        q = (q_in @ params["wq"]).reshape(b, sq, h, kd).transpose(0, 2, 1, 3)
+        k = (k_in @ params["wk"]).reshape(b, sk, h, kd).transpose(0, 2, 1, 3)
+        v = (v_in @ params["wv"]).reshape(b, sk, h, vd).transpose(0, 2, 1, 3)
+
+        dropout = a.get("dropout", 0.0) if ctx.training else 0.0
+        use_flash = a.get("use_flash", True) and dropout == 0.0
+        if use_flash and _flash_ok(sq, sk, kd):
+            from flexflow_tpu.ops.pallas.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=a.get("causal", False))
+        else:
+            rng = ctx.next_rng() if dropout > 0.0 else None
+            out = sdpa(q, k, v, causal=a.get("causal", False),
+                       dropout_rate=dropout, rng=rng)
+        out = out.transpose(0, 2, 1, 3).reshape(b, sq, h * vd)
+        return [out @ params["wo"]]
+
+    def flops(self, layer: Layer) -> float:
+        q, k, v = layer.inputs[:3]
+        a = layer.attrs
+        e, h = a["embed_dim"], a["num_heads"]
+        kd = a.get("kdim") or e // h
+        vd = a.get("vdim") or e // h
+        b, sq = q.shape[0], q.shape[1]
+        sk = k.shape[1]
+        proj = 2.0 * b * (sq * q.shape[-1] * h * kd + sk * k.shape[-1] * h * kd
+                          + sk * v.shape[-1] * h * vd + sq * h * vd * e)
+        core = 2.0 * b * h * sq * sk * (kd + vd)
+        return proj + core
+
+    def partitionable_dims(self, layer):
+        return {0: "sample", 1: "seq", 2: "channel"}
+
+
+def _flash_ok(sq: int, sk: int, d: int) -> bool:
+    """Flash kernel needs MXU-friendly tiles; fall back otherwise."""
+    import jax as _jax
+
+    if _jax.default_backend() != "tpu":
+        return False
+    return sq >= 128 and sk >= 128 and sq % 128 == 0 and sk % 128 == 0 and d % 128 == 0
+
+
+register_op(MultiHeadAttention())

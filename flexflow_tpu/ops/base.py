@@ -1,0 +1,150 @@
+"""Operator definition base + registry.
+
+Reference pattern (SURVEY §2.3): each op is a graph class (``src/ops/x.cc``,
+shape inference + Legion launchers + cost measurement) plus CUDA kernels
+(``src/ops/kernels/x_kernels.cu``) behind fwd/bwd wrappers.
+
+TPU-native pattern: each op is an :class:`OpDef` —
+  * ``infer`` — shape/dtype inference (replaces the .cc constructors)
+  * ``weights`` — weight declarations (shape, initializer, TP-sharding hints)
+  * ``forward`` — pure jax lowering (replaces the .cu forward kernel; the
+    backward kernel is *gone*: jax autodiff derives it, which eliminates the
+    reference's hand-written ``backward_task`` per op)
+  * ``flops``/``mem_bytes`` — analytic cost for the simulator (replaces
+    on-device ``measure_operator_cost`` as the first-line estimate).
+
+Ops never talk to devices or shardings; strategies apply sharding
+constraints *around* op lowerings at step-build time (see
+``flexflow_tpu/runtime/executor.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from flexflow_tpu.fftype import DataType, OperatorType
+from flexflow_tpu.initializer import Initializer
+from flexflow_tpu.tensor import Layer
+
+ShapeDtype = Tuple[Tuple[int, ...], DataType]
+
+
+@dataclasses.dataclass
+class WeightSpec:
+    """Declaration of one trainable (or stateful) parameter.
+
+    ``tp_dim``: which weight dim shards when the op is tensor-parallel along
+    its partitionable output dim (None = always replicate).  This encodes the
+    reference's per-op ``ParallelDimMappingRecord`` for weights
+    (``include/flexflow/operator.h:22-49``) in the only form the TPU build
+    needs: weight-dim <-> mesh-axis alignment.
+    """
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: DataType
+    initializer: Initializer
+    trainable: bool = True
+    tp_dim: Optional[int] = None
+
+
+class OpContext:
+    """Per-trace context handed to ``forward``: training flag + per-layer rng."""
+
+    def __init__(self, training: bool, rng: Optional[jax.Array] = None) -> None:
+        self.training = training
+        self._rng = rng
+        self._counter = 0
+
+    def next_rng(self) -> jax.Array:
+        assert self._rng is not None, "op needs rng but none provided"
+        key = jax.random.fold_in(self._rng, self._counter)
+        self._counter += 1
+        return key
+
+
+class OpDef:
+    op_type: OperatorType = OperatorType.NOOP
+
+    # --- graph side -------------------------------------------------------
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        """Output shapes/dtypes from input tensors + attrs."""
+        raise NotImplementedError
+
+    def weights(self, layer: Layer) -> List[WeightSpec]:
+        return []
+
+    # --- compute side -----------------------------------------------------
+    def forward(
+        self,
+        layer: Layer,
+        params: Dict[str, jax.Array],
+        inputs: Sequence[jax.Array],
+        ctx: OpContext,
+    ) -> List[jax.Array]:
+        raise NotImplementedError
+
+    # --- cost side (simulator S3 analog) ----------------------------------
+    def flops(self, layer: Layer) -> float:
+        """Forward FLOPs (single copy of the op, unsharded)."""
+        return float(sum(math.prod(s) for s, _ in self.infer(layer)))
+
+    def mem_bytes(self, layer: Layer) -> float:
+        total = 0
+        for t in layer.inputs:
+            total += math.prod(t.shape) * _dtype_bytes(t.dtype)
+        for s, dt in self.infer(layer):
+            total += math.prod(s) * _dtype_bytes(dt)
+        for w in self.weights(layer):
+            total += math.prod(w.shape) * _dtype_bytes(w.dtype)
+        return float(total)
+
+    # --- parallelism metadata --------------------------------------------
+    def partitionable_dims(self, layer: Layer) -> Dict[int, str]:
+        """Output dims the search may shard, tagged with a semantic kind:
+        ``sample`` (batch), ``channel`` (TP), ``seq`` (sequence/context
+        parallel), ``expert``.  Analog of the reference's per-op
+        ParallelDimMappingRecords restricted to legal degrees."""
+        out_shape, _ = self.infer(layer)[0]
+        return {0: "sample"} if out_shape else {}
+
+
+_dtype_sizes = {
+    DataType.BOOLEAN: 1,
+    DataType.INT32: 4,
+    DataType.INT64: 8,
+    DataType.HALF: 2,
+    DataType.BFLOAT16: 2,
+    DataType.FLOAT: 4,
+    DataType.DOUBLE: 8,
+}
+
+
+def _dtype_bytes(dt: DataType) -> int:
+    return _dtype_sizes.get(dt, 4)
+
+
+_REGISTRY: Dict[OperatorType, OpDef] = {}
+
+
+def register_op(defn: OpDef) -> OpDef:
+    """Analog of the reference task registry
+    (``register_flexflow_internal_tasks``, ``src/runtime/model.cc:3732``) —
+    but one entry per op, not three tasks (INIT/FWD/BWD collapse into one
+    traced lowering + autodiff)."""
+    _REGISTRY[defn.op_type] = defn
+    return defn
+
+
+def get_op_def(op_type: OperatorType) -> OpDef:
+    if op_type not in _REGISTRY:
+        raise KeyError(f"no OpDef registered for {op_type}")
+    return _REGISTRY[op_type]
+
+
+def all_ops() -> Dict[OperatorType, OpDef]:
+    return dict(_REGISTRY)

@@ -1,0 +1,206 @@
+"""Conv2D, Pool2D, BatchNorm, Flat.
+
+Reference: ``src/ops/conv_2d.cc`` (1198 LoC, cuDNN conv + algo picker,
+groups), ``src/ops/pool_2d.cc`` (cudnnPooling), ``src/ops/batch_norm.cc``
+(cudnnBatchNormalization w/ fused relu), ``src/ops/flat.cc`` (CNN->MLP
+bridge).
+
+TPU-native: ``lax.conv_general_dilated`` lowers to MXU convolutions.  We use
+NHWC activations / HWIO weights (TPU-preferred layouts — channels minormost
+= lane dim) while the user-facing API keeps the reference's NCHW shape
+convention (``FFModel::conv2d`` docs) and we transpose at the lowering
+boundary only when the model was built NCHW.  Internally everything is NHWC;
+``Flat`` is the only op that observes the difference, and it matches the
+reference's flatten order by transposing before reshape.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from flexflow_tpu.fftype import ActiMode, DataType, OperatorType, PoolType
+from flexflow_tpu.initializer import default_bias_initializer, default_kernel_initializer
+from flexflow_tpu.ops.base import OpContext, OpDef, ShapeDtype, WeightSpec, register_op
+from flexflow_tpu.ops.dense import apply_activation
+from flexflow_tpu.tensor import Layer
+
+
+def _conv_out(size: int, kernel: int, stride: int, pad: int) -> int:
+    return (size + 2 * pad - kernel) // stride + 1
+
+
+class Conv2D(OpDef):
+    """NCHW in the graph (reference convention), NHWC on device."""
+
+    op_type = OperatorType.CONV2D
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        t = layer.inputs[0]
+        n, c, h, w = t.shape
+        a = layer.attrs
+        oh = _conv_out(h, a["kernel_h"], a["stride_h"], a["padding_h"])
+        ow = _conv_out(w, a["kernel_w"], a["stride_w"], a["padding_w"])
+        return [((n, a["out_channels"], oh, ow), t.dtype)]
+
+    def weights(self, layer: Layer) -> List[WeightSpec]:
+        t = layer.inputs[0]
+        a = layer.attrs
+        c_in = t.shape[1] // a.get("groups", 1)
+        ws = [
+            WeightSpec(
+                name="kernel",
+                shape=(a["kernel_h"], a["kernel_w"], c_in, a["out_channels"]),  # HWIO
+                dtype=t.dtype,
+                initializer=a.get("kernel_initializer") or default_kernel_initializer(),
+                tp_dim=3,
+            )
+        ]
+        if a.get("use_bias", True):
+            ws.append(
+                WeightSpec(
+                    name="bias",
+                    shape=(a["out_channels"],),
+                    dtype=t.dtype,
+                    initializer=a.get("bias_initializer") or default_bias_initializer(),
+                    tp_dim=0,
+                )
+            )
+        return ws
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        a = layer.attrs
+        x = jnp.transpose(inputs[0], (0, 2, 3, 1))  # NCHW -> NHWC
+        y = lax.conv_general_dilated(
+            x,
+            params["kernel"],
+            window_strides=(a["stride_h"], a["stride_w"]),
+            padding=[(a["padding_h"], a["padding_h"]), (a["padding_w"], a["padding_w"])],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=a.get("groups", 1),
+            preferred_element_type=x.dtype,
+        )
+        if "bias" in params:
+            y = y + params["bias"]
+        y = apply_activation(y, a.get("activation", ActiMode.NONE))
+        return [jnp.transpose(y, (0, 3, 1, 2))]
+
+    def flops(self, layer: Layer) -> float:
+        (n, co, oh, ow), _ = self.infer(layer)[0]
+        a = layer.attrs
+        c_in = layer.inputs[0].shape[1] // a.get("groups", 1)
+        return 2.0 * n * co * oh * ow * c_in * a["kernel_h"] * a["kernel_w"]
+
+    def partitionable_dims(self, layer):
+        # sample + out-channel (attribute parallelism, model.cc:3627)
+        return {0: "sample", 1: "channel"}
+
+
+class Pool2D(OpDef):
+    op_type = OperatorType.POOL2D
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        t = layer.inputs[0]
+        n, c, h, w = t.shape
+        a = layer.attrs
+        oh = _conv_out(h, a["kernel_h"], a["stride_h"], a["padding_h"])
+        ow = _conv_out(w, a["kernel_w"], a["stride_w"], a["padding_w"])
+        return [((n, c, oh, ow), t.dtype)]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        a = layer.attrs
+        x = inputs[0]
+        dims = (1, 1, a["kernel_h"], a["kernel_w"])
+        strides = (1, 1, a["stride_h"], a["stride_w"])
+        pads = ((0, 0), (0, 0), (a["padding_h"], a["padding_h"]), (a["padding_w"], a["padding_w"]))
+        if a.get("pool_type", PoolType.MAX) is PoolType.MAX:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads)
+        else:
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads) / (
+                a["kernel_h"] * a["kernel_w"]
+            )
+        y = apply_activation(y, a.get("activation", ActiMode.NONE))
+        return [y]
+
+    def partitionable_dims(self, layer):
+        return {0: "sample", 1: "channel"}
+
+
+class BatchNorm(OpDef):
+    """``src/ops/batch_norm.cc``: per-channel BN over NCHW, optional fused
+    relu.  Running stats are non-trainable state updated in the step (the
+    reference updates them inside the cudnn call)."""
+
+    op_type = OperatorType.BATCHNORM
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        t = layer.inputs[0]
+        return [(t.shape, t.dtype)]
+
+    def weights(self, layer: Layer) -> List[WeightSpec]:
+        c = layer.inputs[0].shape[1]
+        dt = layer.inputs[0].dtype
+        from flexflow_tpu.initializer import OnesInitializer, ZeroInitializer
+
+        return [
+            WeightSpec("scale", (c,), dt, OnesInitializer(), tp_dim=0),
+            WeightSpec("bias", (c,), dt, ZeroInitializer(), tp_dim=0),
+            WeightSpec("running_mean", (c,), dt, ZeroInitializer(), trainable=False, tp_dim=0),
+            WeightSpec("running_var", (c,), dt, OnesInitializer(), trainable=False, tp_dim=0),
+        ]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        x = inputs[0]
+        eps = layer.attrs.get("eps", 1e-5)
+        if ctx.training:
+            mean = jnp.mean(x, axis=(0, 2, 3))
+            var = jnp.var(x, axis=(0, 2, 3))
+        else:
+            mean, var = params["running_mean"], params["running_var"]
+        inv = lax.rsqrt(var + eps).reshape(1, -1, 1, 1)
+        y = (x - mean.reshape(1, -1, 1, 1)) * inv
+        y = y * params["scale"].reshape(1, -1, 1, 1) + params["bias"].reshape(1, -1, 1, 1)
+        if layer.attrs.get("relu", True):
+            y = jax.nn.relu(y)
+        return [y]
+
+    def state_update(self, layer, params, inputs):
+        """New running stats (momentum matches cudnn default 0.1)."""
+        x = inputs[0]
+        m = layer.attrs.get("momentum", 0.1)
+        mean = jnp.mean(x, axis=(0, 2, 3))
+        var = jnp.var(x, axis=(0, 2, 3))
+        return {
+            "running_mean": (1 - m) * params["running_mean"] + m * mean,
+            "running_var": (1 - m) * params["running_var"] + m * var,
+        }
+
+    def partitionable_dims(self, layer):
+        return {0: "sample", 1: "channel"}
+
+
+class Flat(OpDef):
+    """``src/ops/flat.cc``: (N,C,H,W) -> (N, C*H*W)."""
+
+    op_type = OperatorType.FLAT
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        t = layer.inputs[0]
+        return [((t.shape[0], math.prod(t.shape[1:])), t.dtype)]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        x = inputs[0]
+        return [x.reshape(x.shape[0], -1)]
+
+    def partitionable_dims(self, layer):
+        return {0: "sample"}
+
+
+register_op(Conv2D())
+register_op(Pool2D())
+register_op(BatchNorm())
+register_op(Flat())

@@ -1,0 +1,133 @@
+"""Linear (dense) and BatchMatmul.
+
+Reference: ``src/ops/linear.cc`` (1184 LoC; fwd launcher+task 347-455,
+cublasGemmEx kernel ``src/ops/kernels/linear_kernels.cu:192-274``, fused
+cudnnActivation epilogue) and ``src/ops/batch_matmul.cc`` (cublas strided
+batched gemm, ``a_seq_length_dim`` masking).
+
+TPU-native: a single ``jnp.dot_general`` hits the MXU; the activation
+epilogue is a fused VPU op (XLA fuses automatically — no analog of the
+cudnn epilogue plumbing).  Weight layout is ``(in, out)`` so the TP-shard
+dim (out) is the minormost = lane dim on the MXU.
+
+Parallelism notes (mirrors reference capabilities):
+  * out-dim partition — weight shards on dim 1 (``tp_dim=1``); the xfer
+    ``create_partition_linear_combine`` (``substitution.cc:1809``).
+  * in-dim partition — weight shards dim 0, output becomes a partial sum
+    needing a Reduction (reference ``LINEAR_BWD2/UPD`` tasks,
+    ``model.h:104-105``; xfer ``create_replicate_linear_combine``).
+Both are expressed in strategy specs; the lowering is identical.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.fftype import ActiMode, DataType, OperatorType
+from flexflow_tpu.initializer import default_bias_initializer, default_kernel_initializer
+from flexflow_tpu.ops.base import OpContext, OpDef, ShapeDtype, WeightSpec, register_op
+from flexflow_tpu.tensor import Layer
+
+
+def apply_activation(x: jax.Array, act: ActiMode) -> jax.Array:
+    if act is ActiMode.NONE:
+        return x
+    if act is ActiMode.RELU:
+        return jax.nn.relu(x)
+    if act is ActiMode.SIGMOID:
+        return jax.nn.sigmoid(x)
+    if act is ActiMode.TANH:
+        return jnp.tanh(x)
+    if act is ActiMode.GELU:
+        return jax.nn.gelu(x)
+    raise ValueError(act)
+
+
+class Linear(OpDef):
+    op_type = OperatorType.LINEAR
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        t = layer.inputs[0]
+        out_dim = layer.attrs["out_dim"]
+        return [(t.shape[:-1] + (out_dim,), t.dtype)]
+
+    def weights(self, layer: Layer) -> List[WeightSpec]:
+        t = layer.inputs[0]
+        out_dim = layer.attrs["out_dim"]
+        ws = [
+            WeightSpec(
+                name="kernel",
+                shape=(t.shape[-1], out_dim),
+                dtype=t.dtype,
+                initializer=layer.attrs.get("kernel_initializer")
+                or default_kernel_initializer(),
+                tp_dim=1,
+            )
+        ]
+        if layer.attrs.get("use_bias", True):
+            ws.append(
+                WeightSpec(
+                    name="bias",
+                    shape=(out_dim,),
+                    dtype=t.dtype,
+                    initializer=layer.attrs.get("bias_initializer")
+                    or default_bias_initializer(),
+                    tp_dim=0,
+                )
+            )
+        return ws
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        x = inputs[0]
+        y = jnp.dot(x, params["kernel"], preferred_element_type=x.dtype)
+        if "bias" in params:
+            y = y + params["bias"]
+        return [apply_activation(y, layer.attrs.get("activation", ActiMode.NONE))]
+
+    def flops(self, layer: Layer) -> float:
+        t = layer.inputs[0]
+        return 2.0 * math.prod(t.shape) * layer.attrs["out_dim"]
+
+    def partitionable_dims(self, layer):
+        t = layer.inputs[0]
+        d = {0: "sample", t.ndim - 1: "channel"}
+        if t.ndim >= 3:
+            d[1] = "seq"
+        return d
+
+
+class BatchMatmul(OpDef):
+    """``src/ops/batch_matmul.cc``: C[b] = A[b] @ B[b].
+
+    ``a_seq_length_dim``/``b_seq_length_dim`` masking
+    (``include/flexflow/model.h:481-485``) is honored via ``seq_length``
+    in the context's iteration config when set (NMT incremental decoding).
+    """
+
+    op_type = OperatorType.BATCHMATMUL
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        a, b = layer.inputs
+        assert a.shape[:-2] == b.shape[:-2], "batch dims must match"
+        assert a.shape[-1] == b.shape[-2]
+        return [(a.shape[:-1] + (b.shape[-1],), a.dtype)]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        a, b = inputs
+        return [jnp.matmul(a, b)]
+
+    def flops(self, layer: Layer) -> float:
+        a, b = layer.inputs
+        return 2.0 * math.prod(a.shape) * b.shape[-1]
+
+    def partitionable_dims(self, layer):
+        a, _ = layer.inputs
+        return {0: "sample"}
+
+
+register_op(Linear())
+register_op(BatchMatmul())

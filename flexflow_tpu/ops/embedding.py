@@ -1,0 +1,95 @@
+"""Embedding and Gather.
+
+Reference: ``src/ops/embedding.cc`` (1205 LoC, custom gather/scatter CUDA
+kernels, AggrMode SUM/AVG/NONE, vocab-partition parameter parallelism via
+replica dims, ``embedding.cc:162-196``) and ``src/ops/gather.cc``.
+
+TPU-native: ``jnp.take`` lowers to a gather HLO which XLA implements as a
+dynamic-slice loop on TPU; for vocab-sharded tables under TP the strategy
+shards the table's vocab dim and XLA handles out-of-shard indices via
+masked gather + psum (the one-hot matmul trick is used by the DLRM-tuned
+Pallas kernel in ``flexflow_tpu/ops/pallas/embedding_bag.py`` when rows are
+small — that path replaces the reference's all-to-all-style region
+movement).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.fftype import AggrMode, DataType, OperatorType
+from flexflow_tpu.initializer import NormInitializer
+from flexflow_tpu.ops.base import OpContext, OpDef, ShapeDtype, WeightSpec, register_op
+from flexflow_tpu.tensor import Layer
+
+
+class Embedding(OpDef):
+    """Input: int ids ``(batch, bag)``; output ``(batch, out_dim)`` under
+    SUM/AVG aggregation, or ``(batch, bag, out_dim)`` with AggrMode.NONE —
+    matching reference shape rules (``src/ops/embedding.cc`` ctor)."""
+
+    op_type = OperatorType.EMBEDDING
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        t = layer.inputs[0]
+        a = layer.attrs
+        out_dim = a["out_dim"]
+        aggr = a.get("aggr", AggrMode.NONE)
+        dt = a.get("dtype", DataType.FLOAT)
+        if aggr is AggrMode.NONE:
+            return [(t.shape + (out_dim,), dt)]
+        return [(t.shape[:-1] + (out_dim,), dt)]
+
+    def weights(self, layer: Layer) -> List[WeightSpec]:
+        a = layer.attrs
+        dt = a.get("dtype", DataType.FLOAT)
+        return [
+            WeightSpec(
+                name="kernel",
+                shape=(a["num_entries"], a["out_dim"]),
+                dtype=dt,
+                initializer=a.get("kernel_initializer") or NormInitializer(),
+                tp_dim=0,  # vocab-partition (embedding.cc:162-196)
+            )
+        ]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        ids = inputs[0]
+        table = params["kernel"]
+        aggr = layer.attrs.get("aggr", AggrMode.NONE)
+        rows = jnp.take(table, ids, axis=0)
+        if aggr is AggrMode.SUM:
+            rows = jnp.sum(rows, axis=-2)
+        elif aggr is AggrMode.AVG:
+            rows = jnp.mean(rows, axis=-2)
+        return [rows]
+
+    def flops(self, layer: Layer) -> float:
+        shape, _ = self.infer(layer)[0]
+        return float(math.prod(shape))
+
+    def partitionable_dims(self, layer):
+        return {0: "sample"}
+
+
+class Gather(OpDef):
+    """``src/ops/gather.cc``: torch.gather semantics along ``dim``."""
+
+    op_type = OperatorType.GATHER
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        data, index = layer.inputs
+        return [(index.shape, data.dtype)]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        data, index = inputs
+        dim = layer.attrs.get("dim", 0)
+        return [jnp.take_along_axis(data, index, axis=dim)]
+
+
+register_op(Embedding())
+register_op(Gather())

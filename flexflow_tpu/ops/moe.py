@@ -1,0 +1,149 @@
+"""Mixture-of-Experts ops: Group_by, Aggregate, AggregateSpec.
+
+Reference: ``src/ops/group_by.cc`` (534 LoC, scatter-by-expert with capacity
+factor ``alpha``), ``src/ops/aggregate.cc`` (569 LoC, weighted combine +
+router backward with ``lambda_bal`` load-balancing loss),
+``src/ops/aggregate_spec.cc`` (speculative variant), and the composite
+builder ``FFModel::moe`` (``src/ops/moe.cc:20-44``: gate -> topk ->
+group_by -> experts -> aggregate).
+
+TPU-native: ragged expert batches are illegal under XLA's static shapes, so
+``group_by`` becomes *fixed-capacity dispatch*: each expert receives
+``capacity = ceil(alpha * k * tokens / n)`` rows, selected by
+position-in-expert prefix sums; overflow tokens drop (GShard/Switch
+semantics — the reference's capacity-bounded scatter drops the same way).
+Dispatch/combine are one-hot einsums so they ride the MXU and shard cleanly
+over an ``expert`` mesh axis; autodiff derives the router backward that the
+reference hand-writes (``aggregate.cu`` backward kernels).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.fftype import DataType, OperatorType
+from flexflow_tpu.ops.base import OpContext, OpDef, ShapeDtype, register_op
+from flexflow_tpu.tensor import Layer
+
+
+def expert_capacity(tokens: int, n_experts: int, k: int, alpha: float) -> int:
+    """Per-expert row budget — the reference's ``alpha`` capacity factor
+    (``src/ops/group_by.cc`` ctor arg)."""
+    return max(1, int(math.ceil(alpha * k * tokens / n_experts)))
+
+
+def make_dispatch(
+    assign: jax.Array, n_experts: int, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Dispatch mask from top-k assignments.
+
+    assign: int32 (tokens, k).
+    Returns:
+      dispatch (tokens, n_experts, capacity) float 0/1 — summed over slots,
+      pos (tokens, k) position of each slot within its expert,
+      within (tokens, k) bool — slot survived the capacity cut.
+    """
+    tokens, k = assign.shape
+    onehot = jax.nn.one_hot(assign, n_experts, dtype=jnp.int32)  # (t,k,e)
+    flat = onehot.reshape(tokens * k, n_experts)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat  # exclusive prefix count
+    pos = (pos_flat * flat).sum(-1).reshape(tokens, k)
+    within = pos < capacity
+    eoh = jax.nn.one_hot(assign, n_experts, dtype=jnp.float32)  # (t,k,e)
+    poh = jax.nn.one_hot(jnp.minimum(pos, capacity - 1), capacity, dtype=jnp.float32)
+    mask = within[..., None, None].astype(jnp.float32) * eoh[..., :, None] * poh[..., None, :]
+    dispatch = mask.sum(axis=1)  # (tokens, n_experts, capacity)
+    return dispatch, pos, within
+
+
+class GroupBy(OpDef):
+    """Inputs: data (tokens, d), assign int32 (tokens, k).
+    Outputs: n_experts tensors of (capacity, d) — fixed-capacity analog of
+    the reference's per-expert ragged outputs (``group_by.cc``)."""
+
+    op_type = OperatorType.GROUP_BY
+
+    def _cap(self, layer: Layer) -> int:
+        data, assign = layer.inputs[:2]
+        return expert_capacity(
+            data.shape[0],
+            layer.attrs["n_experts"],
+            assign.shape[-1],
+            layer.attrs.get("alpha", 1.0),
+        )
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        data = layer.inputs[0]
+        n = layer.attrs["n_experts"]
+        cap = self._cap(layer)
+        return [((cap, data.shape[1]), data.dtype) for _ in range(n)]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        data, assign = inputs[:2]
+        n = layer.attrs["n_experts"]
+        cap = self._cap(layer)
+        dispatch, _, _ = make_dispatch(assign, n, cap)
+        grouped = jnp.einsum("tec,td->ecd", dispatch, data.astype(jnp.float32))
+        grouped = grouped.astype(data.dtype)
+        return [grouped[e] for e in range(n)]
+
+    def flops(self, layer: Layer) -> float:
+        data = layer.inputs[0]
+        n = layer.attrs["n_experts"]
+        return 2.0 * data.shape[0] * n * self._cap(layer) * data.shape[1]
+
+
+class Aggregate(OpDef):
+    """Weighted combine of expert outputs back to token order.
+
+    Reference signature (``FFModel::aggregate``, ``model.h:528-533``):
+    inputs = [gate_preds (t,k), gate_assign (t,k), true_gate_assign (t,k),
+    full_gate_grads (t,n), exp_pred_1..n (cap,d)]; attr ``lambda_bal`` is
+    the load-balancing aux-loss weight (``aggregate.cc``).  The aux loss is
+    exposed via :meth:`aux_loss` and added by the model's loss assembly.
+    """
+
+    op_type = OperatorType.AGGREGATE
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        gate_preds = layer.inputs[0]
+        exp0 = layer.inputs[4]
+        return [((gate_preds.shape[0], exp0.shape[-1]), exp0.dtype)]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        n = layer.attrs["n"]
+        gate_preds, gate_assign = inputs[0], inputs[1]
+        experts = jnp.stack(inputs[4 : 4 + n], axis=0)  # (n, cap, d)
+        cap = experts.shape[1]
+        dispatch, _, within = make_dispatch(gate_assign, n, cap)
+        gates = (gate_preds * within.astype(gate_preds.dtype)).astype(jnp.float32)
+        eoh = jax.nn.one_hot(gate_assign, n, dtype=jnp.float32)  # (t,k,e)
+        w_te = jnp.einsum("tk,tke->te", gates, eoh)  # (tokens, n)
+        out = jnp.einsum("tec,te,ecd->td", dispatch, w_te, experts.astype(jnp.float32))
+        return [out.astype(experts.dtype)]
+
+    @staticmethod
+    def aux_loss(gate_probs: jax.Array, assign: jax.Array, n_experts: int) -> jax.Array:
+        """Switch-style load-balance loss ~ reference ``lambda_bal`` router
+        loss in ``aggregate.cu`` backward: n * sum_e f_e * P_e."""
+        eoh = jax.nn.one_hot(assign[:, 0], n_experts, dtype=jnp.float32)
+        frac = eoh.mean(axis=0)
+        prob = gate_probs.mean(axis=0) if gate_probs.shape[-1] == n_experts else frac
+        return n_experts * jnp.sum(frac * prob)
+
+
+class AggregateSpec(Aggregate):
+    """Speculative variant (``src/ops/aggregate_spec.cc``): identical
+    combine math; the reference differs only in backward label-grad routing
+    (``model.cc:2875`` repl_labels interplay), which autodiff subsumes."""
+
+    op_type = OperatorType.AGGREGATE_SPEC
+
+
+register_op(GroupBy())
+register_op(Aggregate())
+register_op(AggregateSpec())

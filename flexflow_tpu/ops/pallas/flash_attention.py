@@ -1,0 +1,130 @@
+"""Pallas TPU flash attention (online-softmax tiling).
+
+Replaces the reference's cuDNN attention core
+(``cudnnMultiHeadAttnForward``, ``src/ops/attention.cu:35``) with an
+O(seq) -memory MXU-tiled kernel: Q blocks stream over K/V blocks keeping a
+running (max, sum) pair, so the (Sq, Sk) score matrix never materializes in
+HBM.  Backward currently recomputes attention via the jnp path inside a
+custom VJP (numerically identical, one extra forward of FLOPs — the
+classic flash-attention trade); a dedicated Pallas backward is a planned
+optimization.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, sq: int, sk: int, causal: bool, sm_scale: float):
+    # q_ref: (block_q, d); k_ref/v_ref: (sk, d); o_ref: (block_q, d)
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q_idx = pl.program_id(1)
+    q = q_ref[:] * sm_scale
+
+    def body(carry, kb):
+        acc, m_prev, l_prev = carry
+        k = jax.lax.dynamic_slice(k_ref[:], (kb * block_k, 0), (block_k, d))
+        v = jax.lax.dynamic_slice(v_ref[:], (kb * block_k, 0), (block_k, d))
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            # offset by sk-sq so query i attends keys <= i + (sk - sq),
+            # matching _sdpa_ref's tril(k=sk-sq) (decoder cross-offsets)
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos + (sk - sq) >= k_pos, s, NEG_INF)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        return (acc, m_new, l_new), None
+
+    n_kb = sk // block_k
+    if causal:
+        # only iterate blocks that can contain unmasked entries (account for
+        # the sk-sq diagonal offset)
+        last_k = (q_idx + 1) * block_q + (sk - sq)
+        n_kb_eff = jnp.minimum(n_kb, (last_k + block_k - 1) // block_k)
+    else:
+        n_kb_eff = n_kb
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+
+    def scan_body(kb, carry):
+        new_carry, _ = body(carry, kb)
+        return new_carry
+
+    acc, m, l = jax.lax.fori_loop(0, n_kb_eff, scan_body, (acc0, m0, l0))
+    o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    sm_scale = 1.0 / math.sqrt(d)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_k=block_k, sq=sq, sk=sk, causal=causal, sm_scale=sm_scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d)
+
+
+def _sdpa_ref(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / math.sqrt(d)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(
+    q, k, v, causal: bool = False, block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K
+):
+    """(B, H, S, D) attention. Requires S % block == 0, D % 128 == 0."""
+    return _flash_fwd(q, k, v, causal, block_q, block_k)
+
+
+def _fwd_rule(q, k, v, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _bwd_rule(causal, block_q, block_k, res, do):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _sdpa_ref(q, k, v, causal), q, k, v)
+    return vjp(do)
+
+
+flash_attention.defvjp(_fwd_rule, _bwd_rule)

@@ -1,0 +1,158 @@
+"""Shape/manipulation ops: Concat, Split, Reshape, Transpose, Reverse,
+Reduce(sum/mean), TopK, NoOp/Input.
+
+Reference: ``src/ops/{concat,split,reshape,transpose,reverse,reduce,topk,
+noop}.cc`` — all custom copy/reduction CUDA kernels.  TPU-native: direct
+XLA ops; copies are usually elided by layout assignment.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.fftype import DataType, OperatorType
+from flexflow_tpu.ops.base import OpContext, OpDef, ShapeDtype, register_op
+from flexflow_tpu.tensor import Layer
+
+
+class Concat(OpDef):
+    op_type = OperatorType.CONCAT
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        axis = layer.attrs["axis"]
+        base = list(layer.inputs[0].shape)
+        base[axis] = sum(t.shape[axis] for t in layer.inputs)
+        return [(tuple(base), layer.inputs[0].dtype)]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        return [jnp.concatenate(inputs, axis=layer.attrs["axis"])]
+
+    def partitionable_dims(self, layer):
+        shape, _ = self.infer(layer)[0]
+        ax = layer.attrs["axis"] % len(shape)
+        return {i: ("sample" if i == 0 else "channel") for i in range(len(shape)) if i != ax}
+
+
+class Split(OpDef):
+    op_type = OperatorType.SPLIT
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        t = layer.inputs[0]
+        axis = layer.attrs["axis"]
+        sizes = layer.attrs["sizes"]
+        assert sum(sizes) == t.shape[axis]
+        outs = []
+        for s in sizes:
+            shape = list(t.shape)
+            shape[axis] = s
+            outs.append((tuple(shape), t.dtype))
+        return outs
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        sizes = layer.attrs["sizes"]
+        idx = list(itertools.accumulate(sizes))[:-1]  # static ints (jit-safe)
+        return list(jnp.split(inputs[0], idx, axis=layer.attrs["axis"]))
+
+
+class Reshape(OpDef):
+    op_type = OperatorType.RESHAPE
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        t = layer.inputs[0]
+        shape = tuple(layer.attrs["shape"])
+        assert math.prod(shape) == math.prod(t.shape), (shape, t.shape)
+        return [(shape, t.dtype)]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        return [inputs[0].reshape(tuple(layer.attrs["shape"]))]
+
+
+class Transpose(OpDef):
+    op_type = OperatorType.TRANSPOSE
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        t = layer.inputs[0]
+        perm = layer.attrs["perm"]
+        return [(tuple(t.shape[p] for p in perm), t.dtype)]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        return [jnp.transpose(inputs[0], layer.attrs["perm"])]
+
+
+class Reverse(OpDef):
+    op_type = OperatorType.REVERSE
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        t = layer.inputs[0]
+        return [(t.shape, t.dtype)]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        return [jnp.flip(inputs[0], axis=layer.attrs["axis"])]
+
+
+class Reduce(OpDef):
+    def __init__(self, op_type: OperatorType) -> None:
+        self.op_type = op_type
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        t = layer.inputs[0]
+        axes = tuple(a % t.ndim for a in layer.attrs["axes"])
+        keepdims = layer.attrs.get("keepdims", False)
+        if keepdims:
+            shape = tuple(1 if i in axes else s for i, s in enumerate(t.shape))
+        else:
+            shape = tuple(s for i, s in enumerate(t.shape) if i not in axes)
+        return [(shape, t.dtype)]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        axes = tuple(layer.attrs["axes"])
+        keepdims = layer.attrs.get("keepdims", False)
+        fn = jnp.sum if self.op_type is OperatorType.REDUCE_SUM else jnp.mean
+        return [fn(inputs[0], axis=axes, keepdims=keepdims)]
+
+
+class TopK(OpDef):
+    """``src/ops/topk.cc`` (custom bitonic/heap kernels, 437/514 LoC):
+    returns (values, int32 indices) along the last dim.  ``lax.top_k``
+    lowers to an efficient TPU sort."""
+
+    op_type = OperatorType.TOPK
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        t = layer.inputs[0]
+        k = layer.attrs["k"]
+        shape = t.shape[:-1] + (k,)
+        return [(shape, t.dtype), (shape, DataType.INT32)]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        v, i = jax.lax.top_k(inputs[0], layer.attrs["k"])
+        return [v, i.astype(jnp.int32)]
+
+
+class NoOp(OpDef):
+    """PCG source nodes — ``src/ops/noop.cc`` (Input/Weight placeholders)."""
+
+    op_type = OperatorType.NOOP
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        t = layer.inputs[0]
+        return [(t.shape, t.dtype)]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        return [inputs[0]]
+
+
+register_op(Concat())
+register_op(Split())
+register_op(Reshape())
+register_op(Transpose())
+register_op(Reverse())
+register_op(Reduce(OperatorType.REDUCE_SUM))
+register_op(Reduce(OperatorType.REDUCE_MEAN))
+register_op(TopK())
+register_op(NoOp())

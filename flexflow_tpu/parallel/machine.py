@@ -1,0 +1,151 @@
+"""Device mesh abstraction — the TPU-native ``MachineView``.
+
+The reference models device placement as a strided grid of device ids
+(``MachineView``, ``include/flexflow/machine_view.h:14-35``) plus
+``MachineResource`` for search-time resource splitting
+(``machine_view.h:51-96``).  On TPU the physical substrate is a torus of
+chips connected by ICI; the idiomatic representation is a named
+``jax.sharding.Mesh``.  A *strategy* then assigns tensor dims to mesh axes
+instead of enumerating strided device grids.
+
+``MachineMesh`` wraps mesh construction and provides the search-side
+enumeration the reference gets from ``register_all_machine_views``
+(``src/runtime/graph.cc:2329-2360``): on TPU, valid "views" are
+factorizations of the mesh axes, not arbitrary device subsets — arbitrary
+strided subsets would break XLA's SPMD model and ICI locality.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def _factorizations(n: int, k: int) -> List[Tuple[int, ...]]:
+    """All ordered factorizations of ``n`` into ``k`` positive factors."""
+    if k == 1:
+        return [(n,)]
+    out = []
+    for d in range(1, n + 1):
+        if n % d == 0:
+            for rest in _factorizations(n // d, k - 1):
+                out.append((d,) + rest)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class MachineMesh:
+    """A named logical mesh over the available devices.
+
+    Axis-name conventions used throughout the framework:
+      * ``data``  — batch/sample axis (DP)
+      * ``model`` — tensor-parallel axis (TP / attribute / parameter parallel)
+      * ``seq``   — sequence-parallel axis (ring attention / Ulysses)
+      * ``expert``— expert-parallel axis (MoE)
+    A strategy may use any subset; unused axes have size 1.
+    """
+
+    shape: Tuple[int, ...]
+    axis_names: Tuple[str, ...]
+
+    def __post_init__(self) -> None:
+        assert len(self.shape) == len(self.axis_names)
+        assert all(s >= 1 for s in self.shape)
+
+    @property
+    def size(self) -> int:
+        return math.prod(self.shape)
+
+    def axis_size(self, name: str) -> int:
+        if name not in self.axis_names:
+            return 1
+        return self.shape[self.axis_names.index(name)]
+
+    def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        """Materialize a ``jax.sharding.Mesh``.
+
+        Device order follows ``jax.devices()`` which on TPU already respects
+        torus locality for the default mesh creation; for multi-host meshes
+        callers should prefer :func:`build_hybrid` so the DCN axis maps to
+        the process dimension.
+        """
+        if devices is None:
+            devices = jax.devices()
+        assert len(devices) >= self.size, (
+            f"mesh {self.shape} needs {self.size} devices, have {len(devices)}"
+        )
+        arr = np.asarray(devices[: self.size]).reshape(self.shape)
+        return Mesh(arr, self.axis_names)
+
+    def build_hybrid(self, dcn_axis: str = "data") -> Mesh:
+        """Multi-host mesh: ``dcn_axis`` spans hosts (DCN), others ride ICI.
+
+        Replaces the reference's GASNet/NCCL split (`MULTI-NODE.md`,
+        ``src/runtime/model.cc:3129-3167``): one mesh, XLA routes collectives
+        over ICI within a slice and DCN across slices.
+        """
+        from jax.experimental import mesh_utils
+
+        idx = self.axis_names.index(dcn_axis)
+        n_proc = jax.process_count()
+        if n_proc == 1:
+            return self.build()
+        ici = list(self.shape)
+        dcn = [1] * len(self.shape)
+        assert self.shape[idx] % n_proc == 0
+        ici[idx] = self.shape[idx] // n_proc
+        dcn[idx] = n_proc
+        devs = mesh_utils.create_hybrid_device_mesh(tuple(ici), tuple(dcn))
+        return Mesh(devs, self.axis_names)
+
+    # --- search-side enumeration ------------------------------------------
+    def enumerate_views(self, max_axes: int = 2) -> List["MachineMesh"]:
+        """Enumerate candidate logical meshes over the same device count.
+
+        TPU analog of ``register_all_machine_views``
+        (``src/runtime/graph.cc:2329-2360``), which registers every
+        1-D strided view.  Here a "view" is an assignment of the total chip
+        count to (data, model[, seq, expert]) axis sizes; the search explores
+        these instead of strided device grids so every candidate is
+        realizable as a GSPMD mesh with ICI-contiguous axes.
+        """
+        names = self.axis_names[: max_axes + 2]
+        out = []
+        for f in _factorizations(self.size, len(names)):
+            out.append(MachineMesh(shape=f, axis_names=names))
+        return out
+
+    def split(self, axis: str) -> Tuple["MachineMesh", "MachineMesh"]:
+        """Halve the mesh along ``axis`` — the torus-aware analog of
+        ``MachineResource`` halving in the DP's horizontal split
+        (``src/runtime/graph.cc:267+``).  Splitting along a mesh axis keeps
+        both halves ICI-contiguous; splitting arbitrary device subsets (as
+        the reference can) would not be lowerable to GSPMD.
+        """
+        idx = self.axis_names.index(axis)
+        assert self.shape[idx] % 2 == 0, f"axis {axis} not splittable"
+        half = list(self.shape)
+        half[idx] //= 2
+        m = MachineMesh(shape=tuple(half), axis_names=self.axis_names)
+        return m, m
+
+    def hash(self) -> int:
+        return hash((self.shape, self.axis_names))
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{n}={s}" for n, s in zip(self.axis_names, self.shape))
+        return f"MachineMesh({inner})"
+
+
+def default_mesh(num_devices: Optional[int] = None, data_parallel_only: bool = True) -> MachineMesh:
+    """Default all-data-parallel mesh (reference
+    ``get_basic_data_parallel_config``, ``include/flexflow/model.h:250``)."""
+    n = num_devices if num_devices is not None else len(jax.devices())
+    if data_parallel_only:
+        return MachineMesh(shape=(n, 1), axis_names=("data", "model"))
+    return MachineMesh(shape=(n, 1), axis_names=("data", "model"))

@@ -1,0 +1,171 @@
+"""Sharded-tensor metadata: the TPU-native ``ParallelDim``/``ParallelTensor``.
+
+Reference model (``include/flexflow/parallel_tensor.h:36-198``): every tensor
+dim carries ``{size, degree, parallel_idx, is_replica_dim}``; replication is
+expressed as *extra* replica dims; the physical placement is a Legion region
+partition driven by a ``MachineView``.
+
+TPU-native re-design: a tensor's distribution is a :class:`TensorSharding` —
+per-logical-dim mesh-axis assignments (== ``jax.sharding.PartitionSpec``)
+plus a set of *partial* axes marking pending reductions.  There are no
+replica dims: an axis absent from the spec is a replication axis, and a
+"partial-sum over axis a" marker plays the role the reference's replica-dim +
+``Reduction`` op pair plays (``src/parallel_ops/reduction.cc``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple, Union
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from flexflow_tpu.parallel.machine import MachineMesh
+
+AxisSpec = Union[None, str, Tuple[str, ...]]
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelDim:
+    """Per-dim sharding record (reference ``parallel_tensor.h:36-71``).
+
+    ``degree`` is derived from the mesh axes assigned to the dim;
+    ``is_replica_dim`` has no analog (see module docstring).
+    """
+
+    size: int
+    axes: Tuple[str, ...] = ()
+
+    def degree(self, mesh: MachineMesh) -> int:
+        d = 1
+        for a in self.axes:
+            d *= mesh.axis_size(a)
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSharding:
+    """Distribution of one logical tensor over a :class:`MachineMesh`.
+
+    * ``spec[i]`` — mesh axes sharding logical dim ``i`` (None = replicated
+      along all unlisted axes).
+    * ``partial_axes`` — mesh axes along which this value is a *partial sum*
+      (the producer computed per-shard contributions that still need a
+      reduction).  Equivalent to the reference's replica-dim awaiting a
+      ``Reduction`` parallel op (``src/parallel_ops/reduction.cc``).
+    """
+
+    spec: Tuple[AxisSpec, ...]
+    partial_axes: Tuple[str, ...] = ()
+
+    @staticmethod
+    def replicated(ndim: int) -> "TensorSharding":
+        return TensorSharding(spec=(None,) * ndim)
+
+    @staticmethod
+    def data_parallel(ndim: int, axis: str = "data", batch_dim: int = 0) -> "TensorSharding":
+        spec = [None] * ndim
+        spec[batch_dim] = axis
+        return TensorSharding(spec=tuple(spec))
+
+    def partition_spec(self) -> PartitionSpec:
+        return PartitionSpec(*self.spec)
+
+    def named_sharding(self, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.partition_spec())
+
+    def dim_degree(self, dim: int, mesh: MachineMesh) -> int:
+        ax = self.spec[dim]
+        if ax is None:
+            return 1
+        if isinstance(ax, str):
+            return mesh.axis_size(ax)
+        d = 1
+        for a in ax:
+            d *= mesh.axis_size(a)
+        return d
+
+    def axes_of(self, dim: int) -> Tuple[str, ...]:
+        ax = self.spec[dim]
+        if ax is None:
+            return ()
+        if isinstance(ax, str):
+            return (ax,)
+        return tuple(ax)
+
+    def used_axes(self) -> Tuple[str, ...]:
+        out = []
+        for i in range(len(self.spec)):
+            out.extend(self.axes_of(i))
+        out.extend(self.partial_axes)
+        return tuple(out)
+
+    def total_degree(self, mesh: MachineMesh) -> int:
+        d = 1
+        for i in range(len(self.spec)):
+            d *= self.dim_degree(i, mesh)
+        return d
+
+    def is_valid(self, shape: Tuple[int, ...], mesh: MachineMesh) -> bool:
+        """A dim must divide evenly by its total sharding degree, and no mesh
+        axis may appear twice (reference ``update_parallel_ids`` validity,
+        ``parallel_tensor.h:163`` / ``ParallelTensorShape::is_valid``)."""
+        if len(self.spec) != len(shape):
+            return False
+        seen = set()
+        for a in self.used_axes():
+            if a in seen:
+                return False
+            seen.add(a)
+        for i, s in enumerate(shape):
+            d = self.dim_degree(i, mesh)
+            if d > 1 and s % d != 0:
+                return False
+        return True
+
+    # --- the parallel-op vocabulary as spec algebra -----------------------
+    # Each reference parallel op (src/parallel_ops/*) is a pure function
+    # TensorSharding -> TensorSharding; XLA emits the matching ICI collective
+    # when the constraint changes inside the jitted program.
+
+    def repartition(self, dim: int, axis: str) -> "TensorSharding":
+        """``Repartition``: shard dim by one more mesh axis
+        (``src/parallel_ops/partition.cc``) — lowers to slice/all-to-all."""
+        spec = list(self.spec)
+        spec[dim] = self.axes_of(dim) + (axis,) if self.axes_of(dim) else axis
+        return TensorSharding(spec=tuple(spec), partial_axes=self.partial_axes)
+
+    def combine(self, dim: int) -> "TensorSharding":
+        """``Combine``: unshard a dim (``src/parallel_ops/combine.cc``) —
+        lowers to all-gather along the removed axes."""
+        spec = list(self.spec)
+        spec[dim] = None
+        return TensorSharding(spec=tuple(spec), partial_axes=self.partial_axes)
+
+    def replicate(self) -> "TensorSharding":
+        """``Replicate`` (``src/parallel_ops/replicate.cc``): identity on the
+        spec — replication over an axis just means not using it.  The bwd
+        direction (sum of replica grads, ``replicate_kernels.cu:36-57``) is
+        produced automatically by jax autodiff (psum)."""
+        return self
+
+    def reduce(self, axis: str) -> "TensorSharding":
+        """``Reduction`` (``src/parallel_ops/reduction.cc``): resolve a
+        partial-sum axis — lowers to all-reduce (or reduce-scatter if the
+        result is simultaneously repartitioned)."""
+        assert axis in self.partial_axes, f"{axis} not partial in {self}"
+        return TensorSharding(
+            spec=self.spec,
+            partial_axes=tuple(a for a in self.partial_axes if a != axis),
+        )
+
+    def with_partial(self, axis: str) -> "TensorSharding":
+        return TensorSharding(spec=self.spec, partial_axes=self.partial_axes + (axis,))
+
+    def __repr__(self) -> str:
+        parts = ",".join(
+            "*" if a is None else "/".join(self.axes_of(i))
+            for i, a in enumerate(self.spec)
+        )
+        p = f" partial={self.partial_axes}" if self.partial_axes else ""
+        return f"Sharding[{parts}{p}]"

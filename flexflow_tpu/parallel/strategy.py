@@ -1,0 +1,185 @@
+"""Parallelization strategy: per-op sharding assignment.
+
+Reference: a strategy is a map op -> ``MachineView`` picked by Unity search
+(``optimal_views``, ``src/runtime/graph.cc:2046-2161``) and realized as
+Legion partitions + parallel-op insertions (``src/runtime/model.cc:2921``).
+
+TPU-native: a strategy is a map op -> :class:`OpSharding` over one
+:class:`MachineMesh`; realization is ``with_sharding_constraint`` on op
+outputs plus ``NamedSharding`` on weights — GSPMD inserts the collectives
+the reference's parallel ops performed.  Strategies serialize to JSON for
+``--export-strategy`` / ``--import-strategy`` parity
+(``src/runtime/model.cc:3609-3618``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Tuple
+
+from jax.sharding import PartitionSpec
+
+from flexflow_tpu.fftype import OperatorType
+from flexflow_tpu.ops.base import WeightSpec, get_op_def
+from flexflow_tpu.parallel.machine import MachineMesh
+from flexflow_tpu.parallel.spec import TensorSharding
+from flexflow_tpu.tensor import Layer
+
+
+@dataclasses.dataclass
+class OpSharding:
+    """Sharding decision for one PCG node.
+
+    ``output`` — sharding of each output tensor.
+    ``weights`` — per-weight-name mesh-axis assignment (dim -> axes).
+    """
+
+    output: List[TensorSharding]
+    weights: Dict[str, TensorSharding] = dataclasses.field(default_factory=dict)
+
+
+class Strategy:
+    def __init__(self, mesh: MachineMesh) -> None:
+        self.mesh = mesh
+        self.ops: Dict[int, OpSharding] = {}  # layer_guid -> OpSharding
+
+    def op_sharding(self, layer: Layer) -> Optional[OpSharding]:
+        return self.ops.get(int(layer.layer_guid))
+
+    def output_pspec(self, layer: Layer, idx: int = 0) -> PartitionSpec:
+        s = self.op_sharding(layer)
+        if s is None or idx >= len(s.output):
+            return PartitionSpec()
+        return s.output[idx].partition_spec()
+
+    def weight_pspec(self, layer: Layer, wname: str, ndim: int) -> PartitionSpec:
+        s = self.op_sharding(layer)
+        if s is None or wname not in s.weights:
+            return PartitionSpec()
+        return s.weights[wname].partition_spec()
+
+    # --- serialization (--export-strategy parity) -------------------------
+    def to_json(self) -> str:
+        def enc_ts(ts: TensorSharding):
+            return {"spec": [list(ts.axes_of(i)) for i in range(len(ts.spec))],
+                    "partial": list(ts.partial_axes)}
+
+        return json.dumps(
+            {
+                "mesh": {"shape": list(self.mesh.shape), "axes": list(self.mesh.axis_names)},
+                "ops": {
+                    str(guid): {
+                        "output": [enc_ts(t) for t in s.output],
+                        "weights": {k: enc_ts(v) for k, v in s.weights.items()},
+                    }
+                    for guid, s in self.ops.items()
+                },
+            },
+            indent=2,
+        )
+
+    @staticmethod
+    def from_json(text: str) -> "Strategy":
+        d = json.loads(text)
+        mesh = MachineMesh(tuple(d["mesh"]["shape"]), tuple(d["mesh"]["axes"]))
+        st = Strategy(mesh)
+
+        def dec_ts(e) -> TensorSharding:
+            spec = tuple(
+                None if not axes else (axes[0] if len(axes) == 1 else tuple(axes))
+                for axes in e["spec"]
+            )
+            return TensorSharding(spec=spec, partial_axes=tuple(e["partial"]))
+
+        for guid, s in d["ops"].items():
+            st.ops[int(guid)] = OpSharding(
+                output=[dec_ts(t) for t in s["output"]],
+                weights={k: dec_ts(v) for k, v in s["weights"].items()},
+            )
+        return st
+
+
+def data_parallel_strategy(layers: List[Layer], mesh: MachineMesh) -> Strategy:
+    """Default all-DP strategy (reference ``--only-data-parallel`` /
+    ``get_basic_data_parallel_config``, ``model.h:250``): batch dim sharded
+    over the ``data`` axis everywhere it divides, weights replicated."""
+    st = Strategy(mesh)
+    dp = mesh.axis_size("data")
+    for layer in layers:
+        opdef = get_op_def(layer.op_type)
+        outs = opdef.infer(layer)
+        shardings = []
+        pdims = opdef.partitionable_dims(layer)
+        for shape, _ in outs:
+            spec: List = [None] * len(shape)
+            if (
+                dp > 1
+                and shape
+                and 0 in pdims
+                and pdims[0] == "sample"
+                and shape[0] % dp == 0
+            ):
+                spec[0] = "data"
+            shardings.append(TensorSharding(spec=tuple(spec)))
+        st.ops[int(layer.layer_guid)] = OpSharding(output=shardings, weights={})
+    return st
+
+
+def tensor_parallel_strategy(
+    layers: List[Layer],
+    mesh: MachineMesh,
+    tp_axis: str = "model",
+    dp_axis: str = "data",
+) -> Strategy:
+    """Megatron-style hand strategy: shard every TP-able weight along
+    ``tp_axis`` (linear out-dim, attention heads, embedding vocab) and the
+    batch along ``dp_axis``.  Mirrors what Unity finds for transformers via
+    ``create_partition_linear_combine``/``create_partition_attention_combine``
+    xfers (``substitution.cc:1769-1820``); useful as a baseline and as the
+    search's warm start."""
+    st = data_parallel_strategy(layers, mesh)
+    tp = mesh.axis_size(tp_axis)
+    if tp <= 1:
+        return st
+    for layer in layers:
+        opdef = get_op_def(layer.op_type)
+        ws = opdef.weights(layer)
+        if not ws:
+            continue
+        entry = st.ops[int(layer.layer_guid)]
+        if layer.op_type is OperatorType.MULTIHEAD_ATTENTION:
+            h = layer.attrs["num_heads"]
+            if h % tp != 0:
+                continue
+            for w in ws:
+                spec = [None] * len(w.shape)
+                spec[w.tp_dim] = tp_axis
+                entry.weights[w.name] = TensorSharding(spec=tuple(spec))
+            # wo contracts the sharded dim -> output partial-summed; GSPMD
+            # resolves it, output stays DP-sharded.
+            continue
+        if layer.op_type is OperatorType.LINEAR:
+            out_dim = layer.attrs["out_dim"]
+            if out_dim % tp != 0:
+                continue
+            for w in ws:
+                if w.tp_dim is None or w.shape[w.tp_dim] % tp != 0:
+                    continue
+                spec = [None] * len(w.shape)
+                spec[w.tp_dim] = tp_axis
+                entry.weights[w.name] = TensorSharding(spec=tuple(spec))
+            # shard activation channel dim to match out-dim partition
+            outs = opdef.infer(layer)
+            (shape, _) = outs[0]
+            o = entry.output[0]
+            spec = list(o.spec)
+            spec[len(shape) - 1] = tp_axis
+            entry.output[0] = TensorSharding(spec=tuple(spec), partial_axes=o.partial_axes)
+        elif layer.op_type is OperatorType.EMBEDDING:
+            for w in ws:
+                if w.tp_dim is not None and w.shape[w.tp_dim] % tp == 0:
+                    spec = [None] * len(w.shape)
+                    spec[w.tp_dim] = tp_axis
+                    entry.weights[w.name] = TensorSharding(spec=tuple(spec))
+    return st

@@ -1,0 +1,258 @@
+"""Step-program builder and executor.
+
+This is the TPU-native replacement for the reference's execution stack:
+``FFModel::forward/backward/update/zero_gradients`` driving one Legion index
+launch per op per iteration (``src/runtime/model.cc:2409-2474``), the
+FFMapper routing tasks to devices (``src/mapper/mapper.cc``), and Legion
+tracing for replay efficiency (``flexflow_cffi.py:2090-2104``).
+
+Design: the whole training step — forward, loss, backward (autodiff),
+metrics, optimizer update, gradient sync — is ONE jitted SPMD program over
+the strategy's mesh.  Per-op "launches" exist only at trace time; XLA fuses
+and schedules everything (subsuming the reference's ``apply_fusion`` pass,
+``model.cc:2495``, and overlap flags).  Tracing happens once per shape —
+the jit cache is the analog of Legion's trace replay.
+
+Gradient synchronization: none explicit.  Sharded batch + replicated (or
+sharded) weights make GSPMD emit the all-reduce (or reduce-scatter) that
+the reference's NCCL optimizer tasks performed
+(``src/runtime/optimizer_kernel.cu:85-140``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from flexflow_tpu.fftype import LossType, OperatorType
+from flexflow_tpu.loss import get_loss_fn
+from flexflow_tpu.metrics import Metrics
+from flexflow_tpu.ops.base import OpContext, get_op_def
+from flexflow_tpu.optimizer import Optimizer
+from flexflow_tpu.parallel.strategy import Strategy
+from flexflow_tpu.tensor import Layer, Tensor
+
+
+class Executor:
+    """Compiles (layers, strategy, optimizer, loss) into jitted step fns."""
+
+    def __init__(
+        self,
+        layers: List[Layer],
+        graph_inputs: List[Tensor],
+        logits: Tensor,
+        strategy: Strategy,
+        optimizer: Optimizer,
+        loss_type: LossType,
+        metrics: Metrics,
+        seed: int = 0,
+        use_remat: bool = False,
+    ) -> None:
+        self.layers = layers
+        self.graph_inputs = graph_inputs
+        self.logits = logits
+        self.strategy = strategy
+        self.optimizer = optimizer
+        self.loss_type = loss_type
+        self.loss_fn = get_loss_fn(loss_type)
+        self.metrics = metrics
+        self.seed = seed
+        self.use_remat = use_remat
+
+        self.mesh: Optional[Mesh] = None
+        if strategy.mesh.size > 1:
+            self.mesh = strategy.mesh.build()
+
+        # split weight declarations into trainable params vs state
+        self._wspecs: Dict[int, List] = {}
+        for layer in layers:
+            self._wspecs[int(layer.layer_guid)] = get_op_def(layer.op_type).weights(layer)
+
+        self._step_jit = None
+        self._fwd_jit = None
+        self.params: Dict[str, Dict[str, jax.Array]] = {}
+        self.state: Dict[str, Dict[str, jax.Array]] = {}
+        self.opt_state: Any = None
+        self._step_count = 0
+
+    # --- sharding helpers --------------------------------------------------
+    def _constrain(self, x: jax.Array, pspec: PartitionSpec) -> jax.Array:
+        if self.mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, NamedSharding(self.mesh, pspec))
+
+    def _input_pspec(self, t: Tensor) -> PartitionSpec:
+        """Inputs follow the first consumer's batch sharding; labels are
+        co-sharded with the final op (reference label-tensor creation,
+        ``model.cc:3086-3124``)."""
+        if self.strategy.mesh.axis_size("data") > 1 and t.shape[0] % self.strategy.mesh.axis_size("data") == 0:
+            return PartitionSpec("data")
+        return PartitionSpec()
+
+    # --- forward trace -----------------------------------------------------
+    def _forward(
+        self,
+        params: Dict[str, Dict[str, jax.Array]],
+        state: Dict[str, Dict[str, jax.Array]],
+        inputs: Sequence[jax.Array],
+        training: bool,
+        rng: Optional[jax.Array],
+    ):
+        """Trace the PCG in layer order (layers are appended
+        topologically by the builder API, mirroring
+        ``create_operators_from_layers`` order, ``model.cc:2785``)."""
+        values: Dict[int, jax.Array] = {}
+        for t, x in zip(self.graph_inputs, inputs):
+            values[t.guid] = self._constrain(x, self._input_pspec(t))
+
+        aux_losses: List[jax.Array] = []
+        new_state: Dict[str, Dict[str, jax.Array]] = {}
+        for layer in self.layers:
+            opdef = get_op_def(layer.op_type)
+            ins = [values[t.guid] for t in layer.inputs]
+            lp = dict(params.get(layer.name, {}))
+            lp.update(state.get(layer.name, {}))
+            ctx = OpContext(
+                training=training,
+                rng=jax.random.fold_in(rng, hash(layer.name) % (2**31)) if rng is not None else None,
+            )
+            if self.use_remat and layer.op_type in _REMAT_OPS:
+                outs = jax.checkpoint(
+                    lambda p, i, _l=layer, _c=ctx: get_op_def(_l.op_type).forward(_l, p, i, _c)
+                )(lp, ins)
+            else:
+                outs = opdef.forward(layer, lp, ins, ctx)
+            # apply the strategy's sharding constraints on outputs
+            for i, (t, y) in enumerate(zip(layer.outputs, outs)):
+                y = self._constrain(y, self.strategy.output_pspec(layer, i))
+                values[t.guid] = y
+            # stateful ops (BN running stats)
+            if training and hasattr(opdef, "state_update") and state.get(layer.name):
+                new_state[layer.name] = opdef.state_update(layer, lp, ins)
+            # MoE aux (load-balance) loss — reference lambda_bal in aggregate
+            if (
+                layer.op_type in (OperatorType.AGGREGATE, OperatorType.AGGREGATE_SPEC)
+                and layer.attrs.get("lambda_bal", 0.0) > 0.0
+            ):
+                from flexflow_tpu.ops.moe import Aggregate
+
+                # inputs[3] is the full softmax gate (t, n) — see Aggregate
+                # docstring; inputs[0] is only the top-k slice.
+                gate_probs = values[layer.inputs[3].guid]
+                assign = values[layer.inputs[1].guid]
+                aux_losses.append(
+                    layer.attrs["lambda_bal"]
+                    * Aggregate.aux_loss(gate_probs, assign, layer.attrs["n"])
+                )
+        # carry over unchanged state
+        for name, s in state.items():
+            if name not in new_state:
+                new_state[name] = s
+        return values[self.logits.guid], new_state, aux_losses
+
+    # --- param init --------------------------------------------------------
+    def init_params(self, key: Optional[jax.Array] = None) -> None:
+        """Sharded on-device init (replaces per-weight init tasks,
+        ``include/flexflow/initializer.h``; weights are born with their
+        final sharding — no host staging)."""
+        if key is None:
+            key = jax.random.PRNGKey(self.seed)
+
+        def make_init(layer, w):
+            pspec = self.strategy.weight_pspec(layer, w.name, len(w.shape))
+
+            def init_fn(k):
+                return w.initializer(k, w.shape, w.dtype.to_jnp())
+
+            if self.mesh is not None:
+                return jax.jit(
+                    init_fn, out_shardings=NamedSharding(self.mesh, pspec)
+                )
+            return jax.jit(init_fn)
+
+        params: Dict[str, Dict[str, jax.Array]] = {}
+        state: Dict[str, Dict[str, jax.Array]] = {}
+        i = 0
+        for layer in self.layers:
+            for w in self._wspecs[int(layer.layer_guid)]:
+                sub = jax.random.fold_in(key, i)
+                i += 1
+                arr = make_init(layer, w)(sub)
+                bucket = params if w.trainable else state
+                bucket.setdefault(layer.name, {})[w.name] = arr
+        self.params = params
+        self.state = state
+        self.opt_state = self.optimizer.init_state(params)
+
+    # --- step building -----------------------------------------------------
+    def _build_step(self):
+        metrics = self.metrics
+        loss_fn = self.loss_fn
+
+        def step(params, state, opt_state, inputs, labels, rng):
+            def objective(p):
+                logits, new_state, aux = self._forward(p, state, inputs, True, rng)
+                loss = loss_fn(logits, labels)
+                for a in aux:
+                    loss = loss + a
+                return loss, (logits, new_state)
+
+            (loss, (logits, new_state)), grads = jax.value_and_grad(
+                objective, has_aux=True
+            )(params)
+            new_params, new_opt = self.optimizer.update(params, grads, opt_state)
+            m = metrics.compute(logits, labels) if metrics else {}
+            return new_params, new_state, new_opt, loss, m
+
+        donate = (0, 1, 2)
+        return jax.jit(step, donate_argnums=donate)
+
+    def _build_fwd(self):
+        def fwd(params, state, inputs):
+            logits, _, _ = self._forward(params, state, inputs, False, None)
+            return logits
+
+        return jax.jit(fwd)
+
+    # --- public API --------------------------------------------------------
+    def train_step(self, inputs: Sequence[Any], labels: Any) -> Tuple[float, Dict[str, float]]:
+        if self._step_jit is None:
+            self._step_jit = self._build_step()
+        inputs = [self._place(x, self._input_pspec(t)) for x, t in zip(inputs, self.graph_inputs)]
+        labels = self._place(labels, self._label_pspec())
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._step_count)
+        self._step_count += 1
+        self.params, self.state, self.opt_state, loss, m = self._step_jit(
+            self.params, self.state, self.opt_state, inputs, labels, rng
+        )
+        return loss, m
+
+    def forward(self, inputs: Sequence[Any]) -> jax.Array:
+        if self._fwd_jit is None:
+            self._fwd_jit = self._build_fwd()
+        inputs = [self._place(x, self._input_pspec(t)) for x, t in zip(inputs, self.graph_inputs)]
+        return self._fwd_jit(self.params, self.state, inputs)
+
+    def _label_pspec(self) -> PartitionSpec:
+        if self.strategy.mesh.axis_size("data") > 1:
+            return PartitionSpec("data")
+        return PartitionSpec()
+
+    def _place(self, x: Any, pspec: PartitionSpec):
+        if isinstance(x, jax.Array) and x.committed:
+            return x
+        arr = np.asarray(x)
+        if self.mesh is not None:
+            ns = NamedSharding(self.mesh, pspec)
+            if jax.process_count() > 1:
+                return jax.make_array_from_process_local_data(ns, arr)
+            return jax.device_put(arr, ns)
+        return jnp.asarray(arr)
+
+
+_REMAT_OPS = frozenset({OperatorType.MULTIHEAD_ATTENTION})

@@ -1,0 +1,11 @@
+"""Auto-parallelization search (Unity, SURVEY §2.2).
+
+``unity_search`` is the entry the model's ``compile()`` calls when
+``--search-budget`` is set (reference ``GRAPH_OPTIMIZE_TASK_ID`` launch,
+``src/runtime/model.cc:2824``).  The full substitution-based search lives in
+``flexflow_tpu.search.optimizer``; this package re-exports it.
+"""
+
+from flexflow_tpu.search.optimizer import unity_search
+
+__all__ = ["unity_search"]

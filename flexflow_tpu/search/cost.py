@@ -1,0 +1,136 @@
+"""Analytic cost model (first tier of the simulator, SURVEY §2.2 S3).
+
+The reference costs a strategy by running kernels on device
+(``Simulator::measure_operator_cost``, ``src/runtime/simulator.cc:537``) +
+analytic transfer estimates (``estimate_xfer_cost``, ``graph.cc:1438``).
+This module is the *analytic* tier: roofline per-op compute time from
+FLOPs/HBM-bytes and collective time from an ICI machine model.  The
+measured tier (compile-and-time sub-programs, the true analog of the
+CUDA-event micro-profiler ``model.cu:38``) plugs in via
+``flexflow_tpu.search.simulator`` and overrides these numbers when
+available.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+from flexflow_tpu.ops.base import get_op_def
+from flexflow_tpu.parallel.machine import MachineMesh
+from flexflow_tpu.parallel.strategy import Strategy
+from flexflow_tpu.tensor import Layer
+
+
+class TPUMachineModel:
+    """ICI/DCN analog of the reference's machine models
+    (``SimpleMachineModel``/``EnhancedMachineModel``,
+    ``include/flexflow/simulator.h:212-605``; config file
+    ``machine_config_example``).
+
+    Default numbers approximate a v5p chip; override via constructor for
+    other generations (the reference reads a config file —
+    ``--machine-model-file`` maps to :func:`from_file`).
+    """
+
+    def __init__(
+        self,
+        peak_flops: float = 4.59e14,  # bf16 FLOP/s per chip
+        hbm_bw: float = 2.765e12,  # bytes/s
+        ici_bw: float = 9e10,  # bytes/s per link direction
+        dcn_bw: float = 6.25e9,  # bytes/s per host
+        latency: float = 1e-6,  # per-collective latency (s)
+    ) -> None:
+        self.peak_flops = peak_flops
+        self.hbm_bw = hbm_bw
+        self.ici_bw = ici_bw
+        self.dcn_bw = dcn_bw
+        self.latency = latency
+
+    @staticmethod
+    def from_file(path: str) -> "TPUMachineModel":
+        import json
+
+        with open(path) as f:
+            d = json.load(f)
+        return TPUMachineModel(**d)
+
+    # --- collective time estimates (ring algorithms over ICI) -------------
+    def all_reduce(self, nbytes: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        return self.latency * math.log2(max(2, n)) + 2 * nbytes * (n - 1) / (n * self.ici_bw)
+
+    def all_gather(self, nbytes_out: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        return self.latency + nbytes_out * (n - 1) / (n * self.ici_bw)
+
+    def reduce_scatter(self, nbytes_in: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        return self.latency + nbytes_in * (n - 1) / (n * self.ici_bw)
+
+    def all_to_all(self, nbytes: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        return self.latency + nbytes * (n - 1) / (n * self.ici_bw)
+
+
+def op_compute_time(
+    layer: Layer, degree: int, machine: TPUMachineModel, mxu_util: float = 0.5
+) -> float:
+    """Roofline: max(flops-bound, bandwidth-bound), fwd+bwd (bwd ≈ 2×fwd
+    flops for matmul-type ops — the reference measures both separately)."""
+    opdef = get_op_def(layer.op_type)
+    flops = 3.0 * opdef.flops(layer) / max(1, degree)
+    mem = 3.0 * opdef.mem_bytes(layer) / max(1, degree)
+    return max(flops / (machine.peak_flops * mxu_util), mem / machine.hbm_bw)
+
+
+def estimate_strategy_cost(
+    layers: List[Layer],
+    strategy: Strategy,
+    machine: Optional[TPUMachineModel] = None,
+) -> float:
+    """Per-step time estimate for a whole strategy (compute + grad sync +
+    activation resharding).  Pure function of the layer graph + strategy —
+    deterministic and unit-testable (the gap SURVEY §4.7 notes in the
+    reference)."""
+    m = machine or TPUMachineModel()
+    mesh = strategy.mesh
+    total = 0.0
+    dp = mesh.axis_size("data")
+    for layer in layers:
+        os_ = strategy.op_sharding(layer)
+        degree = os_.output[0].total_degree(mesh) if os_ and os_.output else 1
+        total += op_compute_time(layer, degree, m)
+        # weight-grad all-reduce over the data axis for replicated weights
+        opdef = get_op_def(layer.op_type)
+        for w in opdef.weights(layer):
+            wb = math.prod(w.shape) * 4
+            ws = os_.weights.get(w.name) if os_ else None
+            shard = ws.total_degree(mesh) if ws else 1
+            if dp > 1:
+                total += m.all_reduce(wb / shard, dp)
+        # resharding cost: if an input's producer sharding != what this op
+        # consumes, XLA inserts a collective; approximate with all-gather of
+        # the input when specs differ.
+        for t in layer.inputs:
+            if t.owner_layer is None:
+                continue
+            prod = strategy.op_sharding(t.owner_layer)
+            if prod is None or os_ is None:
+                continue
+            p_spec = prod.output[t.owner_idx].spec if t.owner_idx < len(prod.output) else None
+            # consumer "wants" its own output batch sharding on inputs; a
+            # channel-sharded producer feeding a replicated consumer costs
+            # an all-gather of the channel shards.
+            if p_spec is None:
+                continue
+            p_model = any("model" in prodspec_axes for prodspec_axes in [prod.output[t.owner_idx].axes_of(i) for i in range(len(p_spec))])
+            consumes_model = layer.op_type.value in ("linear", "multihead_attention")
+            if p_model and not consumes_model:
+                nbytes = math.prod(t.shape) * 4
+                total += m.all_gather(nbytes, mesh.axis_size("model"))
+    return total

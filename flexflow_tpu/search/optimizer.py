@@ -1,0 +1,48 @@
+"""Strategy search entry point.
+
+First-cut implementation: enumerate candidate logical meshes
+(factorizations of the chip count over (data, model) axes — the TPU analog
+of ``register_all_machine_views``, ``src/runtime/graph.cc:2329``) crossed
+with the strategy generators (pure DP, DP+TP), cost each with the analytic
+cost model, return the argmin.  The substitution-engine search
+(``GraphXfer``/``base_optimize``, ``src/runtime/substitution.cc:2229``)
+extends this by rewriting per-op shardings; see
+``flexflow_tpu/search/substitution.py``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from flexflow_tpu.parallel.machine import MachineMesh
+from flexflow_tpu.parallel.strategy import (
+    Strategy,
+    data_parallel_strategy,
+    tensor_parallel_strategy,
+)
+from flexflow_tpu.search.cost import estimate_strategy_cost
+from flexflow_tpu.tensor import Layer
+
+
+def unity_search(
+    layers: List[Layer],
+    mesh: MachineMesh,
+    budget: int = 10,
+    alpha: float = 1.2,
+) -> Strategy:
+    """Pick the cheapest strategy over candidate mesh factorizations.
+
+    ``budget`` bounds the number of candidates costed (reference
+    ``--budget``, ``substitution.cc:2229`` loop bound); ``alpha`` is kept
+    for API parity (pruning threshold) and used once the substitution
+    search is active.
+    """
+    candidates: List[Strategy] = []
+    for view in mesh.enumerate_views(max_axes=0):  # (data, model) factorizations
+        candidates.append(data_parallel_strategy(layers, view))
+        if view.axis_size("model") > 1:
+            candidates.append(tensor_parallel_strategy(layers, view))
+        if len(candidates) >= budget:
+            break
+    best = min(candidates, key=lambda s: estimate_strategy_cost(layers, s))
+    return best

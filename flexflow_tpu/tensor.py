@@ -1,0 +1,107 @@
+"""Symbolic tensors and layers — the user-facing *sequential* graph.
+
+Reference: ``Tensor``/``TensorBase`` (``include/flexflow/tensor.h``) and
+``Layer`` (``include/flexflow/layer.h:10-61``).  User API calls on
+``FFModel`` append ``Layer`` records lazily; nothing executes until
+``compile()`` materializes operators from layers
+(``create_operators_from_layers``, ``src/runtime/model.cc:2785-2801``).
+
+TPU-native twist: a ``Tensor`` never owns device memory — it is a typed
+symbolic handle (shape/dtype/producer).  Physical arrays exist only inside
+the jitted step program; ``get_weights``/``set_weights`` on the model give
+host access (replacing region attach,
+``include/flexflow/parallel_tensor.h:164-169``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+from flexflow_tpu.fftype import DataType, LayerID, OperatorType
+
+_tensor_guid = itertools.count(1)
+
+
+class Tensor:
+    """Symbolic tensor handle (reference ``TensorBase``).
+
+    ``shape`` excludes any replica dims (which don't exist here — see
+    ``flexflow_tpu/parallel/spec.py``).  The batch dim, when present, is
+    dim 0 by convention (the reference uses Legion's reversed dim order;
+    we use plain row-major logical order throughout).
+    """
+
+    def __init__(
+        self,
+        shape: Tuple[int, ...],
+        dtype: DataType = DataType.FLOAT,
+        owner_layer: Optional["Layer"] = None,
+        owner_idx: int = 0,
+        name: Optional[str] = None,
+    ) -> None:
+        self.guid: int = next(_tensor_guid)
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = dtype
+        self.owner_layer = owner_layer
+        self.owner_idx = owner_idx
+        self.name = name or f"tensor_{self.guid}"
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def __repr__(self) -> str:
+        own = self.owner_layer.name if self.owner_layer else "input"
+        return f"Tensor({self.name}, {self.shape}, {self.dtype.value}, from={own})"
+
+
+class Tensor4D(Tensor):
+    pass
+
+
+class Layer:
+    """One node of the sequential graph (reference ``layer.h:10-61``).
+
+    ``attrs`` holds the op's hashable parameters — the analog of the per-op
+    ``XParams`` structs (e.g. ``include/flexflow/ops/linear_params.h``).
+    """
+
+    def __init__(
+        self,
+        op_type: OperatorType,
+        name: str,
+        inputs: List[Tensor],
+        attrs: Dict[str, Any],
+    ) -> None:
+        self.layer_guid = LayerID()
+        self.op_type = op_type
+        self.name = name
+        self.inputs = list(inputs)
+        self.attrs = dict(attrs)
+        self.outputs: List[Tensor] = []
+
+    def params_key(self) -> Tuple:
+        """Hashable (op-params) key — analog of ``OperatorParameters`` used
+        by the simulator's cost cache (``include/flexflow/simulator.h``)."""
+
+        def _freeze(v):
+            if isinstance(v, dict):
+                return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+            if isinstance(v, (list, tuple)):
+                return tuple(_freeze(x) for x in v)
+            if isinstance(v, (DataType, OperatorType)):
+                return v.value
+            if hasattr(v, "value") and isinstance(getattr(v, "value"), str):
+                return v.value
+            return v
+
+        return (
+            self.op_type.value,
+            tuple(t.shape for t in self.inputs),
+            tuple(t.dtype.value for t in self.inputs),
+            _freeze(self.attrs),
+        )
+
+    def __repr__(self) -> str:
+        return f"Layer({self.op_type.value}:{self.name})"

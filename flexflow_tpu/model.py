@@ -414,6 +414,58 @@ class FFModel:
             [topk_out, topk_idx, topk_idx, gate] + experts, num_exp, lambda_bal
         )
 
+    # ------------------------------------------- parallel ops (SURVEY §2.4)
+    # reference: src/parallel_ops/{partition,combine,replicate,reduction}.cc
+    # exposed on FFModel like the C API's flexflow_model_add_* wrappers.
+    def repartition(
+        self, input: Tensor, dim: int, degree: int, axis: Optional[str] = None,
+        name: Optional[str] = None,
+    ) -> Tensor:
+        """Shard ``dim`` ``degree``-ways (``src/parallel_ops/partition.cc``)."""
+        return self._add_layer(
+            OperatorType.REPARTITION,
+            self._name("repartition", name),
+            [input],
+            dict(dim=dim % input.ndim, degree=degree, axis=axis),
+        )[0]
+
+    def combine(self, input: Tensor, dim: int, degree: int, name: Optional[str] = None) -> Tensor:
+        """Unshard ``dim`` (``src/parallel_ops/combine.cc``) — all-gather."""
+        return self._add_layer(
+            OperatorType.COMBINE,
+            self._name("combine", name),
+            [input],
+            dict(dim=dim % input.ndim, degree=degree),
+        )[0]
+
+    def replicate(self, input: Tensor, degree: int = 1, name: Optional[str] = None) -> Tensor:
+        """Replicate (``src/parallel_ops/replicate.cc``); grad sums replicas."""
+        return self._add_layer(
+            OperatorType.REPLICATE, self._name("replicate", name), [input], dict(degree=degree)
+        )[0]
+
+    def reduction(self, input: Tensor, degree: int = 1, name: Optional[str] = None) -> Tensor:
+        """Sum partial replicas (``src/parallel_ops/reduction.cc``)."""
+        return self._add_layer(
+            OperatorType.REDUCTION, self._name("reduction", name), [input], dict(degree=degree)
+        )[0]
+
+    def fused_parallel_op(
+        self, input: Tensor, ops: Sequence[Tuple[str, Dict[str, Any]]], name: Optional[str] = None
+    ) -> Tensor:
+        """Chained resharding (``src/parallel_ops/fused_parallel_op.cc``);
+        ``ops`` is a list of ``(op_type_value, attrs)`` pairs."""
+        return self._add_layer(
+            OperatorType.FUSED_PARALLEL,
+            self._name("fused_parallel", name),
+            [input],
+            dict(ops=tuple((OperatorType(o).value, dict(a)) for o, a in ops)),
+        )[0]
+
+    def cache(self, input: Tensor, name: Optional[str] = None) -> Tensor:
+        """Cached activations op (``src/ops/cache.cc``); see ops.tensor_ops.Cache."""
+        return self._add_layer(OperatorType.CACHE, self._name("cache", name), [input], {})[0]
+
     # elementwise builders (model.h unary/binary API)
     def add(self, x: Tensor, y: Tensor, name: Optional[str] = None) -> Tensor:
         return self._add_layer(OperatorType.EW_ADD, self._name("add", name), [x, y], {})[0]

@@ -8,6 +8,7 @@ from flexflow_tpu.ops import (  # noqa: F401
     embedding,
     moe,
     norm,
+    parallel_ops,
     tensor_ops,
 )
 from flexflow_tpu.ops.base import OpContext, OpDef, WeightSpec, all_ops, get_op_def

@@ -1,0 +1,197 @@
+"""Parallel ops — the resharding/communication vocabulary (SURVEY §2.4).
+
+Reference: ``src/parallel_ops/{partition,combine,replicate,reduction,
+fused_parallel_op}.cc`` — first-class PCG operators that change tensor
+distribution.  Their device kernels are local copies/sums only
+(``src/parallel_ops/kernels/replicate_kernels.cu:21-57``,
+``reduction_kernels.cu:24-60``); the actual cross-device movement comes from
+Legion region requirements over differently-partitioned regions.
+
+TPU-native: each op lowers to an *identity* computation plus a sharding
+constraint transition; XLA/GSPMD emits the matching ICI collective:
+
+  Repartition(dim, degree)  -> slice / all-to-all (dynamic-slice per shard)
+  Combine(dim, degree)      -> all-gather along the removed axes
+  Replicate(degree)         -> broadcast fwd; autodiff makes bwd a psum
+                               (the reference hand-writes that sum,
+                               ``replicate_kernels.cu:36-57``)
+  Reduction(degree)         -> all-reduce / reduce-scatter of partial sums
+  FusedParallelOp           -> composed transition (one collective where
+                               possible, ``fused_parallel_op.cu``)
+
+The sharding algebra itself lives on
+:class:`flexflow_tpu.parallel.spec.TensorSharding`; the executor calls
+:func:`resolve_parallel_sharding` at trace time to turn the op's attrs plus
+the incoming distribution into the outgoing one.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from flexflow_tpu.fftype import OperatorType
+from flexflow_tpu.ops.base import OpContext, OpDef, ShapeDtype, register_op
+from flexflow_tpu.parallel.machine import MachineMesh
+from flexflow_tpu.parallel.spec import TensorSharding
+from flexflow_tpu.tensor import Layer
+
+
+class _IdentityShape(OpDef):
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        t = layer.inputs[0]
+        return [(t.shape, t.dtype)]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        return [inputs[0]]
+
+    def flops(self, layer: Layer) -> float:
+        return 0.0
+
+
+class Repartition(_IdentityShape):
+    """Increase the shard degree of one dim (``src/parallel_ops/partition.cc``).
+
+    attrs: ``dim`` (logical dim), ``degree``, optional ``axis`` (mesh-axis
+    name; resolved against the mesh at trace time when omitted).
+    """
+
+    op_type = OperatorType.REPARTITION
+
+
+class Combine(_IdentityShape):
+    """Decrease the shard degree of one dim (``src/parallel_ops/combine.cc``)
+    — all-gather.  attrs: ``dim``, ``degree``."""
+
+    op_type = OperatorType.COMBINE
+
+
+class Replicate(_IdentityShape):
+    """Add replication (``src/parallel_ops/replicate.cc``).  Under GSPMD,
+    replication over an unused mesh axis is the default state, so forward is
+    pure identity; gradient summation over replicas falls out of autodiff."""
+
+    op_type = OperatorType.REPLICATE
+
+
+class Reduction(_IdentityShape):
+    """Sum away replicas / resolve partial sums
+    (``src/parallel_ops/reduction.cc``).  Inside one SPMD program partial
+    sums are tracked by XLA itself; this op marks the strategy-level point
+    where the reduction must have happened."""
+
+    op_type = OperatorType.REDUCTION
+
+
+class FusedParallelOp(_IdentityShape):
+    """Chain of parallel transitions applied as one op
+    (``src/parallel_ops/fused_parallel_op.cc``).  attrs: ``ops`` — list of
+    ``(op_type_value, attrs_dict)`` applied in order."""
+
+    op_type = OperatorType.FUSED_PARALLEL
+
+
+class _SourceOp(OpDef):
+    """PCG source node (``src/ops/noop.cc`` Input/Weight): no inputs; shape
+    comes from attrs (``shape``/``dtype``) when constructed as a true source,
+    or passes through when wrapped over an existing tensor."""
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        if layer.inputs:
+            t = layer.inputs[0]
+            return [(t.shape, t.dtype)]
+        return [(tuple(layer.attrs["shape"]), layer.attrs["dtype"])]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        assert inputs, "source op has no runtime value to forward"
+        return [inputs[0]]
+
+    def flops(self, layer: Layer) -> float:
+        return 0.0
+
+
+class InputOp(_SourceOp):
+    op_type = OperatorType.INPUT
+
+
+class WeightOp(_SourceOp):
+    op_type = OperatorType.WEIGHT
+
+
+def _pick_axis(
+    mesh: MachineMesh, degree: int, used: tuple, preferred: Optional[str]
+) -> str:
+    """Resolve a degree to a free mesh axis (the analog of the reference
+    binding a parallel op to a MachineView at compile,
+    ``src/runtime/model.cc:2921-2940``)."""
+    if preferred is not None:
+        assert mesh.axis_size(preferred) == degree, (
+            f"axis {preferred} has size {mesh.axis_size(preferred)}, want {degree}"
+        )
+        return preferred
+    for name in mesh.axis_names:
+        if mesh.axis_size(name) == degree and name not in used:
+            return name
+    raise ValueError(
+        f"no free mesh axis of size {degree} in {mesh} (used={used})"
+    )
+
+
+def _apply_one(
+    op_type: OperatorType, attrs: dict, sh: TensorSharding, mesh: MachineMesh
+) -> TensorSharding:
+    if op_type is OperatorType.REPARTITION:
+        axis = _pick_axis(mesh, attrs["degree"], sh.used_axes(), attrs.get("axis"))
+        return sh.repartition(attrs["dim"], axis)
+    if op_type is OperatorType.COMBINE:
+        dim = attrs["dim"]
+        axes = sh.axes_of(dim)
+        degree = attrs.get("degree") or 0
+        if not axes:
+            return sh
+        if degree <= 1 or degree >= sh.dim_degree(dim, mesh):
+            return sh.combine(dim)  # full unshard
+        # partial combine: peel minormost axes until their product == degree
+        # (reference Combine reduces the dim's shard degree BY `degree`,
+        # src/parallel_ops/combine.cc ctor)
+        removed, peel = 1, []
+        for a in reversed(axes):
+            if removed >= degree:
+                break
+            peel.append(a)
+            removed *= mesh.axis_size(a)
+        assert removed == degree, (
+            f"combine degree {degree} is not a suffix product of axes {axes} "
+            f"(sizes {[mesh.axis_size(a) for a in axes]})"
+        )
+        keep = tuple(a for a in axes if a not in peel)
+        spec = list(sh.spec)
+        spec[dim] = None if not keep else (keep[0] if len(keep) == 1 else keep)
+        return TensorSharding(spec=tuple(spec), partial_axes=sh.partial_axes)
+    if op_type is OperatorType.REPLICATE:
+        return sh.replicate()
+    if op_type is OperatorType.REDUCTION:
+        if sh.partial_axes:
+            return sh.reduce(sh.partial_axes[0])
+        return sh
+    raise ValueError(f"not a parallel op: {op_type}")
+
+
+def resolve_parallel_sharding(
+    layer: Layer, in_sharding: TensorSharding, mesh: MachineMesh
+) -> TensorSharding:
+    """Outgoing distribution of a parallel op given the incoming one."""
+    if layer.op_type is OperatorType.FUSED_PARALLEL:
+        sh = in_sharding
+        for op_val, attrs in layer.attrs["ops"]:
+            sh = _apply_one(OperatorType(op_val), attrs, sh, mesh)
+        return sh
+    return _apply_one(layer.op_type, layer.attrs, in_sharding, mesh)
+
+
+register_op(Repartition())
+register_op(Combine())
+register_op(Replicate())
+register_op(Reduction())
+register_op(FusedParallelOp())
+register_op(InputOp())
+register_op(WeightOp())

@@ -147,6 +147,41 @@ class NoOp(OpDef):
         return [inputs[0]]
 
 
+class Cache(OpDef):
+    """Cached activations — ``src/ops/cache.cc`` (~330 LoC + CACHE_UPDATE
+    task, ``include/flexflow/model.h``).  Stores the last batch of its input
+    as non-trainable state each training step; :meth:`score` is the trigger
+    metric (relative L1 drift between cached and current values) consumed by
+    the recompile hooks (``include/flexflow/recompile.h:26-41``) for
+    adaptive-model use cases like MoE expert rebalancing."""
+
+    op_type = OperatorType.CACHE
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        t = layer.inputs[0]
+        return [(t.shape, t.dtype)]
+
+    def weights(self, layer: Layer):
+        from flexflow_tpu.initializer import ZeroInitializer
+        from flexflow_tpu.ops.base import WeightSpec
+
+        t = layer.inputs[0]
+        return [
+            WeightSpec("cached", t.shape, t.dtype, ZeroInitializer(), trainable=False)
+        ]
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        return [inputs[0]]
+
+    def state_update(self, layer, params, inputs):
+        return {"cached": inputs[0]}
+
+    @staticmethod
+    def score(cached: jax.Array, current: jax.Array) -> jax.Array:
+        denom = jnp.maximum(jnp.mean(jnp.abs(current)), 1e-8)
+        return jnp.mean(jnp.abs(current - cached)) / denom
+
+
 register_op(Concat())
 register_op(Split())
 register_op(Reshape())
@@ -156,3 +191,4 @@ register_op(Reduce(OperatorType.REDUCE_SUM))
 register_op(Reduce(OperatorType.REDUCE_MEAN))
 register_op(TopK())
 register_op(NoOp())
+register_op(Cache())

@@ -130,7 +130,14 @@ class TensorSharding:
 
     def repartition(self, dim: int, axis: str) -> "TensorSharding":
         """``Repartition``: shard dim by one more mesh axis
-        (``src/parallel_ops/partition.cc``) — lowers to slice/all-to-all."""
+        (``src/parallel_ops/partition.cc``) — lowers to slice/all-to-all.
+        Idempotent when ``axis`` already shards ``dim`` (the reference's
+        degree-matching no-op case)."""
+        if axis in self.axes_of(dim):
+            return self
+        assert axis not in self.used_axes(), (
+            f"axis {axis} already shards another dim in {self}"
+        )
         spec = list(self.spec)
         spec[dim] = self.axes_of(dim) + (axis,) if self.axes_of(dim) else axis
         return TensorSharding(spec=tuple(spec), partial_axes=self.partial_axes)

@@ -47,12 +47,6 @@ class Strategy:
     def op_sharding(self, layer: Layer) -> Optional[OpSharding]:
         return self.ops.get(int(layer.layer_guid))
 
-    def output_pspec(self, layer: Layer, idx: int = 0) -> PartitionSpec:
-        s = self.op_sharding(layer)
-        if s is None or idx >= len(s.output):
-            return PartitionSpec()
-        return s.output[idx].partition_spec()
-
     def weight_pspec(self, layer: Layer, wname: str, ndim: int) -> PartitionSpec:
         s = self.op_sharding(layer)
         if s is None or wname not in s.weights:
@@ -107,6 +101,10 @@ def data_parallel_strategy(layers: List[Layer], mesh: MachineMesh) -> Strategy:
     st = Strategy(mesh)
     dp = mesh.axis_size("data")
     for layer in layers:
+        if layer.op_type.is_parallel_op:
+            # user-inserted resharding ops derive their distribution from
+            # their input + attrs at trace time (ops/parallel_ops.py)
+            continue
         opdef = get_op_def(layer.op_type)
         outs = opdef.infer(layer)
         shardings = []

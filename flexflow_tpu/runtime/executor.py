@@ -33,7 +33,9 @@ from flexflow_tpu.fftype import LossType, OperatorType
 from flexflow_tpu.loss import get_loss_fn
 from flexflow_tpu.metrics import Metrics
 from flexflow_tpu.ops.base import OpContext, get_op_def
+from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
 from flexflow_tpu.optimizer import Optimizer
+from flexflow_tpu.parallel.spec import TensorSharding
 from flexflow_tpu.parallel.strategy import Strategy
 from flexflow_tpu.tensor import Layer, Tensor
 
@@ -107,8 +109,14 @@ class Executor:
         topologically by the builder API, mirroring
         ``create_operators_from_layers`` order, ``model.cc:2785``)."""
         values: Dict[int, jax.Array] = {}
+        shardings: Dict[int, TensorSharding] = {}
         for t, x in zip(self.graph_inputs, inputs):
-            values[t.guid] = self._constrain(x, self._input_pspec(t))
+            ps = self._input_pspec(t)
+            values[t.guid] = self._constrain(x, ps)
+            spec = tuple(ps)
+            shardings[t.guid] = TensorSharding(
+                spec=spec + (None,) * (t.ndim - len(spec))
+            )
 
         aux_losses: List[jax.Array] = []
         new_state: Dict[str, Dict[str, jax.Array]] = {}
@@ -127,9 +135,26 @@ class Executor:
                 )(lp, ins)
             else:
                 outs = opdef.forward(layer, lp, ins, ctx)
-            # apply the strategy's sharding constraints on outputs
+            # apply sharding constraints on outputs.  Parallel ops derive
+            # their outgoing distribution from the incoming one + attrs (the
+            # resharding vocabulary, SURVEY §2.4); other ops take the
+            # strategy's assignment when one exists.
+            if layer.op_type.is_parallel_op:
+                src = layer.inputs[0]
+                in_sh = shardings.get(src.guid, TensorSharding.replicated(src.ndim))
+                out_sh = resolve_parallel_sharding(layer, in_sh, self.strategy.mesh)
+                t = layer.outputs[0]
+                values[t.guid] = self._constrain(outs[0], out_sh.partition_spec())
+                shardings[t.guid] = out_sh
+                continue
+            op_sh = self.strategy.op_sharding(layer)
             for i, (t, y) in enumerate(zip(layer.outputs, outs)):
-                y = self._constrain(y, self.strategy.output_pspec(layer, i))
+                if op_sh is not None and i < len(op_sh.output):
+                    ts = op_sh.output[i]
+                    y = self._constrain(y, ts.partition_spec())
+                    shardings[t.guid] = ts
+                else:
+                    shardings[t.guid] = TensorSharding.replicated(t.ndim)
                 values[t.guid] = y
             # stateful ops (BN running stats)
             if training and hasattr(opdef, "state_update") and state.get(layer.name):

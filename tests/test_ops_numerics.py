@@ -1,0 +1,423 @@
+"""Per-op numerical alignment vs CPU PyTorch (fwd + grads).
+
+This is the TPU build's analog of the reference's two numeric tiers:
+``tests/ops/test_harness.py`` (per-op dumps vs NumPy/PyTorch, eps=1e-5) and
+``tests/align`` (fwd+bwd closeness vs torch for ~20 ops).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from flexflow_tpu.fftype import (
+    ActiMode,
+    AggrMode,
+    DataType,
+    OperatorType,
+    PoolType,
+)
+from flexflow_tpu.ops.base import OpContext, get_op_def
+from flexflow_tpu.tensor import Layer, Tensor
+
+RTOL, ATOL = 1e-4, 1e-5
+
+
+def make_layer(op_type, attrs, arrays):
+    tensors = [
+        Tensor(a.shape, DataType.from_jnp(a.dtype), name=f"in{i}")
+        for i, a in enumerate(arrays)
+    ]
+    layer = Layer(op_type, "t", tensors, attrs)
+    for i, (s, dt) in enumerate(get_op_def(op_type).infer(layer)):
+        layer.outputs.append(Tensor(s, dt, layer, i))
+    return layer
+
+
+def run_op(op_type, attrs, arrays, params=None, training=False):
+    layer = make_layer(op_type, attrs, arrays)
+    opdef = get_op_def(op_type)
+    ctx = OpContext(training=training, rng=jax.random.PRNGKey(0))
+    p = {k: jnp.asarray(v) for k, v in (params or {}).items()}
+    return opdef.forward(layer, p, [jnp.asarray(a) for a in arrays], ctx)
+
+
+def grads_of(op_type, attrs, arrays, params, wrt_params=True):
+    """d(sum(out))/d(inputs, params) through the jax lowering."""
+    layer = make_layer(op_type, attrs, arrays)
+    opdef = get_op_def(op_type)
+
+    def loss(p, ins):
+        outs = opdef.forward(layer, p, ins, OpContext(training=False))
+        return sum(jnp.sum(o.astype(jnp.float32)) for o in outs if jnp.issubdtype(o.dtype, jnp.floating))
+
+    p = {k: jnp.asarray(v) for k, v in params.items()}
+    ins = [jnp.asarray(a) for a in arrays]
+    if any(not jnp.issubdtype(a.dtype, jnp.inexact) for a in ins):
+        gp = jax.grad(lambda pp: loss(pp, ins))(p)
+        return gp, None
+    gp, gi = jax.grad(loss, argnums=(0, 1))(p, ins)
+    return gp, gi
+
+
+def t_(a):
+    t = torch.tensor(np.asarray(a), dtype=torch.float32, requires_grad=True)
+    return t
+
+
+# ----------------------------------------------------------------- linear
+def test_linear_fwd_bwd():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 32)).astype(np.float32)
+    w = rng.normal(size=(32, 16)).astype(np.float32) * 0.1
+    b = rng.normal(size=(16,)).astype(np.float32)
+    (y,) = run_op(
+        OperatorType.LINEAR,
+        dict(out_dim=16, activation=ActiMode.RELU, use_bias=True),
+        [x],
+        {"kernel": w, "bias": b},
+    )
+    xt, wt, bt = t_(x), t_(w), t_(b)
+    yt = F.relu(xt @ wt + bt)
+    np.testing.assert_allclose(y, yt.detach().numpy(), rtol=RTOL, atol=ATOL)
+
+    gp, gi = grads_of(
+        OperatorType.LINEAR,
+        dict(out_dim=16, activation=ActiMode.RELU, use_bias=True),
+        [x],
+        {"kernel": w, "bias": b},
+    )
+    yt.sum().backward()
+    np.testing.assert_allclose(gp["kernel"], wt.grad.numpy(), rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(gp["bias"], bt.grad.numpy(), rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(gi[0], xt.grad.numpy(), rtol=RTOL, atol=ATOL)
+
+
+# ----------------------------------------------------------------- conv2d
+def test_conv2d_fwd_bwd():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+    w_hwio = rng.normal(size=(3, 3, 3, 8)).astype(np.float32) * 0.1
+    b = rng.normal(size=(8,)).astype(np.float32)
+    attrs = dict(
+        out_channels=8, kernel_h=3, kernel_w=3, stride_h=1, stride_w=1,
+        padding_h=1, padding_w=1, activation=ActiMode.NONE, groups=1, use_bias=True,
+    )
+    (y,) = run_op(OperatorType.CONV2D, attrs, [x], {"kernel": w_hwio, "bias": b})
+
+    xt = t_(x)
+    wt = t_(w_hwio)
+    bt = t_(b)
+    w_oihw = wt.permute(3, 2, 0, 1)
+    yt = F.conv2d(xt, w_oihw, bt, stride=1, padding=1)
+    np.testing.assert_allclose(y, yt.detach().numpy(), rtol=1e-3, atol=1e-4)
+
+    gp, gi = grads_of(OperatorType.CONV2D, attrs, [x], {"kernel": w_hwio, "bias": b})
+    yt.sum().backward()
+    np.testing.assert_allclose(gp["kernel"], wt.grad.numpy(), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(gi[0], xt.grad.numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_conv2d_grouped():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(2, 4, 8, 8)).astype(np.float32)
+    w = rng.normal(size=(3, 3, 2, 8)).astype(np.float32) * 0.1
+    attrs = dict(
+        out_channels=8, kernel_h=3, kernel_w=3, stride_h=1, stride_w=1,
+        padding_h=1, padding_w=1, activation=ActiMode.NONE, groups=2, use_bias=False,
+    )
+    (y,) = run_op(OperatorType.CONV2D, attrs, [x], {"kernel": w})
+    yt = F.conv2d(t_(x), t_(w).permute(3, 2, 0, 1), stride=1, padding=1, groups=2)
+    np.testing.assert_allclose(y, yt.detach().numpy(), rtol=1e-3, atol=1e-4)
+
+
+# ----------------------------------------------------------------- pool2d
+@pytest.mark.parametrize("pt", [PoolType.MAX, PoolType.AVG])
+def test_pool2d(pt):
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(2, 4, 8, 8)).astype(np.float32)
+    attrs = dict(kernel_h=2, kernel_w=2, stride_h=2, stride_w=2,
+                 padding_h=0, padding_w=0, pool_type=pt, activation=ActiMode.NONE)
+    (y,) = run_op(OperatorType.POOL2D, attrs, [x])
+    xt = torch.tensor(x)
+    yt = F.max_pool2d(xt, 2) if pt is PoolType.MAX else F.avg_pool2d(xt, 2)
+    np.testing.assert_allclose(y, yt.numpy(), rtol=RTOL, atol=ATOL)
+
+
+# ------------------------------------------------------------- batch_norm
+def test_batchnorm_training_fwd():
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(4, 6, 5, 5)).astype(np.float32)
+    scale = rng.normal(size=(6,)).astype(np.float32)
+    bias = rng.normal(size=(6,)).astype(np.float32)
+    params = {
+        "scale": scale, "bias": bias,
+        "running_mean": np.zeros(6, np.float32), "running_var": np.ones(6, np.float32),
+    }
+    (y,) = run_op(OperatorType.BATCHNORM, dict(relu=False), [x], params, training=True)
+    yt = F.batch_norm(
+        torch.tensor(x), torch.zeros(6), torch.ones(6),
+        torch.tensor(scale), torch.tensor(bias), training=True,
+    )
+    np.testing.assert_allclose(y, yt.numpy(), rtol=1e-3, atol=1e-4)
+
+
+# ------------------------------------------------------------- layer_norm
+def test_layernorm_fwd_bwd():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(4, 10, 32)).astype(np.float32)
+    scale = rng.normal(size=(32,)).astype(np.float32)
+    bias = rng.normal(size=(32,)).astype(np.float32)
+    attrs = dict(axes=(2,), elementwise_affine=True, eps=1e-5)
+    (y,) = run_op(OperatorType.LAYERNORM, attrs, [x], {"scale": scale, "bias": bias})
+    xt, st, bt = t_(x), t_(scale), t_(bias)
+    yt = F.layer_norm(xt, (32,), st, bt, eps=1e-5)
+    np.testing.assert_allclose(y, yt.detach().numpy(), rtol=1e-3, atol=1e-4)
+
+    gp, gi = grads_of(OperatorType.LAYERNORM, attrs, [x], {"scale": scale, "bias": bias})
+    yt.sum().backward()
+    np.testing.assert_allclose(gp["scale"], st.grad.numpy(), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(gi[0], xt.grad.numpy(), rtol=1e-3, atol=1e-3)
+
+
+def test_rmsnorm_fwd():
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(4, 32)).astype(np.float32)
+    scale = rng.normal(size=(32,)).astype(np.float32)
+    (y,) = run_op(OperatorType.RMS_NORM, dict(eps=1e-6), [x], {"scale": scale})
+    xt = torch.tensor(x)
+    yt = xt * torch.rsqrt(xt.pow(2).mean(-1, keepdim=True) + 1e-6) * torch.tensor(scale)
+    np.testing.assert_allclose(y, yt.numpy(), rtol=1e-4, atol=1e-5)
+
+
+# -------------------------------------------------------------- embedding
+@pytest.mark.parametrize("aggr", [AggrMode.NONE, AggrMode.SUM, AggrMode.AVG])
+def test_embedding(aggr):
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, 50, size=(4, 6)).astype(np.int32)
+    table = rng.normal(size=(50, 8)).astype(np.float32)
+    (y,) = run_op(
+        OperatorType.EMBEDDING,
+        dict(num_entries=50, out_dim=8, aggr=aggr, dtype=DataType.FLOAT),
+        [ids],
+        {"kernel": table},
+    )
+    rows = torch.tensor(table)[torch.tensor(ids, dtype=torch.long)]
+    if aggr is AggrMode.SUM:
+        rows = rows.sum(-2)
+    elif aggr is AggrMode.AVG:
+        rows = rows.mean(-2)
+    np.testing.assert_allclose(y, rows.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_embedding_grad():
+    rng = np.random.default_rng(8)
+    ids = rng.integers(0, 20, size=(4, 3)).astype(np.int32)
+    table = rng.normal(size=(20, 5)).astype(np.float32)
+    attrs = dict(num_entries=20, out_dim=5, aggr=AggrMode.SUM, dtype=DataType.FLOAT)
+    gp, _ = grads_of(OperatorType.EMBEDDING, attrs, [ids], {"kernel": table})
+    tt = t_(table)
+    tt.retain_grad()
+    out = tt[torch.tensor(ids, dtype=torch.long)].sum(-2)
+    out.sum().backward()
+    np.testing.assert_allclose(gp["kernel"], tt.grad.numpy(), rtol=RTOL, atol=ATOL)
+
+
+# -------------------------------------------------------------- attention
+def test_multihead_attention_vs_torch():
+    """Cross-check against torch.nn.MultiheadAttention with copied weights
+    (the reference aligns vs cudnnMultiHeadAttn; tests/align mt5 analog)."""
+    rng = np.random.default_rng(9)
+    b, s, e, h = 2, 10, 32, 4
+    x = rng.normal(size=(b, s, e)).astype(np.float32)
+    wq = rng.normal(size=(e, e)).astype(np.float32) * 0.2
+    wk = rng.normal(size=(e, e)).astype(np.float32) * 0.2
+    wv = rng.normal(size=(e, e)).astype(np.float32) * 0.2
+    wo = rng.normal(size=(e, e)).astype(np.float32) * 0.2
+    attrs = dict(embed_dim=e, num_heads=h, kdim=None, vdim=None,
+                 dropout=0.0, causal=False, use_flash=False)
+    (y,) = run_op(
+        OperatorType.MULTIHEAD_ATTENTION, attrs, [x, x, x],
+        {"wq": wq, "wk": wk, "wv": wv, "wo": wo},
+    )
+
+    mha = torch.nn.MultiheadAttention(e, h, bias=False, batch_first=True)
+    with torch.no_grad():
+        mha.in_proj_weight.copy_(
+            torch.cat([torch.tensor(wq).T, torch.tensor(wk).T, torch.tensor(wv).T])
+        )
+        mha.out_proj.weight.copy_(torch.tensor(wo).T)
+    xt = torch.tensor(x)
+    yt, _ = mha(xt, xt, xt, need_weights=False)
+    np.testing.assert_allclose(y, yt.detach().numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_attention_causal_mask():
+    rng = np.random.default_rng(10)
+    b, s, e, h = 1, 6, 16, 2
+    x = rng.normal(size=(b, s, e)).astype(np.float32)
+    eye = np.eye(e, dtype=np.float32)
+    params = {"wq": eye, "wk": eye, "wv": eye, "wo": eye}
+    attrs = dict(embed_dim=e, num_heads=h, kdim=None, vdim=None,
+                 dropout=0.0, causal=True, use_flash=False)
+    (y,) = run_op(OperatorType.MULTIHEAD_ATTENTION, attrs, [x, x, x], params)
+    xt = torch.tensor(x)
+    q = xt.reshape(b, s, h, e // h).transpose(1, 2)
+    yt = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    yt = yt.transpose(1, 2).reshape(b, s, e)
+    np.testing.assert_allclose(y, yt.numpy(), rtol=1e-3, atol=1e-4)
+
+
+# ---------------------------------------------------------- batch_matmul
+def test_batch_matmul():
+    rng = np.random.default_rng(11)
+    a = rng.normal(size=(3, 4, 5)).astype(np.float32)
+    b = rng.normal(size=(3, 5, 6)).astype(np.float32)
+    (y,) = run_op(OperatorType.BATCHMATMUL, {}, [a, b])
+    np.testing.assert_allclose(y, torch.bmm(torch.tensor(a), torch.tensor(b)).numpy(),
+                               rtol=RTOL, atol=ATOL)
+
+
+# ----------------------------------------------------- softmax/unary/binary
+def test_softmax():
+    x = np.random.default_rng(12).normal(size=(4, 7)).astype(np.float32)
+    (y,) = run_op(OperatorType.SOFTMAX, dict(dim=-1), [x])
+    np.testing.assert_allclose(y, F.softmax(torch.tensor(x), -1).numpy(), rtol=RTOL, atol=ATOL)
+
+
+UNARY_CASES = [
+    (OperatorType.RELU, {}, torch.relu),
+    (OperatorType.SIGMOID, {}, torch.sigmoid),
+    (OperatorType.TANH, {}, torch.tanh),
+    (OperatorType.ELU, {}, F.elu),
+    (OperatorType.GELU, {}, lambda t: F.gelu(t, approximate="tanh")),
+    (OperatorType.EXP, {}, torch.exp),
+    (OperatorType.SIN, {}, torch.sin),
+    (OperatorType.COS, {}, torch.cos),
+    (OperatorType.RSQRT, {}, torch.rsqrt),
+    (OperatorType.POW, {"exponent": 3.0}, lambda t: t.pow(3.0)),
+    (OperatorType.IDENTITY, {}, lambda t: t),
+    (OperatorType.SCALAR_MULTIPLY, {"scalar": 2.5}, lambda t: t * 2.5),
+    (OperatorType.SCALAR_ADD, {"scalar": 1.5}, lambda t: t + 1.5),
+    (OperatorType.SCALAR_SUB, {"scalar": 0.5}, lambda t: t - 0.5),
+    (OperatorType.SCALAR_TRUE_DIV, {"scalar": 2.0}, lambda t: t / 2.0),
+]
+
+
+@pytest.mark.parametrize("op,attrs,ref", UNARY_CASES, ids=[c[0].value for c in UNARY_CASES])
+def test_unary(op, attrs, ref):
+    x = np.random.default_rng(13).uniform(0.1, 2.0, size=(4, 9)).astype(np.float32)
+    (y,) = run_op(op, attrs, [x])
+    np.testing.assert_allclose(y, ref(torch.tensor(x)).numpy(), rtol=1e-4, atol=1e-5)
+
+
+BINARY_CASES = [
+    (OperatorType.EW_ADD, torch.add),
+    (OperatorType.EW_SUB, torch.sub),
+    (OperatorType.EW_MUL, torch.mul),
+    (OperatorType.EW_DIV, torch.div),
+    (OperatorType.EW_MAX, torch.maximum),
+    (OperatorType.EW_MIN, torch.minimum),
+]
+
+
+@pytest.mark.parametrize("op,ref", BINARY_CASES, ids=[c[0].value for c in BINARY_CASES])
+def test_binary(op, ref):
+    rng = np.random.default_rng(14)
+    a = rng.uniform(0.5, 2.0, size=(4, 9)).astype(np.float32)
+    b = rng.uniform(0.5, 2.0, size=(4, 9)).astype(np.float32)
+    (y,) = run_op(op, {}, [a, b])
+    np.testing.assert_allclose(y, ref(torch.tensor(a), torch.tensor(b)).numpy(),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_binary_broadcast():
+    rng = np.random.default_rng(15)
+    a = rng.normal(size=(4, 9)).astype(np.float32)
+    b = rng.normal(size=(1, 9)).astype(np.float32)
+    (y,) = run_op(OperatorType.EW_ADD, {}, [a, b])
+    np.testing.assert_allclose(y, a + b, rtol=RTOL, atol=ATOL)
+
+
+# ----------------------------------------------------------- shape/reduce
+def test_shape_ops():
+    rng = np.random.default_rng(16)
+    x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    (y,) = run_op(OperatorType.RESHAPE, dict(shape=(2, 12)), [x])
+    np.testing.assert_array_equal(y, x.reshape(2, 12))
+    (y,) = run_op(OperatorType.TRANSPOSE, dict(perm=(0, 2, 1)), [x])
+    np.testing.assert_array_equal(y, x.transpose(0, 2, 1))
+    (y,) = run_op(OperatorType.REVERSE, dict(axis=1), [x])
+    np.testing.assert_array_equal(y, x[:, ::-1])
+    (y,) = run_op(OperatorType.FLAT, {}, [rng.normal(size=(2, 3, 4, 5)).astype(np.float32)])
+    assert y.shape == (2, 60)
+    a = rng.normal(size=(2, 3)).astype(np.float32)
+    b = rng.normal(size=(2, 5)).astype(np.float32)
+    (y,) = run_op(OperatorType.CONCAT, dict(axis=1), [a, b])
+    np.testing.assert_array_equal(y, np.concatenate([a, b], axis=1))
+    y1, y2 = run_op(OperatorType.SPLIT, dict(sizes=(3, 5), axis=1), [y])
+    np.testing.assert_array_equal(y1, a)
+    np.testing.assert_array_equal(y2, b)
+
+
+def test_reduce_ops():
+    x = np.random.default_rng(17).normal(size=(3, 4, 5)).astype(np.float32)
+    (y,) = run_op(OperatorType.REDUCE_SUM, dict(axes=(1,), keepdims=False), [x])
+    np.testing.assert_allclose(y, x.sum(1), rtol=RTOL, atol=ATOL)
+    (y,) = run_op(OperatorType.REDUCE_MEAN, dict(axes=(1, 2), keepdims=True), [x])
+    np.testing.assert_allclose(y, x.mean((1, 2), keepdims=True), rtol=RTOL, atol=ATOL)
+
+
+def test_topk_gather_cast():
+    rng = np.random.default_rng(18)
+    x = rng.normal(size=(4, 10)).astype(np.float32)
+    v, i = run_op(OperatorType.TOPK, dict(k=3, sorted=True), [x])
+    vt, it = torch.topk(torch.tensor(x), 3)
+    np.testing.assert_allclose(v, vt.numpy(), rtol=RTOL, atol=ATOL)
+    np.testing.assert_array_equal(i, it.numpy())
+
+    data = rng.normal(size=(4, 10)).astype(np.float32)
+    idx = rng.integers(0, 10, size=(4, 3)).astype(np.int32)
+    (y,) = run_op(OperatorType.GATHER, dict(dim=1), [data, idx])
+    yt = torch.gather(torch.tensor(data), 1, torch.tensor(idx, dtype=torch.long))
+    np.testing.assert_allclose(y, yt.numpy(), rtol=RTOL, atol=ATOL)
+
+    (y,) = run_op(OperatorType.CAST, dict(dtype=DataType.INT32), [x])
+    assert y.dtype == jnp.int32
+
+
+# ------------------------------------------------------------------- MoE
+def test_group_by_aggregate_roundtrip():
+    """Dispatch + combine with uniform gates reconstructs each surviving
+    token (capacity large enough => no drops)."""
+    rng = np.random.default_rng(19)
+    t, d, n, k = 16, 8, 4, 1
+    data = rng.normal(size=(t, d)).astype(np.float32)
+    assign = rng.integers(0, n, size=(t, k)).astype(np.int32)
+    grouped = run_op(
+        OperatorType.GROUP_BY, dict(n_experts=n, alpha=float(n)), [data, assign]
+    )
+    assert len(grouped) == n
+    gate_preds = np.ones((t, k), np.float32)
+    gate_full = np.ones((t, n), np.float32) / n
+    (y,) = run_op(
+        OperatorType.AGGREGATE,
+        dict(n=n, lambda_bal=0.0),
+        [gate_preds, assign, assign, gate_full] + [np.asarray(g) for g in grouped],
+    )
+    np.testing.assert_allclose(y, data, rtol=1e-4, atol=1e-4)
+
+
+def test_dropout_train_eval():
+    x = np.ones((64, 64), np.float32)
+    (y,) = run_op(OperatorType.DROPOUT, dict(rate=0.5, seed=0), [x], training=True)
+    zeros = float(np.mean(np.asarray(y) == 0.0))
+    assert 0.3 < zeros < 0.7
+    surv = np.asarray(y)[np.asarray(y) != 0]
+    np.testing.assert_allclose(surv, 2.0, rtol=1e-5)
+    (y,) = run_op(OperatorType.DROPOUT, dict(rate=0.5, seed=0), [x], training=False)
+    np.testing.assert_array_equal(y, x)

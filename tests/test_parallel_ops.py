@@ -1,0 +1,133 @@
+"""Parallel-op vocabulary (SURVEY §2.4) — semantic identity + sharding
+algebra + end-to-end equivalence on the 8-device CPU mesh.
+
+Reference: ``src/parallel_ops/{partition,combine,replicate,reduction,
+fused_parallel_op}.cc``.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    ActiMode,
+    FFConfig,
+    FFModel,
+    LossType,
+    MachineMesh,
+    SGDOptimizer,
+)
+from flexflow_tpu.parallel.spec import TensorSharding
+
+
+# ------------------------------------------------- sharding algebra (unit)
+def test_sharding_algebra():
+    mesh = MachineMesh((4, 2), ("data", "model"))
+    sh = TensorSharding.replicated(2)
+    sh = sh.repartition(0, "data")
+    assert sh.spec == ("data", None)
+    assert sh.total_degree(mesh) == 4
+    sh = sh.repartition(1, "model")
+    assert sh.total_degree(mesh) == 8
+    sh = sh.combine(1)
+    assert sh.spec == ("data", None)
+    sh2 = sh.with_partial("model")
+    assert sh2.partial_axes == ("model",)
+    sh3 = sh2.reduce("model")
+    assert sh3.partial_axes == ()
+    assert sh.is_valid((8, 6), mesh)
+    assert not sh.is_valid((6, 6), mesh)  # 6 % 4 != 0
+
+
+def test_multi_axis_dim_sharding():
+    mesh = MachineMesh((2, 2, 2), ("data", "model", "seq"))
+    sh = TensorSharding.replicated(2).repartition(0, "data").repartition(0, "model")
+    assert sh.axes_of(0) == ("data", "model")
+    assert sh.dim_degree(0, mesh) == 4
+    assert not TensorSharding(spec=("data", "data")).is_valid((4, 4), mesh)
+
+
+# ------------------------------------------- end-to-end semantic identity
+def make_data(n=256, d=32, classes=8, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, d)).astype(np.float32) * 3
+    y = rng.integers(0, classes, size=n)
+    x = (centers[y] + rng.normal(size=(n, d))).astype(np.float32)
+    return x, y.astype(np.int32).reshape(n, 1)
+
+
+def build(cfg, with_parallel_ops, d=32, classes=8):
+    model = FFModel(cfg)
+    t = model.create_tensor((cfg.batch_size, d))
+    if with_parallel_ops:
+        t = model.repartition(t, dim=0, degree=4, axis="data")
+    t = model.dense(t, 64, ActiMode.RELU)
+    if with_parallel_ops:
+        t = model.combine(t, dim=0, degree=4)
+        t = model.replicate(t)
+    t = model.dense(t, classes)
+    if with_parallel_ops:
+        t = model.reduction(t)
+    t = model.softmax(t)
+    return model
+
+
+def test_parallel_ops_semantic_identity():
+    """Models with and without explicit resharding ops compute the same
+    training trajectory (parallel ops are distribution-only)."""
+    x, y = make_data()
+    weights = []
+    for use_pops in (False, True):
+        cfg = FFConfig(batch_size=64, epochs=2)
+        model = build(cfg, use_pops)
+        model.compile(
+            optimizer=SGDOptimizer(lr=0.05),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            mesh=MachineMesh((4, 2), ("data", "model")),
+            seed=3,
+        )
+        model.fit(x, y, verbose=False)
+        weights.append(model.get_weights())
+    w0, w1 = weights
+    for lname in w0:
+        for wname in w0[lname]:
+            np.testing.assert_allclose(
+                w0[lname][wname], w1[lname][wname], rtol=2e-4, atol=2e-5
+            )
+
+
+def test_fused_parallel_op():
+    cfg = FFConfig(batch_size=32, epochs=1)
+    model = FFModel(cfg)
+    t = model.create_tensor((32, 16))
+    t = model.fused_parallel_op(
+        t, [("repartition", {"dim": 0, "degree": 2, "axis": "data"}),
+            ("combine", {"dim": 0})],
+    )
+    t = model.dense(t, 8)
+    t = model.softmax(t)
+    model.compile(
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        mesh=MachineMesh((2, 1), ("data", "model")),
+    )
+    x = np.random.default_rng(0).normal(size=(64, 16)).astype(np.float32)
+    y = np.zeros((64, 1), np.int32)
+    loss, _ = model.executor.train_step([x[:32]], y[:32])
+    assert np.isfinite(float(loss))
+
+
+def test_cache_op_state():
+    cfg = FFConfig(batch_size=16, epochs=1)
+    model = FFModel(cfg)
+    t = model.create_tensor((16, 8))
+    t = model.cache(t)
+    t = model.dense(t, 4)
+    t = model.softmax(t)
+    model.compile(
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        mesh=MachineMesh((1, 1), ("data", "model")),
+    )
+    x = np.random.default_rng(1).normal(size=(16, 8)).astype(np.float32)
+    y = np.zeros((16, 1), np.int32)
+    model.executor.train_step([x], y)
+    cached = np.asarray(model.executor.state["cache_0"]["cached"])
+    np.testing.assert_allclose(cached, x, rtol=1e-6)

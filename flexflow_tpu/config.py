@@ -60,6 +60,7 @@ class FFConfig:
     compute_dtype: str = "float32"  # params/compute dtype; "bfloat16" for perf
     rng_seed: int = 0
     memory_search_budget: int = -1  # lambda search iterations (graph.cc:2075)
+    device_memory_gb: float = -1.0  # per-device HBM budget for λ mem search
 
     def __post_init__(self) -> None:
         self._devices = None
@@ -148,6 +149,8 @@ class FFConfig:
                 self.compute_dtype = take()
             elif a == "--seed":
                 self.rng_seed = int(take())
+            elif a == "--device-memory-gb":
+                self.device_memory_gb = float(take())
             else:
                 rest.append(a)
             i += 1

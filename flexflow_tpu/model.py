@@ -569,7 +569,16 @@ class FFModel:
                 from flexflow_tpu.search import unity_search
 
                 strategy = unity_search(
-                    self.layers, mesh, budget=cfg.search_budget, alpha=cfg.search_alpha
+                    self.layers,
+                    mesh,
+                    graph_inputs=self.graph_inputs,
+                    budget=cfg.search_budget,
+                    alpha=cfg.search_alpha,
+                    mem_budget_bytes=(
+                        cfg.device_memory_gb * (1 << 30)
+                        if cfg.device_memory_gb > 0
+                        else None
+                    ),
                 )
             else:
                 strategy = data_parallel_strategy(self.layers, mesh)

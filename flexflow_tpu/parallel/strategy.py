@@ -33,10 +33,17 @@ class OpSharding:
 
     ``output`` — sharding of each output tensor.
     ``weights`` — per-weight-name mesh-axis assignment (dim -> axes).
+    ``inputs`` — desired sharding of each input tensor (empty = accept the
+    producer's layout as-is, zero transition cost).  This is the TPU form of
+    the reference's per-op ``ParallelDimMappingRecord`` input requirements
+    (``include/flexflow/operator.h:22-49``): an edge whose producer layout
+    differs from the consumer's desired input layout costs a reshard
+    collective, which the search charges via ``reshard_cost``.
     """
 
     output: List[TensorSharding]
     weights: Dict[str, TensorSharding] = dataclasses.field(default_factory=dict)
+    inputs: List[TensorSharding] = dataclasses.field(default_factory=list)
 
 
 class Strategy:
@@ -66,6 +73,7 @@ class Strategy:
                     str(guid): {
                         "output": [enc_ts(t) for t in s.output],
                         "weights": {k: enc_ts(v) for k, v in s.weights.items()},
+                        "inputs": [enc_ts(t) for t in s.inputs],
                     }
                     for guid, s in self.ops.items()
                 },
@@ -90,6 +98,7 @@ class Strategy:
             st.ops[int(guid)] = OpSharding(
                 output=[dec_ts(t) for t in s["output"]],
                 weights={k: dec_ts(v) for k, v in s["weights"].items()},
+                inputs=[dec_ts(t) for t in s.get("inputs", [])],
             )
         return st
 

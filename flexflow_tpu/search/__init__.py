@@ -2,10 +2,36 @@
 
 ``unity_search`` is the entry the model's ``compile()`` calls when
 ``--search-budget`` is set (reference ``GRAPH_OPTIMIZE_TASK_ID`` launch,
-``src/runtime/model.cc:2824``).  The full substitution-based search lives in
-``flexflow_tpu.search.optimizer``; this package re-exports it.
+``src/runtime/model.cc:2824``).  Components:
+
+  graph_algo     — dominators/post-dominators/topo (S6, ``dominators.h``)
+  candidates     — per-op legal sharding enumeration (MachineView analog)
+  cost           — ICI/DCN machine model + roofline + reshard costs (S3/S4)
+  dp             — frontier DP over the PCG (S1, ``SearchHelper``)
+  substitution   — GraphXfer engine + best-first ``base_optimize`` (S2)
+  memory         — λ-binary-search memory-aware wrapper (S5)
+  optimizer      — ``unity_search`` top-level driver
 """
 
+from flexflow_tpu.search.cost import TPUMachineModel, estimate_strategy_cost
+from flexflow_tpu.search.dp import SearchHelper
+from flexflow_tpu.search.memory import strategy_memory_per_device
 from flexflow_tpu.search.optimizer import unity_search
+from flexflow_tpu.search.substitution import (
+    GraphXfer,
+    base_optimize,
+    generate_all_pcg_xfers,
+    graph_optimize,
+)
 
-__all__ = ["unity_search"]
+__all__ = [
+    "GraphXfer",
+    "SearchHelper",
+    "TPUMachineModel",
+    "base_optimize",
+    "estimate_strategy_cost",
+    "generate_all_pcg_xfers",
+    "graph_optimize",
+    "strategy_memory_per_device",
+    "unity_search",
+]

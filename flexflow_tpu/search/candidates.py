@@ -1,0 +1,256 @@
+"""Per-op sharding-candidate enumeration.
+
+TPU analog of the reference's per-op valid-MachineView enumeration
+(``register_all_machine_views``, ``src/runtime/graph.cc:2329-2360``, crossed
+with each op's ``ParallelDimMappingRecord`` legality rules).  On a torus the
+legal "views" are assignments of mesh axes to partitionable tensor dims —
+divisor-based strided grids become axis products.
+
+Each candidate is a full :class:`OpSharding`: output layouts, desired input
+layouts, and weight layouts.  Special non-local candidates mirror the
+reference's substitution targets:
+
+  * linear out-dim partition  (``create_partition_linear_combine``,
+    ``substitution.cc:1809``) — kernel col-sharded, output channel-sharded.
+  * linear in-dim partition   (``create_replicate_linear_combine``,
+    ``substitution.cc:1756``; LINEAR_BWD2 tasks ``model.h:104-105``) —
+    kernel row-sharded, input channel-sharded, output partial-summed.
+  * attention head partition  (``create_partition_attention_combine``,
+    ``substitution.cc:1769``) — qkv col-sharded / out row-sharded, output
+    partial-summed.
+  * embedding vocab partition (``src/ops/embedding.cc:162-196``) — table
+    row-sharded, output partial-summed (masked-gather + psum).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from flexflow_tpu.fftype import OperatorType
+from flexflow_tpu.ops.base import get_op_def
+from flexflow_tpu.parallel.machine import MachineMesh
+from flexflow_tpu.parallel.spec import TensorSharding
+from flexflow_tpu.parallel.strategy import OpSharding
+from flexflow_tpu.tensor import Layer
+
+# which mesh axes may shard which semantic dim kinds
+KIND_AXES = {
+    "sample": ("data",),
+    "channel": ("model",),
+    "seq": ("seq",),
+    "expert": ("expert",),
+}
+
+# ops whose input dims correspond positionally to output dims (same-shape
+# math) — their desired input layout mirrors the output layout exactly
+_POSITIONAL_OPS = frozenset(
+    {
+        OperatorType.EW_ADD,
+        OperatorType.EW_SUB,
+        OperatorType.EW_MUL,
+        OperatorType.EW_DIV,
+        OperatorType.EW_MAX,
+        OperatorType.EW_MIN,
+        OperatorType.RELU,
+        OperatorType.SIGMOID,
+        OperatorType.TANH,
+        OperatorType.ELU,
+        OperatorType.GELU,
+        OperatorType.EXP,
+        OperatorType.SIN,
+        OperatorType.COS,
+        OperatorType.RSQRT,
+        OperatorType.POW,
+        OperatorType.IDENTITY,
+        OperatorType.SCALAR_MULTIPLY,
+        OperatorType.SCALAR_ADD,
+        OperatorType.SCALAR_SUB,
+        OperatorType.SCALAR_TRUE_DIV,
+        OperatorType.SOFTMAX,
+        OperatorType.LAYERNORM,
+        OperatorType.RMS_NORM,
+        OperatorType.BATCHNORM,
+        OperatorType.DROPOUT,
+        OperatorType.CAST,
+        OperatorType.POOL2D,
+    }
+)
+
+
+def _spec_with(ndim: int, assign: Dict[int, str]) -> TensorSharding:
+    spec: List = [None] * ndim
+    for d, a in assign.items():
+        spec[d] = a
+    return TensorSharding(spec=tuple(spec))
+
+
+def _mirror_outputs(
+    layer: Layer, outs: List[Tuple[Tuple[int, ...], object]],
+    assign: Dict[int, str], mesh: MachineMesh,
+) -> List[TensorSharding]:
+    """Apply the same dim->axis map to every output where it divides."""
+    res = []
+    for shape, _ in outs:
+        a = {
+            d: ax
+            for d, ax in assign.items()
+            if d < len(shape) and shape[d] % mesh.axis_size(ax) == 0
+        }
+        res.append(_spec_with(len(shape), a))
+    return res
+
+
+def _weights_for(
+    layer: Layer, tp_axis: Optional[str], mesh: MachineMesh
+) -> Dict[str, TensorSharding]:
+    """Shard every weight along its declared ``tp_dim`` when the op's
+    channel dim is sharded on ``tp_axis`` (matches tensor_parallel_strategy)."""
+    ws = {}
+    for w in get_op_def(layer.op_type).weights(layer):
+        if tp_axis is None or w.tp_dim is None:
+            ws[w.name] = TensorSharding.replicated(len(w.shape))
+            continue
+        if w.shape[w.tp_dim] % mesh.axis_size(tp_axis) != 0:
+            ws[w.name] = TensorSharding.replicated(len(w.shape))
+            continue
+        spec: List = [None] * len(w.shape)
+        spec[w.tp_dim] = tp_axis
+        ws[w.name] = TensorSharding(spec=tuple(spec))
+    return ws
+
+
+def _dedup(cands: List[OpSharding]) -> List[OpSharding]:
+    seen, out = set(), []
+    for c in cands:
+        key = (
+            tuple((t.spec, t.partial_axes) for t in c.output),
+            tuple(sorted((k, v.spec, v.partial_axes) for k, v in c.weights.items())),
+            tuple((t.spec, t.partial_axes) for t in c.inputs),
+        )
+        if key not in seen:
+            seen.add(key)
+            out.append(c)
+    return out
+
+
+def op_candidates(layer: Layer, mesh: MachineMesh) -> List[OpSharding]:
+    """Deterministic candidate list; first entry is fully replicated."""
+    opdef = get_op_def(layer.op_type)
+    outs = opdef.infer(layer)
+    ndim_in = [t.ndim for t in layer.inputs]
+    cands: List[OpSharding] = []
+
+    def add(output, weights=None, inputs=None):
+        cands.append(
+            OpSharding(output=output, weights=weights or {}, inputs=inputs or [])
+        )
+
+    # 0. fully replicated — demands replicated inputs (consuming a sharded
+    # producer into a replicated compute costs the all-gather, which the
+    # edge cost must see)
+    add(
+        [TensorSharding.replicated(len(s)) for s, _ in outs],
+        _weights_for(layer, None, mesh),
+        [TensorSharding.replicated(n) for n in ndim_in],
+    )
+
+    if layer.op_type.is_parallel_op:
+        return cands[:1]  # distribution set by attrs, not by search
+
+    pdims = opdef.partitionable_dims(layer)
+    # axis assignments: every subset of {dim->axis} with distinct axes
+    options: List[Tuple[int, str]] = []
+    for d, kind in sorted(pdims.items()):
+        for ax in KIND_AXES.get(kind, ()):
+            if mesh.axis_size(ax) > 1 and outs[0][0][d] % mesh.axis_size(ax) == 0:
+                options.append((d, ax))
+
+    def gen(i: int, assign: Dict[int, str], used: frozenset) -> None:
+        if i == len(options):
+            if assign:
+                tp_axis = next(
+                    (a for d, a in assign.items() if pdims.get(d) == "channel"), None
+                )
+                output = _mirror_outputs(layer, outs, assign, mesh)
+                weights = _weights_for(layer, tp_axis, mesh)
+                # desired inputs: positional ops mirror every assigned dim
+                # (same-shape math); contracting/shape-changing ops mirror
+                # only batch/seq dims — their channel dims are contraction
+                # or reshaped dims that must arrive whole
+                positional = layer.op_type in _POSITIONAL_OPS
+                inputs = []
+                for t in layer.inputs:
+                    a = {
+                        d: ax
+                        for d, ax in assign.items()
+                        if (positional or pdims.get(d) in ("sample", "seq"))
+                        and d < t.ndim
+                        and t.shape[d] == outs[0][0][d]
+                        and t.shape[d] % mesh.axis_size(ax) == 0
+                    }
+                    inputs.append(_spec_with(t.ndim, a))
+                add(output, weights, inputs)
+            return
+        d, ax = options[i]
+        gen(i + 1, assign, used)  # skip
+        if ax not in used and d not in assign:
+            gen(i + 1, {**assign, d: ax}, used | {ax})
+
+    gen(0, {}, frozenset())
+
+    # non-local candidates (partial-sum outputs)
+    tp = mesh.axis_size("model")
+    dp = mesh.axis_size("data")
+    if tp > 1:
+        if layer.op_type is OperatorType.LINEAR:
+            t = layer.inputs[0]
+            in_dim = t.shape[-1]
+            if in_dim % tp == 0:
+                # in-dim partition: x channel-sharded, kernel row-sharded,
+                # y = partial sum over "model"
+                kshape = get_op_def(layer.op_type).weights(layer)[0].shape
+                wspec: Dict[str, TensorSharding] = {
+                    "kernel": _spec_with(len(kshape), {0: "model"})
+                }
+                for w in get_op_def(layer.op_type).weights(layer)[1:]:
+                    wspec[w.name] = TensorSharding.replicated(len(w.shape))
+                batch = (
+                    {0: "data"}
+                    if dp > 1 and t.shape[0] % dp == 0
+                    else {}
+                )
+                in_spec = _spec_with(t.ndim, {**batch, t.ndim - 1: "model"})
+                out_shape = outs[0][0]
+                out = TensorSharding(
+                    spec=_spec_with(len(out_shape), batch).spec,
+                    partial_axes=("model",),
+                )
+                add([out], wspec, [in_spec])
+        elif layer.op_type is OperatorType.MULTIHEAD_ATTENTION:
+            h = layer.attrs["num_heads"]
+            if h % tp == 0:
+                wspec = _weights_for(layer, "model", mesh)
+                q = layer.inputs[0]
+                batch = {0: "data"} if dp > 1 and q.shape[0] % dp == 0 else {}
+                out_shape = outs[0][0]
+                out = TensorSharding(
+                    spec=_spec_with(len(out_shape), batch).spec,
+                    partial_axes=("model",),
+                )
+                inputs = [_spec_with(t.ndim, batch) for t in layer.inputs]
+                add([out], wspec, inputs)
+        elif layer.op_type is OperatorType.EMBEDDING:
+            n_entries = layer.attrs["num_entries"]
+            if n_entries % tp == 0:
+                kshape = get_op_def(layer.op_type).weights(layer)[0].shape
+                wspec = {"kernel": _spec_with(len(kshape), {0: "model"})}
+                ids = layer.inputs[0]
+                batch = {0: "data"} if dp > 1 and ids.shape[0] % dp == 0 else {}
+                out_shape = outs[0][0]
+                out = TensorSharding(
+                    spec=_spec_with(len(out_shape), batch).spec,
+                    partial_axes=("model",),
+                )
+                add([out], wspec, [_spec_with(ids.ndim, batch)])
+
+    return _dedup(cands)

@@ -18,7 +18,7 @@ from typing import Dict, List, Optional
 
 from flexflow_tpu.ops.base import get_op_def
 from flexflow_tpu.parallel.machine import MachineMesh
-from flexflow_tpu.parallel.strategy import Strategy
+from flexflow_tpu.parallel.strategy import OpSharding, Strategy
 from flexflow_tpu.tensor import Layer
 
 
@@ -88,49 +88,192 @@ def op_compute_time(
     return max(flops / (machine.peak_flops * mxu_util), mem / machine.hbm_bw)
 
 
+def _dtype_nbytes(dt) -> int:
+    from flexflow_tpu.ops.base import _dtype_bytes
+
+    return _dtype_bytes(dt)
+
+
+def reshard_cost(
+    shape,
+    elt_bytes: int,
+    src: "TensorSharding",
+    dst: "TensorSharding",
+    mesh: MachineMesh,
+    machine: TPUMachineModel,
+) -> float:
+    """Collective time to move a tensor from distribution ``src`` to ``dst``.
+
+    This is the analytic analog of the reference's
+    ``SearchHelper::estimate_xfer_cost`` (``src/runtime/graph.cc:1438``) +
+    the parallel-op kernels' implied data movement (§2.4): under GSPMD a
+    layout change lowers to
+      * all-reduce     — partial axes resolved (``Reduction``)
+      * all-gather     — axes removed from a dim (``Combine``)
+      * all-to-all     — axes moved between dims (``Repartition`` of an
+                         already-sharded tensor)
+      * local slice    — axes added to a dim (``Repartition``; ~latency only)
+    Deterministic pure function — unit-testable, unlike the reference's
+    device-measured xfers (SURVEY §4.7 gap).
+    """
+    from flexflow_tpu.parallel.spec import TensorSharding  # noqa: F401
+
+    total = float(math.prod(shape)) * elt_bytes
+    cost = 0.0
+
+    # partial-sum resolution (axes partial in src, not in dst)
+    pending = [a for a in src.partial_axes if a not in dst.partial_axes]
+    shard_deg = max(1, src.total_degree(mesh))
+    for a in pending:
+        n = mesh.axis_size(a)
+        if n > 1:
+            cost += machine.all_reduce(total / shard_deg, n)
+
+    src_map = {a: d for d in range(len(src.spec)) for a in src.axes_of(d)}
+    dst_map = {a: d for d in range(len(dst.spec)) for a in dst.axes_of(d)}
+
+    # axes kept but moved between dims -> all-to-all
+    moved = [a for a in src_map if a in dst_map and src_map[a] != dst_map[a]]
+    # axes removed entirely -> all-gather
+    removed = [a for a in src_map if a not in dst_map]
+
+    dst_deg = max(1, dst.total_degree(mesh))
+    bytes_per_dev_dst = total / dst_deg
+    for a in moved:
+        n = mesh.axis_size(a)
+        if n > 1:
+            cost += machine.all_to_all(bytes_per_dev_dst, n)
+    gather_factor = 1
+    for a in removed:
+        gather_factor *= mesh.axis_size(a)
+    if gather_factor > 1:
+        cost += machine.all_gather(bytes_per_dev_dst, gather_factor)
+    # axes only in dst: local dynamic-slice, charge latency once
+    added = [a for a in dst_map if a not in src_map]
+    if added:
+        cost += machine.latency
+    return cost
+
+
+def node_cost(
+    layer: Layer,
+    sharding: "OpSharding",
+    mesh: MachineMesh,
+    machine: Optional[TPUMachineModel] = None,
+    lambda_mem: float = 0.0,
+) -> float:
+    """Compute + weight-grad-sync time for one op under one sharding choice
+    (the DP's leaf cost — reference ``SearchHelper::graph_cost`` leaf at
+    ``src/runtime/graph.cc:1586`` + optimizer NCCL allreduce cost).
+
+    ``lambda_mem`` adds a memory pressure term (λ·bytes) — the
+    multi-objective combination of the reference's memory-aware search
+    (``try_one_lambda``, ``src/runtime/graph.cc:1884``).
+    """
+    m = machine or TPUMachineModel()
+    out0 = sharding.output[0] if sharding.output else None
+    degree = 1
+    if out0 is not None:
+        degree = out0.total_degree(mesh)
+        for a in out0.partial_axes:
+            degree *= mesh.axis_size(a)
+    t = op_compute_time(layer, degree, m)
+
+    opdef = get_op_def(layer.op_type)
+    # gradient sync: weight grads are partial over every mesh axis that
+    # shards the op's *data* (batch/seq) but not the weight itself
+    data_axes = set()
+    if out0 is not None:
+        for i in range(len(out0.spec)):
+            data_axes.update(out0.axes_of(i))
+        data_axes -= set(out0.partial_axes)
+    for w in opdef.weights(layer):
+        if not w.trainable:
+            continue
+        wb = math.prod(w.shape) * _dtype_nbytes(w.dtype)
+        ws = sharding.weights.get(w.name)
+        wd = ws.total_degree(mesh) if ws is not None else 1
+        waxes = set(ws.used_axes()) if ws is not None else set()
+        sync = 1
+        for a in data_axes - waxes:
+            sync *= mesh.axis_size(a)
+        if sync > 1:
+            t += m.all_reduce(wb / wd, sync)
+        if lambda_mem > 0.0:
+            t += lambda_mem * (wb / wd)
+    if lambda_mem > 0.0 and out0 is not None:
+        out_b = sum(
+            math.prod(s) * _dtype_nbytes(dt) for s, dt in opdef.infer(layer)
+        )
+        t += lambda_mem * (out_b / max(1, degree))
+    return t
+
+
 def estimate_strategy_cost(
     layers: List[Layer],
     strategy: Strategy,
     machine: Optional[TPUMachineModel] = None,
+    lambda_mem: float = 0.0,
 ) -> float:
-    """Per-step time estimate for a whole strategy (compute + grad sync +
-    activation resharding).  Pure function of the layer graph + strategy —
-    deterministic and unit-testable (the gap SURVEY §4.7 notes in the
-    reference)."""
+    """Per-step time estimate for a whole strategy: node costs (compute +
+    weight-grad sync) + per-edge reshard collectives.  Pure function of the
+    layer graph + strategy — deterministic and unit-testable (the gap
+    SURVEY §4.7 notes in the reference's device-measured costing)."""
+    from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
+    from flexflow_tpu.parallel.spec import TensorSharding
+
     m = machine or TPUMachineModel()
     mesh = strategy.mesh
     total = 0.0
-    dp = mesh.axis_size("data")
+    # track explicit parallel-op distributions (layers are topological)
+    pop_out: Dict[int, TensorSharding] = {}  # tensor guid -> sharding
+
+    def producer_sharding(t) -> Optional[TensorSharding]:
+        if t.guid in pop_out:
+            return pop_out[t.guid]
+        if t.owner_layer is None:
+            return None
+        prod = strategy.op_sharding(t.owner_layer)
+        if prod is None or t.owner_idx >= len(prod.output):
+            return None
+        return prod.output[t.owner_idx]
+
     for layer in layers:
+        if layer.op_type.is_parallel_op:
+            # explicit reshard: charge the implied collective (mirrors the
+            # DP tier's _transition_cost_parallel)
+            t = layer.inputs[0]
+            src = producer_sharding(t) or TensorSharding.replicated(t.ndim)
+            dst = resolve_parallel_sharding(layer, src, mesh)
+            total += reshard_cost(t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m)
+            pop_out[layer.outputs[0].guid] = dst
+            continue
         os_ = strategy.op_sharding(layer)
-        degree = os_.output[0].total_degree(mesh) if os_ and os_.output else 1
-        total += op_compute_time(layer, degree, m)
-        # weight-grad all-reduce over the data axis for replicated weights
-        opdef = get_op_def(layer.op_type)
-        for w in opdef.weights(layer):
-            wb = math.prod(w.shape) * 4
-            ws = os_.weights.get(w.name) if os_ else None
-            shard = ws.total_degree(mesh) if ws else 1
-            if dp > 1:
-                total += m.all_reduce(wb / shard, dp)
-        # resharding cost: if an input's producer sharding != what this op
-        # consumes, XLA inserts a collective; approximate with all-gather of
-        # the input when specs differ.
-        for t in layer.inputs:
-            if t.owner_layer is None:
+        if os_ is None:
+            os_ = OpSharding(
+                output=[
+                    TensorSharding.replicated(len(s))
+                    for s, _ in get_op_def(layer.op_type).infer(layer)
+                ]
+            )
+        total += node_cost(layer, os_, mesh, m, lambda_mem=lambda_mem)
+        for i, t in enumerate(layer.inputs):
+            src = producer_sharding(t)
+            if src is None:
                 continue
-            prod = strategy.op_sharding(t.owner_layer)
-            if prod is None or os_ is None:
+            dst = (
+                os_.inputs[i]
+                if i < len(os_.inputs)
+                else TensorSharding.replicated(t.ndim)
+            )
+            # without an explicit requirement, batch-compatible layouts pass
+            # through free (GSPMD keeps them); only charge when src carries
+            # partials or channel shards the consumer didn't ask for
+            if i >= len(os_.inputs) and not src.partial_axes and not any(
+                "model" in src.axes_of(d) for d in range(len(src.spec))
+            ):
                 continue
-            p_spec = prod.output[t.owner_idx].spec if t.owner_idx < len(prod.output) else None
-            # consumer "wants" its own output batch sharding on inputs; a
-            # channel-sharded producer feeding a replicated consumer costs
-            # an all-gather of the channel shards.
-            if p_spec is None:
-                continue
-            p_model = any("model" in prodspec_axes for prodspec_axes in [prod.output[t.owner_idx].axes_of(i) for i in range(len(p_spec))])
-            consumes_model = layer.op_type.value in ("linear", "multihead_attention")
-            if p_model and not consumes_model:
-                nbytes = math.prod(t.shape) * 4
-                total += m.all_gather(nbytes, mesh.axis_size("model"))
+            total += reshard_cost(
+                t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m
+            )
     return total

@@ -1,0 +1,179 @@
+"""Optimal per-op sharding assignment via dynamic programming.
+
+Reference: ``SearchHelper`` (``include/flexflow/graph.h:170-284``) —
+``generic_optimal_cost`` (``src/runtime/graph.cc:1803``) recursively splits
+the PCG into sequence segments at post-dominators (``graph.cc:115``) and
+horizontal branches (``graph.cc:267``), memoized by ``dp_state_hash``, with
+per-leaf (op, MachineView) costs.
+
+TPU-native formulation: the DP runs over topo order keeping a *frontier* of
+live tensors, each annotated with its chosen :class:`TensorSharding`.
+States with identical frontier signatures collapse to the cheapest — at a
+post-dominator the frontier is a single tensor, so the state set collapses
+exactly as the reference's sequence split does; between dominators the beam
+bound caps the blow-up the reference handles with horizontal splits.  The
+result is deterministic and memo-free (single forward sweep).
+
+Resource model difference (deliberate): the reference assigns each op a
+MachineView over a *subset* of devices and may run branches concurrently on
+split resources.  Under GSPMD every op executes SPMD over the full mesh and
+XLA overlaps independent branches; so "resources" here are the mesh axes an
+op's sharding uses, and branch concurrency is XLA's job, not the search's.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Optional, Tuple
+
+from flexflow_tpu.fftype import OperatorType
+from flexflow_tpu.ops.base import get_op_def
+from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
+from flexflow_tpu.parallel.machine import MachineMesh
+from flexflow_tpu.parallel.spec import TensorSharding
+from flexflow_tpu.parallel.strategy import OpSharding, Strategy
+from flexflow_tpu.search.candidates import op_candidates
+from flexflow_tpu.search.cost import (
+    TPUMachineModel,
+    node_cost,
+    reshard_cost,
+)
+from flexflow_tpu.search.cost import _dtype_nbytes
+from flexflow_tpu.tensor import Layer, Tensor
+
+
+def _sh_key(sh: TensorSharding) -> Tuple:
+    return (sh.spec, sh.partial_axes)
+
+
+class SearchHelper:
+    """Frontier DP over the layer graph (see module docstring)."""
+
+    def __init__(
+        self,
+        layers: List[Layer],
+        graph_inputs: List[Tensor],
+        mesh: MachineMesh,
+        machine: Optional[TPUMachineModel] = None,
+        beam: int = 16,
+        lambda_mem: float = 0.0,
+    ) -> None:
+        self.layers = layers
+        self.graph_inputs = graph_inputs
+        self.mesh = mesh
+        self.machine = machine or TPUMachineModel()
+        self.beam = beam
+        self.lambda_mem = lambda_mem
+
+        # tensor guid -> list of consumer layer indices (for liveness)
+        self.consumers: Dict[int, List[int]] = {}
+        for idx, layer in enumerate(layers):
+            for t in layer.inputs:
+                self.consumers.setdefault(t.guid, []).append(idx)
+
+    def _input_sharding(self, t: Tensor) -> TensorSharding:
+        """Graph inputs arrive data-sharded when divisible (mirrors
+        Executor._input_pspec / reference default DP config)."""
+        dp = self.mesh.axis_size("data")
+        if dp > 1 and t.shape and t.shape[0] % dp == 0:
+            return TensorSharding.data_parallel(t.ndim)
+        return TensorSharding.replicated(t.ndim)
+
+    def _edge_cost(
+        self, t: Tensor, src: TensorSharding, dst: Optional[TensorSharding]
+    ) -> float:
+        """dst None = consumer accepts producer layout, but partial sums
+        must still be resolved before a consumer that didn't ask for them."""
+        if dst is None:
+            if not src.partial_axes:
+                return 0.0
+            dst = TensorSharding(spec=src.spec)
+        if _sh_key(src) == _sh_key(dst):
+            return 0.0
+        return reshard_cost(
+            t.shape, _dtype_nbytes(t.dtype), src, dst, self.mesh, self.machine
+        )
+
+    def solve(self) -> Tuple[float, Dict[int, OpSharding]]:
+        """Returns (estimated step time, guid -> OpSharding)."""
+        # state: frontier signature -> (cost, assignment dict)
+        init_front = {
+            t.guid: self._input_sharding(t) for t in self.graph_inputs
+        }
+        states: Dict[Tuple, Tuple[float, Dict[int, OpSharding], Dict[int, TensorSharding]]] = {}
+        key0 = tuple(sorted((g, _sh_key(s)) for g, s in init_front.items()))
+        states[key0] = (0.0, {}, init_front)
+
+        for idx, layer in enumerate(self.layers):
+            new_states: Dict[Tuple, Tuple[float, Dict[int, OpSharding], Dict[int, TensorSharding]]] = {}
+            if layer.op_type.is_parallel_op:
+                cand_list = None
+            else:
+                cand_list = op_candidates(layer, self.mesh)
+            for cost, assign, front in states.values():
+                in_shs = [
+                    front.get(t.guid, TensorSharding.replicated(t.ndim))
+                    for t in layer.inputs
+                ]
+                if cand_list is None:
+                    # parallel op: outgoing distribution from attrs
+                    out_sh = resolve_parallel_sharding(
+                        layer, in_shs[0], self.mesh
+                    )
+                    choices = [
+                        (
+                            self._transition_cost_parallel(layer, in_shs[0], out_sh),
+                            OpSharding(output=[out_sh]),
+                        )
+                    ]
+                else:
+                    choices = []
+                    for cand in cand_list:
+                        c = node_cost(
+                            layer, cand, self.mesh, self.machine,
+                            lambda_mem=self.lambda_mem,
+                        )
+                        for i, t in enumerate(layer.inputs):
+                            want = cand.inputs[i] if i < len(cand.inputs) else None
+                            c += self._edge_cost(t, in_shs[i], want)
+                        choices.append((c, cand))
+                for c, cand in choices:
+                    na = dict(assign)
+                    na[int(layer.layer_guid)] = cand
+                    nf = dict(front)
+                    for i, t in enumerate(layer.outputs):
+                        if i < len(cand.output):
+                            nf[t.guid] = cand.output[i]
+                    # drop tensors with no remaining consumers
+                    for t in layer.inputs:
+                        rem = [j for j in self.consumers.get(t.guid, []) if j > idx]
+                        if not rem and t.guid in nf:
+                            del nf[t.guid]
+                    key = tuple(sorted((g, _sh_key(s)) for g, s in nf.items()))
+                    tot = cost + c
+                    cur = new_states.get(key)
+                    if cur is None or tot < cur[0]:
+                        new_states[key] = (tot, na, nf)
+            # beam bound (the horizontal-split analog)
+            if len(new_states) > self.beam:
+                kept = heapq.nsmallest(
+                    self.beam, new_states.items(), key=lambda kv: kv[1][0]
+                )
+                new_states = dict(kept)
+            states = new_states
+
+        best_cost, best_assign, _ = min(states.values(), key=lambda v: v[0])
+        return best_cost, best_assign
+
+    def _transition_cost_parallel(
+        self, layer: Layer, src: TensorSharding, dst: TensorSharding
+    ) -> float:
+        t = layer.inputs[0]
+        return reshard_cost(
+            t.shape, _dtype_nbytes(t.dtype), src, dst, self.mesh, self.machine
+        )
+
+    def to_strategy(self, assign: Dict[int, OpSharding]) -> Strategy:
+        st = Strategy(self.mesh)
+        st.ops = dict(assign)
+        return st

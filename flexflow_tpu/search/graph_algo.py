@@ -1,0 +1,175 @@
+"""Generic graph algorithms for the search (SURVEY §2.2 S6).
+
+Reference: ``include/flexflow/basic_graph.h`` (488 LoC) and
+``include/flexflow/dominators.h`` (475 LoC) — BasicGraph, roots/leaves,
+topo sort, dominators/post-dominators, imm_post_dominator (used to find
+sequence-split points, ``src/runtime/graph.cc:115``), transitive reduction.
+
+Pure-Python re-implementation over integer node ids; deterministic
+(ordered dicts / sorted sets) so search results are reproducible in CI —
+the testability gap SURVEY §4.7 notes in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+
+class BasicGraph:
+    """Directed graph over hashable node ids (``basic_graph.h``)."""
+
+    def __init__(self) -> None:
+        self.nodes: List[int] = []
+        self._node_set: Set[int] = set()
+        self.out_edges: Dict[int, List[int]] = {}
+        self.in_edges: Dict[int, List[int]] = {}
+
+    def add_node(self, n: int) -> None:
+        if n not in self._node_set:
+            self._node_set.add(n)
+            self.nodes.append(n)
+            self.out_edges.setdefault(n, [])
+            self.in_edges.setdefault(n, [])
+
+    def add_edge(self, src: int, dst: int) -> None:
+        self.add_node(src)
+        self.add_node(dst)
+        if dst not in self.out_edges[src]:
+            self.out_edges[src].append(dst)
+            self.in_edges[dst].append(src)
+
+    def roots(self) -> List[int]:
+        return [n for n in self.nodes if not self.in_edges[n]]
+
+    def leaves(self) -> List[int]:
+        return [n for n in self.nodes if not self.out_edges[n]]
+
+    def topo_order(self) -> List[int]:
+        """Deterministic Kahn topo sort (insertion order tie-break)."""
+        indeg = {n: len(self.in_edges[n]) for n in self.nodes}
+        ready = [n for n in self.nodes if indeg[n] == 0]
+        out: List[int] = []
+        while ready:
+            n = ready.pop(0)
+            out.append(n)
+            for m in self.out_edges[n]:
+                indeg[m] -= 1
+                if indeg[m] == 0:
+                    ready.append(m)
+        assert len(out) == len(self.nodes), "cycle detected"
+        return out
+
+    def subgraph(self, keep: Iterable[int]) -> "BasicGraph":
+        ks = set(keep)
+        g = BasicGraph()
+        for n in self.nodes:
+            if n in ks:
+                g.add_node(n)
+        for n in g.nodes:
+            for m in self.out_edges[n]:
+                if m in ks:
+                    g.add_edge(n, m)
+        return g
+
+    def reversed(self) -> "BasicGraph":
+        g = BasicGraph()
+        for n in self.nodes:
+            g.add_node(n)
+        for n in self.nodes:
+            for m in self.out_edges[n]:
+                g.add_edge(m, n)
+        return g
+
+
+def dominators(g: BasicGraph) -> Dict[int, Set[int]]:
+    """Classic iterative dominator sets from a virtual root covering all
+    real roots (``dominators.h`` ``dominators()``)."""
+    order = g.topo_order()
+    roots = set(g.roots())
+    dom: Dict[int, Set[int]] = {}
+    for n in order:
+        preds = g.in_edges[n]
+        if n in roots or not preds:
+            dom[n] = {n}
+            continue
+        inter: Optional[Set[int]] = None
+        for p in preds:
+            inter = set(dom[p]) if inter is None else inter & dom[p]
+        dom[n] = (inter or set()) | {n}
+    return dom
+
+
+def post_dominators(g: BasicGraph) -> Dict[int, Set[int]]:
+    """Post-dominators = dominators of the reverse graph
+    (``dominators.h`` ``post_dominators()``)."""
+    return dominators(g.reversed())
+
+
+def imm_post_dominator(g: BasicGraph, n: Optional[int] = None) -> Optional[int]:
+    """Immediate post-dominator of node ``n`` (or of the whole graph's
+    source frontier when ``n`` is None) — the reference's sequence-split
+    point (``imm_post_dominators`` in ``dominators.h``; used at
+    ``src/runtime/graph.cc:115``).
+
+    Returns the earliest (in topo order) node != n that post-dominates
+    every root (n is None) or that post-dominates n.
+    """
+    pdom = post_dominators(g)
+    order = g.topo_order()
+    pos = {v: i for i, v in enumerate(order)}
+    if n is None:
+        targets = g.roots()
+        cands: Optional[Set[int]] = None
+        for r in targets:
+            cands = set(pdom[r]) if cands is None else cands & pdom[r]
+        if cands is None:
+            return None
+        cands -= set(targets)
+    else:
+        cands = pdom[n] - {n}
+    if not cands:
+        return None
+    return min(cands, key=lambda v: pos[v])
+
+
+def transitive_reduction(g: BasicGraph) -> BasicGraph:
+    """Remove edges implied by longer paths (``graph.cc`` uses this to
+    canonicalize PCGs before hashing)."""
+    order = g.topo_order()
+    pos = {v: i for i, v in enumerate(order)}
+    reach: Dict[int, Set[int]] = {n: set() for n in g.nodes}
+    for n in reversed(order):
+        for m in g.out_edges[n]:
+            reach[n].add(m)
+            reach[n] |= reach[m]
+    out = BasicGraph()
+    for n in g.nodes:
+        out.add_node(n)
+    for n in g.nodes:
+        for m in sorted(g.out_edges[n], key=lambda v: pos[v]):
+            # edge n->m is redundant if some other successor reaches m
+            if any(m in reach[k] for k in g.out_edges[n] if k != m):
+                continue
+            out.add_edge(n, m)
+    return out
+
+
+def connected_components_undirected(g: BasicGraph) -> List[List[int]]:
+    """Weakly-connected components — the nonsequence (horizontal) split's
+    branch discovery (``src/runtime/graph.cc:267``)."""
+    seen: Set[int] = set()
+    comps: List[List[int]] = []
+    for n in g.nodes:
+        if n in seen:
+            continue
+        stack, comp = [n], []
+        seen.add(n)
+        while stack:
+            v = stack.pop()
+            comp.append(v)
+            for m in list(g.out_edges[v]) + list(g.in_edges[v]):
+                if m not in seen:
+                    seen.add(m)
+                    stack.append(m)
+        comps.append(sorted(comp))
+    return comps

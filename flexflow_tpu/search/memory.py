@@ -1,0 +1,122 @@
+"""Memory-aware multi-objective search (SURVEY §2.2 S5).
+
+Reference: ``MemoryUsage`` (``include/flexflow/memory_optimization.h:16+``),
+the λ-combined objective ``try_one_lambda`` (``src/runtime/graph.cc:1884``)
+and the λ binary search in ``Graph::graph_optimize_task``
+(``graph.cc:2046-2161``): run the search with run_time + λ·memory, binary
+search λ until the chosen strategy fits the per-device budget.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+from flexflow_tpu.ops.base import get_op_def
+from flexflow_tpu.ops.base import _dtype_bytes
+from flexflow_tpu.parallel.machine import MachineMesh
+from flexflow_tpu.parallel.strategy import OpSharding, Strategy
+from flexflow_tpu.tensor import Layer
+
+
+def strategy_memory_per_device(
+    layers: List[Layer],
+    strategy: Strategy,
+    optimizer_state_factor: float = 3.0,
+) -> float:
+    """Peak per-device HBM estimate in bytes.
+
+    weights × (1 param + 1 grad + optimizer slots) / shard-degree
+    + activations (training saves every op output for backward) / degree.
+    Pure function — the reference's ``MemoryUsage`` accounting made
+    deterministic/unit-testable.
+    """
+    mesh = strategy.mesh
+    total = 0.0
+    for layer in layers:
+        if layer.op_type.is_parallel_op:
+            continue
+        opdef = get_op_def(layer.op_type)
+        s = strategy.op_sharding(layer)
+        for w in opdef.weights(layer):
+            wb = math.prod(w.shape) * _dtype_bytes(w.dtype)
+            ws = s.weights.get(w.name) if s else None
+            deg = ws.total_degree(mesh) if ws else 1
+            factor = optimizer_state_factor if w.trainable else 1.0
+            total += wb * factor / deg
+        for i, (shape, dt) in enumerate(opdef.infer(layer)):
+            ob = math.prod(shape) * _dtype_bytes(dt)
+            deg = 1
+            if s and i < len(s.output):
+                deg = s.output[i].total_degree(mesh)
+                for a in s.output[i].partial_axes:
+                    deg *= mesh.axis_size(a)
+            total += ob / deg
+    return total
+
+
+def optimize_with_memory_budget(
+    optimize_fn,
+    layers: List[Layer],
+    mesh: MachineMesh,
+    mem_budget_bytes: float,
+    iters: int = 8,
+    machine=None,
+) -> Tuple[float, Dict[int, OpSharding]]:
+    """λ binary search (reference ``graph_optimize_task`` λ loop,
+    ``graph.cc:2056-2131``): ``optimize_fn(lambda_mem)`` must return
+    (cost, assignment); λ in seconds/byte trades step time for memory.
+
+    The returned cost is always re-estimated at λ=0 (pure step time) so
+    callers comparing across meshes compare like with like.  If no tried λ
+    fits, returns the minimum-memory assignment seen and logs a warning
+    (the reference errors out of ``try_one_lambda`` similarly).
+    """
+    from flexflow_tpu.search.cost import estimate_strategy_cost
+
+    def mem_of(a: Dict[int, OpSharding]) -> float:
+        st = Strategy(mesh)
+        st.ops = a
+        return strategy_memory_per_device(layers, st)
+
+    def time_of(a: Dict[int, OpSharding]) -> float:
+        st = Strategy(mesh)
+        st.ops = a
+        return estimate_strategy_cost(layers, st, machine)
+
+    _, assign = optimize_fn(0.0)
+    if mem_of(assign) <= mem_budget_bytes:
+        return time_of(assign), assign
+
+    tried: List[Tuple[float, Dict[int, OpSharding]]] = [(mem_of(assign), assign)]
+    # phase 1: escalate λ geometrically until something fits
+    fit_lam: Optional[float] = None
+    lam = 1e-9
+    for _ in range(iters):
+        _, a = optimize_fn(lam)
+        m = mem_of(a)
+        tried.append((m, a))
+        if m <= mem_budget_bytes:
+            fit_lam = lam
+            break
+        lam *= 100.0
+    if fit_lam is None:
+        import logging
+
+        m_min, a_min = min(tried, key=lambda t: t[0])
+        logging.getLogger("flexflow_tpu").warning(
+            "memory search: no λ fits budget %.2f GB (min reachable %.2f GB)",
+            mem_budget_bytes / (1 << 30), m_min / (1 << 30),
+        )
+        return time_of(a_min), a_min
+    # phase 2: binary search λ in (fit_lam/100, fit_lam] for the cheapest fit
+    lo, hi = fit_lam / 100.0, fit_lam
+    best = next(a for m, a in tried if m <= mem_budget_bytes)
+    for _ in range(iters):
+        mid = (lo + hi) / 2
+        _, a = optimize_fn(mid)
+        if mem_of(a) <= mem_budget_bytes:
+            best, hi = a, mid
+        else:
+            lo = mid
+    return time_of(best), best

@@ -1,48 +1,93 @@
-"""Strategy search entry point.
+"""Unity strategy-search entry point.
 
-First-cut implementation: enumerate candidate logical meshes
-(factorizations of the chip count over (data, model) axes — the TPU analog
-of ``register_all_machine_views``, ``src/runtime/graph.cc:2329``) crossed
-with the strategy generators (pure DP, DP+TP), cost each with the analytic
-cost model, return the argmin.  The substitution-engine search
-(``GraphXfer``/``base_optimize``, ``src/runtime/substitution.cc:2229``)
-extends this by rewriting per-op shardings; see
-``flexflow_tpu/search/substitution.py``.
+Reference flow (``FFModel::compile`` → ``GRAPH_OPTIMIZE_TASK_ID`` →
+``Graph::graph_optimize_task``, ``src/runtime/graph.cc:2046-2161``):
+construct the PCG, run the substitution search costed by the DP +
+simulator, optionally λ-binary-search for a memory budget, return the best
+(graph, optimal_views).
+
+TPU-native: enumerate candidate logical meshes (factorizations of the chip
+count over named axes — the torus-legal analog of
+``register_all_machine_views``), run :func:`graph_optimize` (DP + xfer
+best-first) per mesh, optionally wrap in the λ memory search, return the
+argmin as a :class:`Strategy`.
 """
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional
 
 from flexflow_tpu.parallel.machine import MachineMesh
-from flexflow_tpu.parallel.strategy import (
-    Strategy,
-    data_parallel_strategy,
-    tensor_parallel_strategy,
-)
-from flexflow_tpu.search.cost import estimate_strategy_cost
+from flexflow_tpu.parallel.strategy import Strategy
+from flexflow_tpu.search.cost import TPUMachineModel
+from flexflow_tpu.search.memory import optimize_with_memory_budget
+from flexflow_tpu.search.substitution import graph_optimize
 from flexflow_tpu.tensor import Layer
 
 
 def unity_search(
     layers: List[Layer],
     mesh: MachineMesh,
-    budget: int = 10,
-    alpha: float = 1.2,
+    graph_inputs=None,
+    budget: int = 20,
+    alpha: float = 1.05,
+    machine: Optional[TPUMachineModel] = None,
+    mem_budget_bytes: Optional[float] = None,
+    explore_meshes: bool = True,
+    beam: int = 16,
 ) -> Strategy:
-    """Pick the cheapest strategy over candidate mesh factorizations.
+    """Pick the cheapest (mesh factorization, per-op sharding) pair.
 
-    ``budget`` bounds the number of candidates costed (reference
-    ``--budget``, ``substitution.cc:2229`` loop bound); ``alpha`` is kept
-    for API parity (pruning threshold) and used once the substitution
-    search is active.
+    ``budget``/``alpha`` mirror the reference ``--budget``/``--alpha``
+    flags (``substitution.cc:2229`` loop bound / pruning threshold);
+    ``mem_budget_bytes`` activates the λ memory search
+    (``graph.cc:2056-2131``).
     """
-    candidates: List[Strategy] = []
-    for view in mesh.enumerate_views(max_axes=0):  # (data, model) factorizations
-        candidates.append(data_parallel_strategy(layers, view))
-        if view.axis_size("model") > 1:
-            candidates.append(tensor_parallel_strategy(layers, view))
-        if len(candidates) >= budget:
-            break
-    best = min(candidates, key=lambda s: estimate_strategy_cost(layers, s))
+    if graph_inputs is None:
+        seen = set()
+        graph_inputs = []
+        produced = {t.guid for l in layers for t in l.outputs}
+        for l in layers:
+            for t in l.inputs:
+                if t.guid not in produced and t.guid not in seen:
+                    seen.add(t.guid)
+                    graph_inputs.append(t)
+
+    meshes = mesh.enumerate_views() if explore_meshes else [mesh]
+    # keep the device total fixed; dedupe degenerate permutations
+    seen_shapes = set()
+    cands = []
+    for mv in meshes:
+        if mv.shape in seen_shapes:
+            continue
+        seen_shapes.add(mv.shape)
+        cands.append(mv)
+
+    best: Optional[Strategy] = None
+    best_cost = float("inf")
+    for mv in cands:
+        def run(lam: float, _mv=mv):
+            return graph_optimize(
+                layers, graph_inputs, _mv, machine,
+                budget=budget, alpha=alpha, beam=beam, lambda_mem=lam,
+            )
+
+        try:
+            if mem_budget_bytes is not None:
+                cost, assign = optimize_with_memory_budget(
+                    run, layers, mv, mem_budget_bytes, machine=machine
+                )
+            else:
+                cost, assign = run(0.0)
+        except (AssertionError, ValueError):
+            # mesh factorization incompatible with the model's explicit
+            # parallel-op attrs (fixed degree/axis) — skip, like the
+            # reference skips invalid MachineViews
+            continue
+        if cost < best_cost:
+            best_cost = cost
+            st = Strategy(mv)
+            st.ops = assign
+            best = st
+    assert best is not None, "no feasible mesh factorization"
     return best

@@ -1,0 +1,348 @@
+"""Substitution engine — pattern-matched strategy rewrites + best-first search.
+
+Reference: ``GraphXfer`` (``include/flexflow/substitution.h:169-247``) with
+pattern graphs (``OpX``/``TensorX``, PM/TN constraints, ``substitution.h:
+39-111``), the programmatic generator set ``generate_all_pcg_xfers``
+(``src/runtime/substitution.cc:1726-1868``), best-first backtracking
+``base_optimize`` (``substitution.cc:2229-2311``) with pruning threshold
+``best_cost * alpha`` and ``--budget`` iterations, and the recursive
+``graph_optimize`` that splits at bottleneck nodes
+(``find_split_node``, ``substitution.cc:2094``).
+
+TPU-native: a substitution does not insert parallel-op *nodes* — it rewrites
+the *sharding assignment* of a matched op chain (the parallel ops exist
+implicitly as the sharding transitions GSPMD lowers to collectives).  Each
+generated xfer corresponds 1:1 to a reference generator:
+
+  partition_linear_combine      -> Linear out-dim candidate
+  replicate_linear_combine      -> Linear in-dim (partial-sum) candidate
+  partition_attention_combine   -> MHA head-partition candidate
+  partition_add/relu/softmax/.. -> elementwise follows producer's shards
+  partition_conv2d_combine      -> Conv2D out-channel candidate
+  (embedding vocab partition)   -> Embedding row-shard candidate
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from flexflow_tpu.fftype import OperatorType
+from flexflow_tpu.parallel.machine import MachineMesh
+from flexflow_tpu.parallel.strategy import OpSharding, Strategy
+from flexflow_tpu.search.candidates import op_candidates
+from flexflow_tpu.search.cost import TPUMachineModel, estimate_strategy_cost
+from flexflow_tpu.search.graph_algo import BasicGraph, imm_post_dominator
+from flexflow_tpu.tensor import Layer
+
+
+@dataclasses.dataclass
+class OpX:
+    """One pattern node (reference ``OpX``, ``substitution.h:85-111``):
+    an op-type plus an optional attribute constraint."""
+
+    op_type: OperatorType
+    constraint: Optional[Callable[[Layer], bool]] = None
+
+    def matches(self, layer: Layer) -> bool:
+        if layer.op_type is not self.op_type:
+            return False
+        return self.constraint is None or self.constraint(layer)
+
+
+@dataclasses.dataclass
+class GraphXfer:
+    """A chain pattern + a per-matched-op candidate selector.
+
+    ``select[i](candidates)`` picks the replacement OpSharding for the i-th
+    matched op from its enumerated candidate list (None = leave unchanged).
+    """
+
+    name: str
+    pattern: List[OpX]
+    select: List[Optional[Callable[[List[OpSharding]], Optional[OpSharding]]]]
+
+    def find_matches(self, layers: List[Layer]) -> List[Tuple[Layer, ...]]:
+        """All chains l0 -> l1 -> ... where l{i+1} consumes l{i}'s output."""
+        by_producer: Dict[int, List[Layer]] = {}
+        for layer in layers:
+            for t in layer.inputs:
+                if t.owner_layer is not None:
+                    by_producer.setdefault(
+                        int(t.owner_layer.layer_guid), []
+                    ).append(layer)
+        out: List[Tuple[Layer, ...]] = []
+
+        def extend(chain: Tuple[Layer, ...]) -> None:
+            i = len(chain)
+            if i == len(self.pattern):
+                out.append(chain)
+                return
+            cands = (
+                layers
+                if i == 0
+                else by_producer.get(int(chain[-1].layer_guid), [])
+            )
+            for layer in cands:
+                if self.pattern[i].matches(layer):
+                    extend(chain + (layer,))
+
+        extend(())
+        return out
+
+    def apply(
+        self,
+        assign: Dict[int, OpSharding],
+        match: Tuple[Layer, ...],
+        mesh: MachineMesh,
+        cand_cache: Optional[Dict[int, List[OpSharding]]] = None,
+    ) -> Optional[Dict[int, OpSharding]]:
+        new = dict(assign)
+        changed = False
+        for layer, sel in zip(match, self.select):
+            if sel is None:
+                continue
+            guid = int(layer.layer_guid)
+            if cand_cache is not None:
+                if guid not in cand_cache:
+                    cand_cache[guid] = op_candidates(layer, mesh)
+                cands = cand_cache[guid]
+            else:
+                cands = op_candidates(layer, mesh)
+            chosen = sel(cands)
+            if chosen is None:
+                return None
+            cur = new.get(guid)
+            if cur is None or op_sharding_key(cur) != op_sharding_key(chosen):
+                new[guid] = chosen
+                changed = True
+        return new if changed else None
+
+
+# ------------------------------------------------------------ selectors
+def _sel_channel_sharded(cands: List[OpSharding]) -> Optional[OpSharding]:
+    """Candidate whose output has a 'model'-sharded dim, no partials."""
+    for c in cands:
+        if c.output and not c.output[0].partial_axes and any(
+            "model" in c.output[0].axes_of(d) for d in range(len(c.output[0].spec))
+        ):
+            return c
+    return None
+
+
+def _sel_partial(cands: List[OpSharding]) -> Optional[OpSharding]:
+    """Candidate with a partial-sum output ('model' contraction)."""
+    for c in cands:
+        if c.output and "model" in c.output[0].partial_axes:
+            return c
+    return None
+
+
+def _sel_data_parallel(cands: List[OpSharding]) -> Optional[OpSharding]:
+    for c in cands:
+        if c.output and c.output[0].axes_of(0) == ("data",) and not any(
+            c.output[0].axes_of(d) for d in range(1, len(c.output[0].spec))
+        ) and not c.output[0].partial_axes:
+            return c
+    return None
+
+
+def _sel_replicated(cands: List[OpSharding]) -> Optional[OpSharding]:
+    return cands[0] if cands else None
+
+
+def generate_all_pcg_xfers(mesh: MachineMesh) -> List[GraphXfer]:
+    """The generator set (reference ``generate_all_pcg_xfers``,
+    ``substitution.cc:1726-1868``), parameterized by mesh-axis sizes instead
+    of per-degree divisor loops — one xfer per (pattern, target layout)."""
+    xfers: List[GraphXfer] = []
+    if mesh.axis_size("model") > 1:
+        xfers += [
+            GraphXfer(
+                "partition_linear_combine",
+                [OpX(OperatorType.LINEAR)],
+                [_sel_channel_sharded],
+            ),
+            GraphXfer(
+                "replicate_linear_combine",
+                [OpX(OperatorType.LINEAR)],
+                [_sel_partial],
+            ),
+            GraphXfer(
+                "partition_attention_combine",
+                [OpX(OperatorType.MULTIHEAD_ATTENTION)],
+                [_sel_partial],
+            ),
+            GraphXfer(
+                "partition_embedding_combine",
+                [OpX(OperatorType.EMBEDDING)],
+                [_sel_partial],
+            ),
+            GraphXfer(
+                "partition_conv2d_combine",
+                [OpX(OperatorType.CONV2D)],
+                [_sel_channel_sharded],
+            ),
+            # megatron pair: col-shard then row-shard, no intermediate gather
+            GraphXfer(
+                "partition_linear_pair",
+                [OpX(OperatorType.LINEAR), OpX(OperatorType.LINEAR)],
+                [_sel_channel_sharded, _sel_partial],
+            ),
+            GraphXfer(
+                "partition_relu_combine",
+                [OpX(OperatorType.LINEAR), OpX(OperatorType.RELU)],
+                [_sel_channel_sharded, _sel_channel_sharded],
+            ),
+            GraphXfer(
+                "partition_softmax_combine",
+                [OpX(OperatorType.SOFTMAX)],
+                [_sel_data_parallel],
+            ),
+        ]
+    if mesh.axis_size("data") > 1:
+        for op in (
+            OperatorType.LINEAR,
+            OperatorType.CONV2D,
+            OperatorType.MULTIHEAD_ATTENTION,
+            OperatorType.EMBEDDING,
+            OperatorType.EW_ADD,
+            OperatorType.CONCAT,
+        ):
+            xfers.append(
+                GraphXfer(f"partition_{op.value}_data", [OpX(op)], [_sel_data_parallel])
+            )
+    return xfers
+
+
+# ---------------------------------------------------------- best-first
+def base_optimize(
+    layers: List[Layer],
+    mesh: MachineMesh,
+    start: Dict[int, OpSharding],
+    machine: Optional[TPUMachineModel] = None,
+    budget: int = 20,
+    alpha: float = 1.05,
+    lambda_mem: float = 0.0,
+) -> Tuple[float, Dict[int, OpSharding]]:
+    """Best-first backtracking over xfer applications (reference
+    ``base_optimize``, ``substitution.cc:2229-2311``): pop the cheapest
+    assignment, try every xfer at every match, keep candidates under
+    ``alpha * best``; ``budget`` bounds pops."""
+    m = machine or TPUMachineModel()
+
+    def cost_of(assign: Dict[int, OpSharding]) -> float:
+        st = Strategy(mesh)
+        st.ops = assign
+        return estimate_strategy_cost(layers, st, m, lambda_mem=lambda_mem)
+
+    xfers = generate_all_pcg_xfers(mesh)
+    matches = [(x, mt) for x in xfers for mt in x.find_matches(layers)]
+    cand_cache: Dict[int, List[OpSharding]] = {}
+
+    best_cost = cost_of(start)
+    best = start
+    counter = itertools.count()
+    heap: List[Tuple[float, int, Dict[int, OpSharding]]] = [
+        (best_cost, next(counter), start)
+    ]
+    seen = {_assign_key(start)}
+    pops = 0
+    while heap and pops < budget:
+        cost, _, assign = heapq.heappop(heap)
+        pops += 1
+        if cost > alpha * best_cost:
+            continue
+        for xfer, mt in matches:
+            new = xfer.apply(assign, mt, mesh, cand_cache)
+            if new is None:
+                continue
+            key = _assign_key(new)
+            if key in seen:
+                continue
+            seen.add(key)
+            c = cost_of(new)
+            if c < best_cost:
+                best_cost, best = c, new
+            if c < alpha * best_cost:
+                heapq.heappush(heap, (c, next(counter), new))
+    return best_cost, best
+
+
+def op_sharding_key(s: OpSharding) -> Tuple:
+    """Value identity of one OpSharding (for change detection / memo)."""
+    return (
+        tuple((t.spec, t.partial_axes) for t in s.output),
+        tuple(sorted((k, v.spec, v.partial_axes) for k, v in s.weights.items())),
+        tuple((t.spec, t.partial_axes) for t in s.inputs),
+    )
+
+
+def _assign_key(assign: Dict[int, OpSharding]) -> Tuple:
+    return tuple((guid, op_sharding_key(assign[guid])) for guid in sorted(assign))
+
+
+# --------------------------------------------------- recursive optimize
+def find_split_node(layers: List[Layer]) -> Optional[int]:
+    """Bottleneck layer index for the recursive split (reference
+    ``find_split_node``, ``substitution.cc:2094``): the immediate
+    post-dominator of the graph's source frontier."""
+    g = BasicGraph()
+    guid_to_idx = {int(l.layer_guid): i for i, l in enumerate(layers)}
+    for layer in layers:
+        g.add_node(int(layer.layer_guid))
+        for t in layer.inputs:
+            if t.owner_layer is not None:
+                g.add_edge(int(t.owner_layer.layer_guid), int(layer.layer_guid))
+    b = imm_post_dominator(g)
+    if b is None:
+        return None
+    idx = guid_to_idx[b]
+    if idx <= 0 or idx >= len(layers) - 1:
+        return None
+    return idx
+
+
+def graph_optimize(
+    layers: List[Layer],
+    graph_inputs,
+    mesh: MachineMesh,
+    machine: Optional[TPUMachineModel] = None,
+    budget: int = 20,
+    alpha: float = 1.05,
+    beam: int = 16,
+    lambda_mem: float = 0.0,
+    _depth: int = 0,
+) -> Tuple[float, Dict[int, OpSharding]]:
+    """Recursive optimize (reference ``GraphSearchHelper::graph_optimize``,
+    ``substitution.cc:1898-1945``): split at a bottleneck node when the
+    graph is large, optimize halves independently, then refine the whole
+    assignment with a budgeted best-first xfer pass."""
+    from flexflow_tpu.search.dp import SearchHelper
+
+    if len(layers) > 24 and _depth < 3:
+        split = find_split_node(layers)
+        if split is not None and 4 < split < len(layers) - 4:
+            pre, post = layers[: split + 1], layers[split + 1 :]
+            _, a1 = graph_optimize(
+                pre, graph_inputs, mesh, machine, budget // 2 or 1, alpha,
+                beam, lambda_mem, _depth + 1,
+            )
+            post_inputs = [t for l in post for t in l.inputs
+                           if t.owner_layer is None or t.owner_layer in pre]
+            _, a2 = graph_optimize(
+                post, post_inputs, mesh, machine, budget // 2 or 1, alpha,
+                beam, lambda_mem, _depth + 1,
+            )
+            merged = {**a1, **a2}
+            return base_optimize(
+                layers, mesh, merged, machine, budget, alpha, lambda_mem
+            )
+
+    helper = SearchHelper(
+        layers, graph_inputs, mesh, machine, beam=beam, lambda_mem=lambda_mem
+    )
+    _, assign = helper.solve()
+    return base_optimize(layers, mesh, assign, machine, budget, alpha, lambda_mem)

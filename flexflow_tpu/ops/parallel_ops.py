@@ -32,7 +32,7 @@ from typing import List, Optional
 from flexflow_tpu.fftype import OperatorType
 from flexflow_tpu.ops.base import OpContext, OpDef, ShapeDtype, register_op
 from flexflow_tpu.parallel.machine import MachineMesh
-from flexflow_tpu.parallel.spec import TensorSharding
+from flexflow_tpu.parallel.spec import ShardingError, TensorSharding
 from flexflow_tpu.tensor import Layer
 
 
@@ -124,14 +124,15 @@ def _pick_axis(
     binding a parallel op to a MachineView at compile,
     ``src/runtime/model.cc:2921-2940``)."""
     if preferred is not None:
-        assert mesh.axis_size(preferred) == degree, (
-            f"axis {preferred} has size {mesh.axis_size(preferred)}, want {degree}"
-        )
+        if mesh.axis_size(preferred) != degree:
+            raise ShardingError(
+                f"axis {preferred} has size {mesh.axis_size(preferred)}, want {degree}"
+            )
         return preferred
     for name in mesh.axis_names:
         if mesh.axis_size(name) == degree and name not in used:
             return name
-    raise ValueError(
+    raise ShardingError(
         f"no free mesh axis of size {degree} in {mesh} (used={used})"
     )
 
@@ -159,10 +160,11 @@ def _apply_one(
                 break
             peel.append(a)
             removed *= mesh.axis_size(a)
-        assert removed == degree, (
-            f"combine degree {degree} is not a suffix product of axes {axes} "
-            f"(sizes {[mesh.axis_size(a) for a in axes]})"
-        )
+        if removed != degree:
+            raise ShardingError(
+                f"combine degree {degree} is not a suffix product of axes {axes} "
+                f"(sizes {[mesh.axis_size(a) for a in axes]})"
+            )
         keep = tuple(a for a in axes if a not in peel)
         spec = list(sh.spec)
         spec[dim] = None if not keep else (keep[0] if len(keep) == 1 else keep)

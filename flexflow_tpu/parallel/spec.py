@@ -25,6 +25,12 @@ from flexflow_tpu.parallel.machine import MachineMesh
 AxisSpec = Union[None, str, Tuple[str, ...]]
 
 
+class ShardingError(ValueError):
+    """A sharding transition/assignment is infeasible on the given mesh
+    (axis size mismatch, axis reuse, non-divisible dim).  The search treats
+    this as 'skip this candidate/mesh', distinct from programming errors."""
+
+
 @dataclasses.dataclass(frozen=True)
 class ParallelDim:
     """Per-dim sharding record (reference ``parallel_tensor.h:36-71``).
@@ -135,9 +141,8 @@ class TensorSharding:
         degree-matching no-op case)."""
         if axis in self.axes_of(dim):
             return self
-        assert axis not in self.used_axes(), (
-            f"axis {axis} already shards another dim in {self}"
-        )
+        if axis in self.used_axes():
+            raise ShardingError(f"axis {axis} already shards another dim in {self}")
         spec = list(self.spec)
         spec[dim] = self.axes_of(dim) + (axis,) if self.axes_of(dim) else axis
         return TensorSharding(spec=tuple(spec), partial_axes=self.partial_axes)
@@ -168,6 +173,11 @@ class TensorSharding:
 
     def with_partial(self, axis: str) -> "TensorSharding":
         return TensorSharding(spec=self.spec, partial_axes=self.partial_axes + (axis,))
+
+    def key(self) -> Tuple:
+        """Value identity for memoization/dedup (single source of truth —
+        used by the DP frontier, substitution memo, and candidate dedup)."""
+        return (self.spec, self.partial_axes)
 
     def __repr__(self) -> str:
         parts = ",".join(

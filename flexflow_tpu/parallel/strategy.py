@@ -45,6 +45,14 @@ class OpSharding:
     weights: Dict[str, TensorSharding] = dataclasses.field(default_factory=dict)
     inputs: List[TensorSharding] = dataclasses.field(default_factory=list)
 
+    def key(self) -> tuple:
+        """Value identity (memoization/dedup/change detection)."""
+        return (
+            tuple(t.key() for t in self.output),
+            tuple(sorted((k, v.key()) for k, v in self.weights.items())),
+            tuple(t.key() for t in self.inputs),
+        )
+
 
 class Strategy:
     def __init__(self, mesh: MachineMesh) -> None:

@@ -17,6 +17,12 @@ from flexflow_tpu.search.cost import TPUMachineModel, estimate_strategy_cost
 from flexflow_tpu.search.dp import SearchHelper
 from flexflow_tpu.search.memory import strategy_memory_per_device
 from flexflow_tpu.search.optimizer import unity_search
+from flexflow_tpu.search.simulator import (
+    MeasuredCostModel,
+    OpProfiler,
+    profile_strategy,
+    simulate_strategy,
+)
 from flexflow_tpu.search.substitution import (
     GraphXfer,
     base_optimize,
@@ -26,12 +32,16 @@ from flexflow_tpu.search.substitution import (
 
 __all__ = [
     "GraphXfer",
+    "MeasuredCostModel",
+    "OpProfiler",
     "SearchHelper",
     "TPUMachineModel",
     "base_optimize",
     "estimate_strategy_cost",
     "generate_all_pcg_xfers",
     "graph_optimize",
+    "profile_strategy",
+    "simulate_strategy",
     "strategy_memory_per_device",
     "unity_search",
 ]

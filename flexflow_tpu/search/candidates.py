@@ -122,11 +122,7 @@ def _weights_for(
 def _dedup(cands: List[OpSharding]) -> List[OpSharding]:
     seen, out = set(), []
     for c in cands:
-        key = (
-            tuple((t.spec, t.partial_axes) for t in c.output),
-            tuple(sorted((k, v.spec, v.partial_axes) for k, v in c.weights.items())),
-            tuple((t.spec, t.partial_axes) for t in c.inputs),
-        )
+        key = c.key()
         if key not in seen:
             seen.add(key)
             out.append(c)

@@ -161,6 +161,7 @@ def node_cost(
     mesh: MachineMesh,
     machine: Optional[TPUMachineModel] = None,
     lambda_mem: float = 0.0,
+    compute_time: Optional[float] = None,
 ) -> float:
     """Compute + weight-grad-sync time for one op under one sharding choice
     (the DP's leaf cost — reference ``SearchHelper::graph_cost`` leaf at
@@ -177,7 +178,8 @@ def node_cost(
         degree = out0.total_degree(mesh)
         for a in out0.partial_axes:
             degree *= mesh.axis_size(a)
-    t = op_compute_time(layer, degree, m)
+    # measured tier (simulator.MeasuredCostModel) overrides the roofline
+    t = compute_time if compute_time is not None else op_compute_time(layer, degree, m)
 
     opdef = get_op_def(layer.op_type)
     # gradient sync: weight grads are partial over every mesh axis that
@@ -205,7 +207,9 @@ def node_cost(
         out_b = sum(
             math.prod(s) * _dtype_nbytes(dt) for s, dt in opdef.infer(layer)
         )
-        t += lambda_mem * (out_b / max(1, degree))
+        # memory degree excludes partial axes (partial sums are full-size
+        # per device along those axes)
+        t += lambda_mem * (out_b / max(1, out0.total_degree(mesh)))
     return t
 
 
@@ -214,6 +218,7 @@ def estimate_strategy_cost(
     strategy: Strategy,
     machine: Optional[TPUMachineModel] = None,
     lambda_mem: float = 0.0,
+    node_time_fn=None,
 ) -> float:
     """Per-step time estimate for a whole strategy: node costs (compute +
     weight-grad sync) + per-edge reshard collectives.  Pure function of the
@@ -256,7 +261,14 @@ def estimate_strategy_cost(
                     for s, _ in get_op_def(layer.op_type).infer(layer)
                 ]
             )
-        total += node_cost(layer, os_, mesh, m, lambda_mem=lambda_mem)
+        total += node_cost(
+            layer,
+            os_,
+            mesh,
+            m,
+            lambda_mem=lambda_mem,
+            compute_time=node_time_fn(layer, os_) if node_time_fn else None,
+        )
         for i, t in enumerate(layer.inputs):
             src = producer_sharding(t)
             if src is None:

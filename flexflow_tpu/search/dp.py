@@ -26,8 +26,6 @@ from __future__ import annotations
 import heapq
 from typing import Dict, List, Optional, Tuple
 
-from flexflow_tpu.fftype import OperatorType
-from flexflow_tpu.ops.base import get_op_def
 from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
 from flexflow_tpu.parallel.machine import MachineMesh
 from flexflow_tpu.parallel.spec import TensorSharding
@@ -43,7 +41,7 @@ from flexflow_tpu.tensor import Layer, Tensor
 
 
 def _sh_key(sh: TensorSharding) -> Tuple:
-    return (sh.spec, sh.partial_axes)
+    return sh.key()
 
 
 class SearchHelper:

@@ -46,11 +46,11 @@ def strategy_memory_per_device(
             total += wb * factor / deg
         for i, (shape, dt) in enumerate(opdef.infer(layer)):
             ob = math.prod(shape) * _dtype_bytes(dt)
+            # NOTE: partial axes do NOT divide memory — a partial-sum tensor
+            # is full (local) size on every device along its partial axes
             deg = 1
             if s and i < len(s.output):
                 deg = s.output[i].total_degree(mesh)
-                for a in s.output[i].partial_axes:
-                    deg *= mesh.axis_size(a)
             total += ob / deg
     return total
 

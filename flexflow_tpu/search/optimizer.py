@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from flexflow_tpu.parallel.machine import MachineMesh
+from flexflow_tpu.parallel.spec import ShardingError
 from flexflow_tpu.parallel.strategy import Strategy
 from flexflow_tpu.search.cost import TPUMachineModel
 from flexflow_tpu.search.memory import optimize_with_memory_budget
@@ -79,7 +80,7 @@ def unity_search(
                 )
             else:
                 cost, assign = run(0.0)
-        except (AssertionError, ValueError):
+        except ShardingError:
             # mesh factorization incompatible with the model's explicit
             # parallel-op attrs (fixed degree/axis) — skip, like the
             # reference skips invalid MachineViews

@@ -1,0 +1,321 @@
+"""Measured-cost tier of the simulator (SURVEY §2.2 S3).
+
+Reference: ``Simulator`` (``include/flexflow/simulator.h:691-778``) —
+``measure_operator_cost`` (``src/runtime/simulator.cc:537-577``) runs each
+(op-params, MachineView) pair's real kernels on device with CUDA-event
+timing (``Op::inner_measure_operator_cost``, ``src/runtime/model.cu:38-74``),
+caches by hash (``strict_hash_to_operator_cost``), and feeds the DP; a full
+event-driven task-graph simulation also exists (``simulate_runtime``,
+``simulator.cc:822-1250``).
+
+TPU-native differences (SURVEY §7.3 risk register):
+  * XLA fuses across ops, so isolated per-op timing mispredicts fused
+    reality; measured times are therefore an *upper bound* refinement over
+    the analytic roofline, and the unit of measurement is one op's
+    fwd+bwd jitted in isolation at its per-shard local shape.
+  * Timing uses wall clock around ``block_until_ready`` (no CUDA events);
+    compile time is excluded by warmup.
+  * The cache is a JSON file — deterministic replay in CI (the gap noted
+    in SURVEY §4.7: the reference's measured costs are not reproducible).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flexflow_tpu.fftype import DataType
+from flexflow_tpu.ops.base import OpContext, get_op_def
+from flexflow_tpu.parallel.machine import MachineMesh
+from flexflow_tpu.parallel.strategy import OpSharding, Strategy
+from flexflow_tpu.search.cost import (
+    TPUMachineModel,
+    _dtype_nbytes,
+    op_compute_time,
+    reshard_cost,
+)
+from flexflow_tpu.tensor import Layer, Tensor
+
+
+def _local_shape(shape: Tuple[int, ...], sharding, mesh: MachineMesh) -> Tuple[int, ...]:
+    """Per-shard shape under a TensorSharding (sub-tensor extraction analog,
+    ``ParallelTensorBase::get_sub_tensor``, ``parallel_tensor.h:149``)."""
+    out = list(shape)
+    if sharding is None:
+        return tuple(out)
+    for d in range(len(shape)):
+        deg = sharding.dim_degree(d, mesh)
+        if deg > 1 and out[d] % deg == 0:
+            out[d] //= deg
+    return tuple(out)
+
+
+class OpProfiler:
+    """Compile-and-time profiler with a persistent cost cache.
+
+    Cache key: ``(layer.params_key(), local input shapes)`` — the analog of
+    the reference's (OperatorParameters, MachineView) hash.
+    """
+
+    def __init__(self, cache_file: Optional[str] = None, iters: int = 5) -> None:
+        self.cache_file = cache_file
+        self.iters = iters
+        self.cache: Dict[str, float] = {}
+        if cache_file and os.path.exists(cache_file):
+            with open(cache_file) as f:
+                loaded = json.load(f)
+            self.cache = {k: v for k, v in loaded.items() if v > 0}
+
+    def save(self) -> None:
+        if self.cache_file:
+            with open(self.cache_file, "w") as f:
+                json.dump(self.cache, f, indent=1, sort_keys=True)
+
+    @staticmethod
+    def _key(layer: Layer, local_in: List[Tuple[int, ...]]) -> str:
+        return repr((layer.params_key(), tuple(local_in)))
+
+    def measure(
+        self, layer: Layer, sharding: Optional[OpSharding], mesh: MachineMesh
+    ) -> float:
+        """Seconds for one fwd+bwd of this op at its per-shard shapes."""
+        out0 = sharding.output[0] if sharding and sharding.output else None
+        local_in = []
+        for i, t in enumerate(layer.inputs):
+            ts = None
+            if sharding and i < len(sharding.inputs):
+                ts = sharding.inputs[i]
+            elif out0 is not None and t.shape == (
+                layer.outputs[0].shape if layer.outputs else None
+            ):
+                ts = out0
+            local_in.append(_local_shape(t.shape, ts, mesh))
+        key = self._key(layer, local_in)
+        if key in self.cache:
+            return self.cache[key]
+        t = self._run(layer, local_in, sharding, mesh)
+        if t > 0:  # never cache the failure sentinel — retry next session
+            self.cache[key] = t
+        return t
+
+    def _run(
+        self,
+        layer: Layer,
+        local_in: List[Tuple[int, ...]],
+        sharding: Optional[OpSharding],
+        mesh: MachineMesh,
+    ) -> float:
+        import jax
+        import jax.numpy as jnp
+
+        opdef = get_op_def(layer.op_type)
+        rng = np.random.default_rng(0)
+
+        def mk(shape, dt: DataType):
+            if dt in (DataType.INT32, DataType.INT64):
+                return jnp.asarray(rng.integers(0, 2, size=shape), dt.to_jnp())
+            return jnp.asarray(rng.normal(size=shape), dt.to_jnp())
+
+        ins = [mk(s, t.dtype) for s, t in zip(local_in, layer.inputs)]
+        params = {}
+        for w in opdef.weights(layer):
+            ws = sharding.weights.get(w.name) if sharding else None
+            params[w.name] = mk(_local_shape(w.shape, ws, mesh), w.dtype)
+
+        float_in = [
+            i for i, x in enumerate(ins) if jnp.issubdtype(x.dtype, jnp.inexact)
+        ]
+
+        def fwd_loss(p, xs):
+            full = list(ins)
+            for i, x in zip(float_in, xs):
+                full[i] = x
+            outs = opdef.forward(layer, p, full, OpContext(training=False))
+            return sum(
+                jnp.sum(o.astype(jnp.float32))
+                for o in outs
+                if jnp.issubdtype(o.dtype, jnp.floating)
+            )
+
+        xs = [ins[i] for i in float_in]
+        has_grad = bool(params) or bool(xs)
+        if has_grad:
+            fn = jax.jit(jax.value_and_grad(fwd_loss, argnums=(0, 1)))
+        else:
+            fn = jax.jit(fwd_loss)
+        try:
+            out = fn(params, xs)  # compile + warmup
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(self.iters):
+                out = fn(params, xs)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / self.iters
+        except Exception:
+            # ops that need training ctx/rng or that fail to trace in
+            # isolation fall back to the analytic roofline
+            return -1.0
+
+
+class MeasuredCostModel:
+    """Cost provider blending measured per-op times with the analytic model
+    (measured when available and positive, roofline otherwise).  Plug into
+    ``SearchHelper``/``estimate_strategy_cost`` via ``node_time_fn``."""
+
+    def __init__(
+        self,
+        profiler: OpProfiler,
+        mesh: MachineMesh,
+        machine: Optional[TPUMachineModel] = None,
+    ) -> None:
+        self.profiler = profiler
+        self.mesh = mesh
+        self.machine = machine or TPUMachineModel()
+
+    def node_time(self, layer: Layer, sharding: Optional[OpSharding]) -> float:
+        t = self.profiler.measure(layer, sharding, self.mesh)
+        if t > 0:
+            return t
+        out0 = sharding.output[0] if sharding and sharding.output else None
+        degree = 1
+        if out0 is not None:
+            degree = out0.total_degree(self.mesh)
+            for a in out0.partial_axes:
+                degree *= self.mesh.axis_size(a)
+        return op_compute_time(layer, degree, self.machine)
+
+
+# ----------------------------------------------------- event-driven sim
+class SimTask:
+    __slots__ = ("name", "duration", "stream", "deps", "start", "end")
+
+    def __init__(self, name: str, duration: float, stream: str, deps: List["SimTask"]):
+        self.name = name
+        self.duration = duration
+        self.stream = stream
+        self.deps = deps
+        self.start = 0.0
+        self.end = 0.0
+
+
+def simulate_strategy(
+    layers: List[Layer],
+    strategy: Strategy,
+    machine: Optional[TPUMachineModel] = None,
+    node_time_fn: Optional[Callable[[Layer, Optional[OpSharding]], float]] = None,
+) -> float:
+    """Event-driven makespan of one training step (reference
+    ``simulate_runtime``, ``src/runtime/simulator.cc:822-1250``).
+
+    Two streams per device — ``compute`` (MXU/VPU) and ``comm`` (ICI DMA)
+    — with dependency-respecting overlap; this models XLA's async
+    collectives overlapping compute, which the flat sum in
+    ``estimate_strategy_cost`` cannot.  Deterministic given the cost table.
+    """
+    m = machine or TPUMachineModel()
+    mesh = strategy.mesh
+    from flexflow_tpu.search.cost import node_cost
+
+    from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
+    from flexflow_tpu.parallel.spec import TensorSharding
+
+    tasks: List[SimTask] = []
+    produced: Dict[int, SimTask] = {}  # tensor guid -> producing task
+    out_sh: Dict[int, TensorSharding] = {}  # tensor guid -> actual layout
+
+    def producer_sharding(t) -> Optional[TensorSharding]:
+        if t.guid in out_sh:
+            return out_sh[t.guid]
+        if t.owner_layer is None:
+            return None
+        ps = strategy.op_sharding(t.owner_layer)
+        if ps and t.owner_idx < len(ps.output):
+            return ps.output[t.owner_idx]
+        return None
+
+    for layer in layers:
+        if layer.op_type.is_parallel_op:
+            t = layer.inputs[0]
+            src_task = produced.get(t.guid)
+            src_sh = producer_sharding(t) or TensorSharding.replicated(t.ndim)
+            dst_sh = resolve_parallel_sharding(layer, src_sh, mesh)
+            dur = reshard_cost(t.shape, _dtype_nbytes(t.dtype), src_sh, dst_sh, mesh, m)
+            task = SimTask(layer.name, dur, "comm", [src_task] if src_task else [])
+            tasks.append(task)
+            for o in layer.outputs:
+                produced[o.guid] = task
+                out_sh[o.guid] = dst_sh
+            continue
+        s = strategy.op_sharding(layer)
+        deps: List[SimTask] = []
+        comm_deps: List[SimTask] = []
+        for i, t in enumerate(layer.inputs):
+            p = produced.get(t.guid)
+            if p is None:
+                continue
+            # edge reshard -> comm task between producer and consumer.
+            # Same semantics as estimate_strategy_cost: an explicit input
+            # requirement is honored; otherwise partial sums and channel
+            # shards the consumer didn't ask for must still be resolved.
+            src = producer_sharding(t)
+            dst = s.inputs[i] if s and i < len(s.inputs) else None
+            if src is not None and dst is None and (
+                src.partial_axes
+                or any("model" in src.axes_of(d) for d in range(len(src.spec)))
+            ):
+                dst = TensorSharding.replicated(t.ndim)
+            if src is not None and dst is not None and src.key() != dst.key():
+                dur = reshard_cost(
+                    t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m
+                )
+                if dur > 0:
+                    ct = SimTask(f"reshard:{t.name}->{layer.name}", dur, "comm", [p])
+                    tasks.append(ct)
+                    comm_deps.append(ct)
+                    continue
+            deps.append(p)
+        if node_time_fn is not None:
+            dur = node_time_fn(layer, s)
+        else:
+            from flexflow_tpu.parallel.spec import TensorSharding
+
+            s_eff = s or OpSharding(
+                output=[
+                    TensorSharding.replicated(len(sh))
+                    for sh, _ in get_op_def(layer.op_type).infer(layer)
+                ]
+            )
+            dur = node_cost(layer, s_eff, mesh, m)
+        task = SimTask(layer.name, dur, "compute", deps + comm_deps)
+        tasks.append(task)
+        for o in layer.outputs:
+            produced[o.guid] = task
+
+    # list-schedule over the two streams
+    stream_free = {"compute": 0.0, "comm": 0.0}
+    for task in tasks:  # already topological
+        ready = max((d.end for d in task.deps), default=0.0)
+        task.start = max(ready, stream_free[task.stream])
+        task.end = task.start + task.duration
+        stream_free[task.stream] = task.end
+    return max((t.end for t in tasks), default=0.0)
+
+
+def profile_strategy(
+    layers: List[Layer],
+    strategy: Strategy,
+    cache_file: Optional[str] = None,
+    machine: Optional[TPUMachineModel] = None,
+) -> Tuple[float, OpProfiler]:
+    """Measure every op in the strategy and return the simulated step time
+    (the ``--taskgraph``-style offline analysis entry)."""
+    prof = OpProfiler(cache_file)
+    mcm = MeasuredCostModel(prof, strategy.mesh, machine)
+    t = simulate_strategy(layers, strategy, machine, node_time_fn=mcm.node_time)
+    prof.save()
+    return t, prof

@@ -272,16 +272,12 @@ def base_optimize(
 
 
 def op_sharding_key(s: OpSharding) -> Tuple:
-    """Value identity of one OpSharding (for change detection / memo)."""
-    return (
-        tuple((t.spec, t.partial_axes) for t in s.output),
-        tuple(sorted((k, v.spec, v.partial_axes) for k, v in s.weights.items())),
-        tuple((t.spec, t.partial_axes) for t in s.inputs),
-    )
+    """Value identity of one OpSharding (delegates to OpSharding.key)."""
+    return s.key()
 
 
 def _assign_key(assign: Dict[int, OpSharding]) -> Tuple:
-    return tuple((guid, op_sharding_key(assign[guid])) for guid in sorted(assign))
+    return tuple((guid, assign[guid].key()) for guid in sorted(assign))
 
 
 # --------------------------------------------------- recursive optimize

@@ -86,6 +86,46 @@ class MultiHeadAttention(OpDef):
         v = (v_in @ params["wv"]).reshape(b, sk, h, vd).transpose(0, 2, 1, 3)
 
         dropout = a.get("dropout", 0.0) if ctx.training else 0.0
+
+        # Sequence/context parallelism: if the query's seq dim arrives
+        # sharded (strategy put a mesh axis on dim 1), run the attention
+        # core under shard_map — ring by default, Ulysses all-to-all when
+        # requested and heads divide.  (New capability vs the reference,
+        # SURVEY §2.4 checklist: SP/CP absent there.)  Both query and key
+        # sequence lengths must divide the seq-axis size; otherwise (e.g.
+        # ragged cross-attention) fall back to the global path.
+        sp_axis = ctx.seq_axis(0, dim=1)
+        sp = ctx.mesh.shape[sp_axis] if sp_axis is not None else 1
+        if sp_axis is not None and sq % sp == 0 and sk % sp == 0:
+            from flexflow_tpu.parallel.sequence import (
+                ring_attention,
+                ulysses_attention,
+            )
+
+            causal = a.get("causal", False)
+            impl = None
+            if ctx.op_sharding is not None:
+                impl = ctx.op_sharding.extras.get("sp_impl")
+            impl = impl or a.get("sp_impl", "ring")
+            # DP/TP composition: keep batch and head dims sharded on their
+            # existing mesh axes inside the shard_map region.
+            head_axis = ctx.weight_axis("wq", 1)
+            b_axes = ctx.input_shardings[0].axes_of(0) if ctx.input_shardings else ()
+            batch_axis = b_axes[0] if b_axes else None
+            kw = dict(
+                mesh=ctx.mesh, axis=sp_axis, causal=causal,
+                head_axis=head_axis, batch_axis=batch_axis,
+                dropout_rate=dropout,
+                rng=ctx.next_rng() if dropout > 0.0 else None,
+            )
+            h_local = h // (ctx.mesh.shape[head_axis] if head_axis else 1)
+            if impl == "ulysses" and h_local % sp == 0:
+                out = ulysses_attention(q, k, v, **kw)
+            else:
+                out = ring_attention(q, k, v, **kw)
+            out = out.transpose(0, 2, 1, 3).reshape(b, sq, h * vd)
+            return [out @ params["wo"]]
+
         use_flash = a.get("use_flash", True) and dropout == 0.0
         if use_flash and _flash_ok(sq, sk, kd):
             from flexflow_tpu.ops.pallas.flash_attention import flash_attention

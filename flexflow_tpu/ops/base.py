@@ -53,12 +53,47 @@ class WeightSpec:
 
 
 class OpContext:
-    """Per-trace context handed to ``forward``: training flag + per-layer rng."""
+    """Per-trace context handed to ``forward``: training flag, per-layer rng,
+    and — for ops that open a ``shard_map`` region (ring/Ulysses attention,
+    MoE all-to-all dispatch) — the live mesh plus the incoming distribution
+    of each input (``input_shardings``)."""
 
-    def __init__(self, training: bool, rng: Optional[jax.Array] = None) -> None:
+    def __init__(
+        self,
+        training: bool,
+        rng: Optional[jax.Array] = None,
+        mesh: Optional[Any] = None,
+        input_shardings: Optional[Sequence[Any]] = None,
+        op_sharding: Optional[Any] = None,
+    ) -> None:
         self.training = training
         self._rng = rng
         self._counter = 0
+        self.mesh = mesh
+        self.input_shardings = input_shardings
+        self.op_sharding = op_sharding
+
+    def weight_axis(self, wname: str, dim: int) -> Optional[str]:
+        """Mesh axis sharding dim ``dim`` of weight ``wname`` under the
+        current strategy (None if replicated)."""
+        if self.op_sharding is None or wname not in self.op_sharding.weights:
+            return None
+        axes = self.op_sharding.weights[wname].axes_of(dim)
+        return axes[0] if axes else None
+
+    def seq_axis(self, input_idx: int = 0, dim: int = 1) -> Optional[str]:
+        """Mesh axis sharding ``dim`` of input ``input_idx`` (None if
+        replicated or no sharding context) — the signal sequence-parallel
+        ops key off."""
+        if self.mesh is None or not self.input_shardings:
+            return None
+        if input_idx >= len(self.input_shardings):
+            return None
+        sh = self.input_shardings[input_idx]
+        if sh is None or dim >= len(sh.spec):
+            return None
+        axes = sh.axes_of(dim)
+        return axes[0] if axes else None
 
     def next_rng(self) -> jax.Array:
         assert self._rng is not None, "op needs rng but none provided"

@@ -134,7 +134,10 @@ class Dropout(OpDef):
 
     def partitionable_dims(self, layer):
         t = layer.inputs[0]
-        return {i: ("sample" if i == 0 else "channel") for i in range(t.ndim)}
+        d = {i: ("sample" if i == 0 else "channel") for i in range(t.ndim)}
+        if t.ndim >= 3:
+            d[1] = "seq"  # (B, S, ...) activations: dim 1 is sequence
+        return d
 
 
 register_op(LayerNorm())

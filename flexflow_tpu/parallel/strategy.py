@@ -43,14 +43,26 @@ class OpSharding:
 
     output: List[TensorSharding]
     weights: Dict[str, TensorSharding] = dataclasses.field(default_factory=dict)
-    inputs: List[TensorSharding] = dataclasses.field(default_factory=list)
+    inputs: List[Optional[TensorSharding]] = dataclasses.field(default_factory=list)
+    # strategy-scoped op knobs (e.g. sp_impl for attention) — kept here, not
+    # on Layer.attrs, so evaluating a candidate never mutates the graph
+    extras: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     def key(self) -> tuple:
         """Value identity (memoization/dedup/change detection)."""
         return (
             tuple(t.key() for t in self.output),
             tuple(sorted((k, v.key()) for k, v in self.weights.items())),
-            tuple(t.key() for t in self.inputs),
+            tuple(None if t is None else t.key() for t in self.inputs),
+            tuple(sorted(self.extras.items())),
+        )
+
+    def copy(self) -> "OpSharding":
+        return OpSharding(
+            output=list(self.output),
+            weights=dict(self.weights),
+            inputs=list(self.inputs),
+            extras=dict(self.extras),
         )
 
 
@@ -81,7 +93,8 @@ class Strategy:
                     str(guid): {
                         "output": [enc_ts(t) for t in s.output],
                         "weights": {k: enc_ts(v) for k, v in s.weights.items()},
-                        "inputs": [enc_ts(t) for t in s.inputs],
+                        "inputs": [None if t is None else enc_ts(t) for t in s.inputs],
+                        "extras": s.extras,
                     }
                     for guid, s in self.ops.items()
                 },
@@ -106,7 +119,8 @@ class Strategy:
             st.ops[int(guid)] = OpSharding(
                 output=[dec_ts(t) for t in s["output"]],
                 weights={k: dec_ts(v) for k, v in s["weights"].items()},
-                inputs=[dec_ts(t) for t in s.get("inputs", [])],
+                inputs=[None if t is None else dec_ts(t) for t in s.get("inputs", [])],
+                extras=dict(s.get("extras", {})),
             )
         return st
 
@@ -138,6 +152,87 @@ def data_parallel_strategy(layers: List[Layer], mesh: MachineMesh) -> Strategy:
                 spec[0] = "data"
             shardings.append(TensorSharding(spec=tuple(spec)))
         st.ops[int(layer.layer_guid)] = OpSharding(output=shardings, weights={})
+    return st
+
+
+def sequence_parallel_strategy(
+    layers: List[Layer],
+    mesh: MachineMesh,
+    sp_axis: str = "seq",
+    dp_axis: str = "data",
+    impl: str = "ring",
+    base: Optional[Strategy] = None,
+) -> Strategy:
+    """Sequence/context parallelism: shard the sequence dim (logical dim 1
+    of (B, S, ...) activations) over ``sp_axis`` wherever it divides, on top
+    of the usual batch sharding.  Attention ops see their seq dim sharded
+    and open a ring / Ulysses shard_map region
+    (:mod:`flexflow_tpu.parallel.sequence`); every other op is seq-local so
+    GSPMD keeps it communication-free.
+
+    ``impl``: "ring" (ppermute K/V rotation) or "ulysses" (all-to-all
+    head/seq swap) — recorded on attention layers as ``sp_impl``.
+
+    New capability vs the reference (SURVEY §2.4: SP/CP not implemented
+    there), expressed in the same per-op sharding vocabulary.
+
+    ``base``: overlay on an existing strategy (e.g. tensor_parallel) to
+    compose dp×tp×sp hybrids; defaults to the all-DP strategy.
+    """
+    src = base if base is not None else data_parallel_strategy(layers, mesh)
+    sp = mesh.axis_size(sp_axis)
+    if sp <= 1:
+        return src
+    # overlay on a copy — never mutate the caller's base strategy or the graph
+    st = Strategy(mesh)
+    st.ops = {guid: s.copy() for guid, s in src.ops.items()}
+    dp = mesh.axis_size(dp_axis)
+    produced = {t.guid for l in layers for t in l.outputs}
+    for layer in layers:
+        if layer.op_type.is_parallel_op:
+            continue
+        opdef = get_op_def(layer.op_type)
+        pdims = opdef.partitionable_dims(layer)
+        entry = st.ops[int(layer.layer_guid)]
+        outs = opdef.infer(layer)
+        for i, (shape, _) in enumerate(outs):
+            if i >= len(entry.output):
+                break
+            # shard dim 1 when the op declares it a seq dim, or (rank>=3
+            # activations) when it is not the sample/channel dim
+            seq_ok = pdims.get(1) == "seq" or (
+                len(shape) >= 3 and 1 not in pdims
+            )
+            if seq_ok and len(shape) >= 2 and shape[1] % sp == 0:
+                o = entry.output[i]
+                if sp_axis in o.used_axes():
+                    continue
+                spec = list(o.spec)
+                spec[1] = sp_axis
+                entry.output[i] = TensorSharding(
+                    spec=tuple(spec), partial_axes=o.partial_axes
+                )
+        # graph inputs feed this op directly — declare their distribution so
+        # the executor places them seq-sharded instead of replicated (the
+        # analog of the reference co-sharding the label tensor with its
+        # consumer, model.cc:3086-3124)
+        for j, t in enumerate(layer.inputs):
+            if t.guid in produced or t.ndim < 2:
+                continue
+            spec: List = [None] * t.ndim
+            if dp > 1 and t.shape[0] % dp == 0:
+                spec[0] = dp_axis
+            # dim 1 of a graph input is "sequence" for rank>=3 activations
+            # and for token-id inputs (B, S) feeding an embedding; for
+            # rank-2 feature inputs it is a channel dim — leave it alone
+            seq_like = t.ndim >= 3 or layer.op_type is OperatorType.EMBEDDING
+            if seq_like and t.shape[1] % sp == 0:
+                spec[1] = sp_axis
+            while len(entry.inputs) <= j:
+                entry.inputs.append(None)
+            entry.inputs[j] = TensorSharding(spec=tuple(spec))
+        if layer.op_type is OperatorType.MULTIHEAD_ATTENTION:
+            entry.extras.setdefault("sp_impl", impl)
     return st
 
 

@@ -89,9 +89,23 @@ class Executor:
         return jax.lax.with_sharding_constraint(x, NamedSharding(self.mesh, pspec))
 
     def _input_pspec(self, t: Tensor) -> PartitionSpec:
-        """Inputs follow the first consumer's batch sharding; labels are
+        """Inputs take the strategy's declared input sharding of their first
+        consumer when one exists (e.g. seq-parallel strategies declare
+        graph inputs seq-sharded so layer-0 attention sees a sharded seq
+        dim); otherwise they follow the default batch sharding.  Labels are
         co-sharded with the final op (reference label-tensor creation,
         ``model.cc:3086-3124``)."""
+        for layer in self.layers:
+            for j, it in enumerate(layer.inputs):
+                if it.guid != t.guid:
+                    continue
+                op_sh = self.strategy.op_sharding(layer)
+                if op_sh is not None and j < len(op_sh.inputs) and op_sh.inputs[j] is not None:
+                    return op_sh.inputs[j].partition_spec()
+                break  # first consumer decides
+            else:
+                continue
+            break
         if self.strategy.mesh.axis_size("data") > 1 and t.shape[0] % self.strategy.mesh.axis_size("data") == 0:
             return PartitionSpec("data")
         return PartitionSpec()
@@ -128,6 +142,9 @@ class Executor:
             ctx = OpContext(
                 training=training,
                 rng=jax.random.fold_in(rng, hash(layer.name) % (2**31)) if rng is not None else None,
+                mesh=self.mesh,
+                input_shardings=[shardings.get(t.guid) for t in layer.inputs],
+                op_sharding=self.strategy.op_sharding(layer),
             )
             if self.use_remat and layer.op_type in _REMAT_OPS:
                 outs = jax.checkpoint(

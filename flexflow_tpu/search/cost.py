@@ -273,15 +273,12 @@ def estimate_strategy_cost(
             src = producer_sharding(t)
             if src is None:
                 continue
-            dst = (
-                os_.inputs[i]
-                if i < len(os_.inputs)
-                else TensorSharding.replicated(t.ndim)
-            )
+            explicit = i < len(os_.inputs) and os_.inputs[i] is not None
+            dst = os_.inputs[i] if explicit else TensorSharding.replicated(t.ndim)
             # without an explicit requirement, batch-compatible layouts pass
             # through free (GSPMD keeps them); only charge when src carries
             # partials or channel shards the consumer didn't ask for
-            if i >= len(os_.inputs) and not src.partial_axes and not any(
+            if not explicit and not src.partial_axes and not any(
                 "model" in src.axes_of(d) for d in range(len(src.spec))
             ):
                 continue

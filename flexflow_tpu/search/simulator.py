@@ -22,7 +22,6 @@ TPU-native differences (SURVEY §7.3 risk register):
 from __future__ import annotations
 
 import json
-import math
 import os
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -39,7 +38,7 @@ from flexflow_tpu.search.cost import (
     op_compute_time,
     reshard_cost,
 )
-from flexflow_tpu.tensor import Layer, Tensor
+from flexflow_tpu.tensor import Layer
 
 
 def _local_shape(shape: Tuple[int, ...], sharding, mesh: MachineMesh) -> Tuple[int, ...]:
@@ -66,6 +65,10 @@ class OpProfiler:
         self.cache_file = cache_file
         self.iters = iters
         self.cache: Dict[str, float] = {}
+        # failures are remembered in-memory only (retried next session) so
+        # a non-traceable op doesn't re-attempt a full jit compile on every
+        # DP/search evaluation
+        self._failed: set = set()
         if cache_file and os.path.exists(cache_file):
             with open(cache_file) as f:
                 loaded = json.load(f)
@@ -98,9 +101,13 @@ class OpProfiler:
         key = self._key(layer, local_in)
         if key in self.cache:
             return self.cache[key]
+        if key in self._failed:
+            return -1.0
         t = self._run(layer, local_in, sharding, mesh)
-        if t > 0:  # never cache the failure sentinel — retry next session
+        if t > 0:  # never persist the failure sentinel — retry next session
             self.cache[key] = t
+        else:
+            self._failed.add(key)
         return t
 
     def _run(
@@ -282,8 +289,6 @@ def simulate_strategy(
         if node_time_fn is not None:
             dur = node_time_fn(layer, s)
         else:
-            from flexflow_tpu.parallel.spec import TensorSharding
-
             s_eff = s or OpSharding(
                 output=[
                     TensorSharding.replicated(len(sh))

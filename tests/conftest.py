@@ -1,6 +1,12 @@
 """Test env: virtual 8-device CPU mesh (SURVEY §4 TPU-build implication).
 
-Must set XLA flags before jax initializes a backend.
+Must set XLA flags before jax initializes a backend.  Note: pytest plugins
+(e.g. jaxtyping) import jax BEFORE this conftest runs, so setting the
+JAX_PLATFORMS env var here is too late — jax snapshots it at import.  The
+``jax_platforms`` config update below restricts backend discovery to CPU
+regardless of import order; without it the axon TPU plugin initializes at
+first dispatch and hangs the whole suite whenever the TPU tunnel is
+unreachable.
 """
 
 import os
@@ -12,4 +18,4 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platform_name", "cpu")
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,54 @@
+"""CNN training example: AlexNet / ResNet / ResNeXt-50 / InceptionV3
+(reference ``examples/cpp/{AlexNet,ResNet,resnext50,InceptionV3}``) on
+synthetic images.
+
+Run:
+  python examples/cnn/train_cnn.py --arch alexnet -b 16 --size 128
+  python examples/cnn/train_cnn.py --arch resnet --mesh-shape 8x1   # DP
+"""
+
+import argparse
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models.cnn import alexnet, inception_v3, resnet, resnext50
+
+ARCHS = {
+    "alexnet": alexnet,
+    "resnet": resnet,
+    "resnext50": resnext50,
+    "inception": inception_v3,
+}
+
+
+def main():
+    cfg = FFConfig(batch_size=16, epochs=1, learning_rate=0.01)
+    rest = cfg.parse_args()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arch", choices=sorted(ARCHS), default="alexnet")
+    ap.add_argument("--size", type=int, default=128)
+    ap.add_argument("--classes", type=int, default=10)
+    args = ap.parse_args(rest)
+
+    model = FFModel(cfg)
+    ARCHS[args.arch](model, cfg.batch_size, num_classes=args.classes,
+                     height=args.size, width=args.size)
+    model.compile(
+        optimizer=SGDOptimizer(lr=cfg.learning_rate),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    print(f"compiled {args.arch}: {model.num_parameters} parameters, "
+          f"mesh={model.strategy.mesh}")
+
+    rng = np.random.default_rng(0)
+    n = 8 * cfg.batch_size
+    x = rng.normal(size=(n, 3, args.size, args.size)).astype(np.float32)
+    y = rng.integers(0, args.classes, size=(n, 1)).astype(np.int32)
+    pm = model.fit(x, y)
+    print(f"throughput: {pm.throughput():.1f} samples/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""DLRM training example (reference ``examples/cpp/DLRM/dlrm.cc``) on
+synthetic click data, with optional vocab-sharded embedding tables
+(parameter parallelism).
+
+Run:
+  python examples/dlrm/dlrm.py -b 64 -e 2
+  python examples/dlrm/dlrm.py --mesh-shape 2x4       # dp x tp (vocab-sharded)
+"""
+
+import argparse
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MachineMesh, SGDOptimizer
+from flexflow_tpu.models.dlrm import dlrm, dlrm_strategy
+
+
+def main():
+    cfg = FFConfig(batch_size=64, epochs=2, learning_rate=0.01)
+    rest = cfg.parse_args()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--embedding-size", type=int, default=65536)
+    ap.add_argument("--num-tables", type=int, default=4)
+    ap.add_argument("--sparse-feature-size", type=int, default=64)
+    ap.add_argument("--bag-size", type=int, default=1)
+    args = ap.parse_args(rest)
+
+    vocabs = tuple([args.embedding_size] * args.num_tables)
+    model = FFModel(cfg)
+    dlrm(
+        model, cfg.batch_size, embedding_sizes=vocabs,
+        sparse_feature_size=args.sparse_feature_size, bag_size=args.bag_size,
+    )
+
+    mesh = None
+    strategy = None
+    if cfg.mesh_shape is not None:
+        mesh = MachineMesh(cfg.mesh_shape, ("data", "model")[: len(cfg.mesh_shape)])
+        strategy = dlrm_strategy(model.layers, mesh)
+
+    model.compile(
+        optimizer=SGDOptimizer(lr=cfg.learning_rate),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        mesh=mesh,
+        strategy=strategy,
+    )
+    print(f"compiled: {model.num_parameters} parameters, mesh={model.strategy.mesh}")
+
+    rng = np.random.default_rng(0)
+    n = 32 * cfg.batch_size
+    xs = [
+        rng.integers(0, v, size=(n, args.bag_size)).astype(np.int32) for v in vocabs
+    ]
+    xs.append(rng.normal(size=(n, 4)).astype(np.float32))
+    y = rng.uniform(size=(n, 2)).astype(np.float32)
+    pm = model.fit(xs, y)
+    print(f"throughput: {pm.throughput():.1f} samples/s")
+
+
+if __name__ == "__main__":
+    main()

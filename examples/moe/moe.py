@@ -1,0 +1,39 @@
+"""Mixture-of-experts example (reference
+``examples/cpp/mixture_of_experts/moe.cc``) — MoE classifier on synthetic
+MNIST-like blobs.
+
+Run:  python examples/moe/moe.py -b 64 -e 3
+"""
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models.moe import moe_classifier
+
+
+def main():
+    cfg = FFConfig(batch_size=64, epochs=3, learning_rate=0.001)
+    cfg.parse_args()
+
+    model = FFModel(cfg)
+    moe_classifier(model, cfg.batch_size)
+    model.compile(
+        optimizer=SGDOptimizer(lr=cfg.learning_rate),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    print(f"compiled: {model.num_parameters} parameters")
+
+    rng = np.random.default_rng(0)
+    n = 4096
+    centers = rng.normal(size=(10, 784)).astype(np.float32) * 2
+    y = rng.integers(0, 10, size=n)
+    x = (centers[y] + rng.normal(size=(n, 784))).astype(np.float32)
+    y = y.astype(np.int32).reshape(n, 1)
+    pm = model.fit(x, y)
+    print(f"final accuracy: {pm.accuracy:.4f}")
+    print(f"throughput: {pm.throughput():.1f} samples/s")
+
+
+if __name__ == "__main__":
+    main()

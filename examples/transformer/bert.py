@@ -1,0 +1,77 @@
+"""Transformer/BERT encoder training example (reference
+``examples/cpp/Transformer/transformer.cc``).
+
+Run:
+  python examples/transformer/bert.py -b 8 --seq 128 --layers 2
+  python examples/transformer/bert.py --mesh-shape 2x4 --strategy tp   # dp x tp
+  python examples/transformer/bert.py --mesh-shape 2x4 --strategy sp   # dp x sp
+"""
+
+import argparse
+
+import numpy as np
+
+from flexflow_tpu import (
+    AdamOptimizer,
+    FFConfig,
+    FFModel,
+    LossType,
+    MachineMesh,
+    MetricsType,
+)
+from flexflow_tpu.models.transformer import transformer_encoder
+from flexflow_tpu.parallel.strategy import (
+    sequence_parallel_strategy,
+    tensor_parallel_strategy,
+)
+
+
+def main():
+    cfg = FFConfig(batch_size=8, epochs=2, learning_rate=1e-4)
+    rest = cfg.parse_args()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--ff-dim", type=int, default=1024)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--classes", type=int, default=16)
+    ap.add_argument("--strategy", choices=["dp", "tp", "sp"], default="dp")
+    args = ap.parse_args(rest)
+
+    model = FFModel(cfg)
+    transformer_encoder(
+        model, batch=cfg.batch_size, seq=args.seq, hidden=args.hidden,
+        heads=args.heads, ff_dim=args.ff_dim, num_layers=args.layers,
+        vocab=512, num_classes=args.classes, raw_input=True, use_flash=False,
+    )
+
+    mesh = None
+    strategy = None
+    if cfg.mesh_shape is not None:
+        axes = ("data", "model" if args.strategy != "sp" else "seq")
+        mesh = MachineMesh(cfg.mesh_shape, axes[: len(cfg.mesh_shape)])
+        if args.strategy == "tp":
+            strategy = tensor_parallel_strategy(model.layers, mesh)
+        elif args.strategy == "sp":
+            strategy = sequence_parallel_strategy(model.layers, mesh, sp_axis="seq")
+
+    model.compile(
+        optimizer=AdamOptimizer(alpha=cfg.learning_rate),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+        mesh=mesh,
+        strategy=strategy,
+    )
+    print(f"compiled: {model.num_parameters} parameters, mesh={model.strategy.mesh}")
+
+    rng = np.random.default_rng(0)
+    n = 32 * cfg.batch_size
+    x = rng.normal(size=(n, args.seq, args.hidden)).astype(np.float32)
+    y = rng.integers(0, args.classes, size=(n, 1)).astype(np.int32)
+    pm = model.fit(x, y)
+    print(f"throughput: {pm.throughput():.1f} samples/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,7 +1,25 @@
 """Built-in model builders (reference ``examples/cpp/*`` apps as library
-functions): Transformer/BERT, MLP, AlexNet, ResNet, DLRM, MoE."""
+functions): Transformer/BERT, MLP, AlexNet, ResNet, ResNeXt-50,
+InceptionV3, DLRM, XDL, CANDLE-Uno, MoE."""
 
-from flexflow_tpu.models.transformer import transformer_encoder
+from flexflow_tpu.models.candle_uno import candle_uno
+from flexflow_tpu.models.cnn import alexnet, inception_v3, resnet, resnext50
+from flexflow_tpu.models.dlrm import dlrm, dlrm_strategy, xdl
 from flexflow_tpu.models.mlp import mlp
+from flexflow_tpu.models.moe import moe_classifier, moe_encoder
+from flexflow_tpu.models.transformer import transformer_encoder
 
-__all__ = ["transformer_encoder", "mlp"]
+__all__ = [
+    "alexnet",
+    "candle_uno",
+    "dlrm",
+    "dlrm_strategy",
+    "inception_v3",
+    "mlp",
+    "moe_classifier",
+    "moe_encoder",
+    "resnet",
+    "resnext50",
+    "transformer_encoder",
+    "xdl",
+]

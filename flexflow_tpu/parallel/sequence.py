@@ -37,29 +37,16 @@ from jax.sharding import Mesh, PartitionSpec
 _NEG = -1e30  # finite mask value: keeps online-softmax nan-free
 
 
-def _local_sdpa(q, k, v, rng=None, *, causal: bool, dropout_rate: float = 0.0,
-                q_offset=0, k_offset=0):
-    """Plain SDPA on local (B, H, Sq, D) blocks with *global* causal
-    positions (offsets give each shard its absolute coordinates)."""
-    scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-    if causal:
-        # end-aligned mask (matches ops.attention.sdpa's tril(k=sk-sq)):
-        # query i may attend key j <= i + (Sk - Sq)
-        q_pos = q_offset + jnp.arange(q.shape[2]) + (k.shape[2] + k_offset
-                                                     - q.shape[2] - q_offset)
-        k_pos = k_offset + jnp.arange(k.shape[2])
-        mask = q_pos[:, None] >= k_pos[None, :]
-        s = jnp.where(mask[None, None], s, _NEG)
-    p = jax.nn.softmax(s, axis=-1)
-    if dropout_rate > 0.0 and rng is not None:
-        keep = 1.0 - dropout_rate
-        p = p * jax.random.bernoulli(rng, keep, p.shape) / keep
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+def _local_sdpa(q, k, v, rng=None, *, causal: bool, dropout_rate: float = 0.0):
+    """Full-sequence SDPA on local blocks — same math as the global path
+    (ops.attention.sdpa: scale, end-aligned causal tril, prob dropout)."""
+    from flexflow_tpu.ops.attention import sdpa
+
+    return sdpa(q, k, v, causal=causal, dropout_rate=dropout_rate, rng=rng)
 
 
 def _ring_local(q, k, v, rng, *, axis_name: str, axis_size: int, causal: bool,
-                dropout_rate: float = 0.0):
+                dropout_rate: float = 0.0, other_axes=()):
     """Per-shard ring attention body (runs under shard_map).
 
     q/k/v: (B, H, S_local, D).  Rotates K/V blocks ``axis_size`` times with
@@ -77,7 +64,7 @@ def _ring_local(q, k, v, rng, *, axis_name: str, axis_size: int, causal: bool,
     q_pos = my * sq + jnp.arange(sq) + (sk - sq) * axis_size
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
     if rng is not None:
-        rng = jax.random.fold_in(rng, my)
+        rng = _fold_shard(rng, axis_name, other_axes)
 
     def fold(o, m, l, kb, vb, i):
         """Fold one K/V block into the online-softmax accumulators."""
@@ -128,6 +115,16 @@ def _specs(batch_axis, head_axis, axis):
     return PartitionSpec(batch_axis, head_axis, axis, None)
 
 
+def _fold_shard(rng, axis_name, other_axes):
+    """Distinct dropout key per device: fold in the coordinate along the
+    seq axis AND every other mesh axis sharding this tensor (batch/head) —
+    shards that differ only in DP/TP position must not share masks."""
+    rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
+    for a in other_axes:
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(a))
+    return rng
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -157,6 +154,7 @@ def ring_attention(
         functools.partial(
             _ring_local, axis_name=axis, axis_size=axis_size, causal=causal,
             dropout_rate=dropout_rate,
+            other_axes=tuple(a for a in (batch_axis, head_axis) if a),
         )
     )
     f = jax.shard_map(
@@ -168,7 +166,7 @@ def ring_attention(
 
 
 def _ulysses_local(q, k, v, rng, *, axis_name: str, axis_size: int,
-                   causal: bool, dropout_rate: float = 0.0):
+                   causal: bool, dropout_rate: float = 0.0, other_axes=()):
     """all_to_all: (B, H, S/P, D) -> (B, H/P, S, D), local full-seq SDPA,
     then back.  The two transposes are the only collectives."""
     a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name, tiled=True)
@@ -176,7 +174,7 @@ def _ulysses_local(q, k, v, rng, *, axis_name: str, axis_size: int,
     kh = a2a(k, split_axis=1, concat_axis=2)
     vh = a2a(v, split_axis=1, concat_axis=2)
     if rng is not None:
-        rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
+        rng = _fold_shard(rng, axis_name, other_axes)
     out = _local_sdpa(qh, kh, vh, rng, causal=causal, dropout_rate=dropout_rate)
     return a2a(out, split_axis=2, concat_axis=1)
 
@@ -209,6 +207,7 @@ def ulysses_attention(
     body = functools.partial(
         _ulysses_local, axis_name=axis, axis_size=axis_size, causal=causal,
         dropout_rate=dropout_rate,
+        other_axes=tuple(a for a in (batch_axis, head_axis) if a),
     )
     f = jax.shard_map(
         body, mesh=mesh,

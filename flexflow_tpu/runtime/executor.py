@@ -95,20 +95,24 @@ class Executor:
         dim); otherwise they follow the default batch sharding.  Labels are
         co-sharded with the final op (reference label-tensor creation,
         ``model.cc:3086-3124``)."""
-        for layer in self.layers:
-            for j, it in enumerate(layer.inputs):
-                if it.guid != t.guid:
-                    continue
-                op_sh = self.strategy.op_sharding(layer)
-                if op_sh is not None and j < len(op_sh.inputs) and op_sh.inputs[j] is not None:
-                    return op_sh.inputs[j].partition_spec()
-                break  # first consumer decides
-            else:
-                continue
-            break
+        declared = self._declared_input_sharding(t)
+        if declared is not None:
+            return declared.partition_spec()
         if self.strategy.mesh.axis_size("data") > 1 and t.shape[0] % self.strategy.mesh.axis_size("data") == 0:
             return PartitionSpec("data")
         return PartitionSpec()
+
+    def _declared_input_sharding(self, t: Tensor) -> Optional[TensorSharding]:
+        """First consumer's strategy-declared sharding for tensor ``t``
+        (None when no consumer declares one)."""
+        for layer in self.layers:
+            for j, it in enumerate(layer.inputs):
+                if it.guid == t.guid:
+                    op_sh = self.strategy.op_sharding(layer)
+                    if op_sh is not None and j < len(op_sh.inputs):
+                        return op_sh.inputs[j]
+                    return None  # first consumer decides
+        return None
 
     # --- forward trace -----------------------------------------------------
     def _forward(

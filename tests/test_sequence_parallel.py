@@ -135,8 +135,10 @@ def test_sp_composes_with_dp_batch_axis():
 
 
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
-def test_transformer_seq_parallel_e2e(impl):
-    """Full training steps under dp=2 × sp=4 track the unsharded losses."""
+def test_transformer_seq_parallel_e2e(impl, monkeypatch):
+    """Full training steps under dp=2 × sp=4 track the unsharded losses —
+    and the SP attention path actually engages (guards against plumbing
+    regressions that silently fall back to global attention)."""
     from flexflow_tpu import (
         AdamOptimizer,
         FFConfig,
@@ -167,6 +169,18 @@ def test_transformer_seq_parallel_e2e(impl):
         return model
 
     ref = build((1, 1), ("data", "seq"), None)
+
+    # instrument the SP entry points: the loss-parity check alone would
+    # pass trivially if attention silently fell back to the global path
+    import flexflow_tpu.parallel.sequence as seq_mod
+
+    calls = []
+    real_ring, real_uly = seq_mod.ring_attention, seq_mod.ulysses_attention
+    monkeypatch.setattr(seq_mod, "ring_attention",
+                        lambda *a, **k: calls.append("ring") or real_ring(*a, **k))
+    monkeypatch.setattr(seq_mod, "ulysses_attention",
+                        lambda *a, **k: calls.append("ulysses") or real_uly(*a, **k))
+
     sp_model = build(
         (2, 4), ("data", "seq"),
         lambda layers, mesh: sequence_parallel_strategy(layers, mesh, impl=impl),
@@ -186,3 +200,4 @@ def test_transformer_seq_parallel_e2e(impl):
             float(l_sp), float(l_ref), atol=1e-4, rtol=1e-4,
             err_msg=f"step {step} ({impl})",
         )
+    assert impl in calls, f"SP path never engaged: {calls}"

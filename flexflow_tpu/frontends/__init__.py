@@ -1,0 +1,4 @@
+"""User-facing frontends (SURVEY §2.5): Keras-style API, torch.fx importer,
+ONNX importer.  Each is a thin translation layer onto the FFModel builder —
+the reference's ``python/flexflow/{keras,torch,onnx}`` packages re-designed
+for the TPU-native core."""

@@ -1,0 +1,39 @@
+"""Keras-style frontend (reference ``python/flexflow/keras``): Sequential
+and functional ``Model``, layer classes, string-named optimizers/losses/
+metrics, and callbacks.  Pure translation onto the FFModel builder."""
+
+from flexflow_tpu.frontends.keras import layers  # noqa: F401
+from flexflow_tpu.frontends.keras.callbacks import (
+    Callback,
+    EpochVerifyMetrics,
+    LearningRateScheduler,
+    VerifyMetrics,
+)
+from flexflow_tpu.frontends.keras.layers import (
+    Activation,
+    Add,
+    AveragePooling2D,
+    BatchNormalization,
+    Concatenate,
+    Conv2D,
+    Dense,
+    Dropout,
+    Embedding,
+    Flatten,
+    Input,
+    LayerNormalization,
+    MaxPooling2D,
+    Multiply,
+    Reshape,
+    Subtract,
+)
+from flexflow_tpu.frontends.keras.models import Model, Sequential
+from flexflow_tpu.frontends.keras.optimizers import SGD, Adam
+
+__all__ = [
+    "Activation", "Adam", "Add", "AveragePooling2D", "BatchNormalization",
+    "Callback", "Concatenate", "Conv2D", "Dense", "Dropout", "Embedding",
+    "EpochVerifyMetrics", "Flatten", "Input", "LayerNormalization",
+    "LearningRateScheduler", "MaxPooling2D", "Model", "Multiply", "Reshape",
+    "SGD", "Sequential", "Subtract", "VerifyMetrics", "layers",
+]

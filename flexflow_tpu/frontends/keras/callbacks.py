@@ -1,0 +1,86 @@
+"""Keras callbacks (reference ``python/flexflow/keras/callbacks.py:21-90``):
+``Callback`` base, ``LearningRateScheduler``, ``VerifyMetrics`` (assert a
+final accuracy threshold — used by the reference's accuracy-gated CI
+examples, ``examples/python/keras/accuracy.py``), ``EpochVerifyMetrics``."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class Callback:
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_batch_begin(self, batch, logs=None):
+        pass
+
+    def on_batch_end(self, batch, logs=None):
+        pass
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+
+class LearningRateScheduler(Callback):
+    """Calls ``schedule(epoch) -> lr`` and updates the compiled optimizer.
+
+    The jitted step closes over the optimizer object's hyperparams via
+    jit-retrace; changing the lr invalidates the cached step fn (same cost
+    the reference pays re-configuring its optimizer tasks)."""
+
+    def __init__(self, schedule: Callable[[int], float]):
+        self.schedule = schedule
+
+    def on_epoch_begin(self, epoch, logs=None):
+        lr = float(self.schedule(epoch))
+        ff = self.model.ffmodel
+        opt = ff.executor.optimizer
+        if hasattr(opt, "lr"):
+            opt.lr = lr
+        else:
+            opt.alpha = lr
+        ff.executor._step_jit = None  # force re-trace with the new lr
+
+
+class VerifyMetrics(Callback):
+    """Assert the final accuracy reaches ``threshold`` (fraction or the
+    reference's ``ModelAccuracy`` percent enum values)."""
+
+    def __init__(self, threshold: float):
+        self.threshold = threshold if threshold <= 1.0 else threshold / 100.0
+
+    def on_train_end(self, logs=None):
+        acc = (logs or {}).get("accuracy")
+        assert acc is not None, "accuracy metric not tracked"
+        assert acc >= self.threshold, (
+            f"accuracy {acc:.4f} below required {self.threshold:.4f}"
+        )
+
+
+class EpochVerifyMetrics(Callback):
+    """Stop early once an epoch reaches the target accuracy."""
+
+    def __init__(self, threshold: float, early_stop: bool = True):
+        self.threshold = threshold if threshold <= 1.0 else threshold / 100.0
+        self.early_stop = early_stop
+        self.reached = False
+
+    def on_epoch_end(self, epoch, logs=None):
+        acc = (logs or {}).get("accuracy", 0.0)
+        if acc >= self.threshold:
+            self.reached = True
+            if self.early_stop:
+                raise StopIteration(f"target accuracy reached at epoch {epoch}")

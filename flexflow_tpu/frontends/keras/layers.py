@@ -1,0 +1,314 @@
+"""Keras layer classes (reference ``python/flexflow/keras/layers/*``).
+
+Each layer is a *recorder*: calling it on a :class:`KTensor` appends a node
+to a lightweight trace; ``Model.compile`` replays the trace onto an
+``FFModel`` (the reference does the same two-phase dance — keras layers
+build ``ff`` layers inside ``BaseModel._create_flexflow_layers``,
+``python/flexflow/keras/models/base_model.py``).
+
+Shapes are batch-implicit (Keras convention): ``Input(shape=(784,))``
+describes one sample; the batch dim is prepended at compile time.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+from flexflow_tpu.fftype import ActiMode, DataType, PoolType
+
+_ACTIVATIONS = {
+    None: ActiMode.NONE,
+    "linear": ActiMode.NONE,
+    "relu": ActiMode.RELU,
+    "sigmoid": ActiMode.SIGMOID,
+    "tanh": ActiMode.TANH,
+    "gelu": ActiMode.GELU,
+    # handled as separate ops after the layer (no fused ActiMode exists)
+    "softmax": "softmax",
+    "elu": "elu",
+}
+
+_guid = itertools.count()
+
+
+class KTensor:
+    """Symbolic tensor in the keras trace: sample shape + producing node."""
+
+    def __init__(self, shape: Tuple[int, ...], dtype: DataType, node=None):
+        self.shape = tuple(shape)  # batch-implicit
+        self.dtype = dtype
+        self.node = node
+        self.guid = next(_guid)
+
+    def __repr__(self):
+        return f"KTensor{self.shape}"
+
+
+class Node:
+    def __init__(self, layer: "Layer", inputs: List[KTensor], outputs: List[KTensor]):
+        self.layer = layer
+        self.inputs = inputs
+        self.outputs = outputs
+
+
+def Input(shape: Sequence[int], dtype: Union[str, DataType] = DataType.FLOAT) -> KTensor:
+    """Graph input (reference ``keras/layers/input_layer.py``)."""
+    if isinstance(dtype, str):
+        dtype = {"float32": DataType.FLOAT, "int32": DataType.INT32,
+                 "int64": DataType.INT64}[dtype]
+    t = KTensor(tuple(shape), dtype, node=None)
+    t.is_input = True
+    return t
+
+
+class Layer:
+    """Base recorder.  Subclasses implement ``compute_output_shape`` and
+    ``build_ff`` (the FFModel lowering)."""
+
+    _counters = {}
+
+    def __init__(self, name: Optional[str] = None):
+        cls = type(self).__name__.lower()
+        if name is None:
+            n = Layer._counters.get(cls, 0)
+            Layer._counters[cls] = n + 1
+            name = f"{cls}_{n}"
+        self.name = name
+
+    # --- trace side -------------------------------------------------------
+    def __call__(self, inputs):
+        ins = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+        out_shape, out_dtype = self.compute_output_shape(
+            [t.shape for t in ins], [t.dtype for t in ins]
+        )
+        out = KTensor(out_shape, out_dtype)
+        out.node = Node(self, ins, [out])
+        return out
+
+    def compute_output_shape(self, shapes, dtypes):
+        return shapes[0], dtypes[0]
+
+    # --- lowering side ----------------------------------------------------
+    def build_ff(self, model, inputs):
+        raise NotImplementedError
+
+
+class Dense(Layer):
+    def __init__(self, units: int, activation=None, use_bias: bool = True,
+                 name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.units = units
+        self.activation = activation
+        self.use_bias = use_bias
+
+    def compute_output_shape(self, shapes, dtypes):
+        return shapes[0][:-1] + (self.units,), dtypes[0]
+
+    def build_ff(self, model, inputs):
+        act = _ACTIVATIONS[self.activation]
+        if isinstance(act, str):  # separate-op activation
+            t = model.dense(inputs[0], self.units, ActiMode.NONE,
+                            use_bias=self.use_bias, name=self.name)
+            return getattr(model, act)(t, name=f"{self.name}_{act}")
+        return model.dense(inputs[0], self.units, act, use_bias=self.use_bias,
+                           name=self.name)
+
+
+class Activation(Layer):
+    def __init__(self, activation: str, name: Optional[str] = None):
+        super().__init__(name)
+        self.activation = activation
+
+    def build_ff(self, model, inputs):
+        t = inputs[0]
+        if self.activation == "softmax":
+            return model.softmax(t, name=self.name)
+        fn = {"relu": model.relu, "sigmoid": model.sigmoid, "tanh": model.tanh,
+              "elu": model.elu, "gelu": model.gelu}[self.activation]
+        return fn(t, name=self.name)
+
+
+class Dropout(Layer):
+    def __init__(self, rate: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.rate = rate
+
+    def build_ff(self, model, inputs):
+        return model.dropout(inputs[0], self.rate, name=self.name)
+
+
+class Flatten(Layer):
+    def compute_output_shape(self, shapes, dtypes):
+        n = 1
+        for d in shapes[0]:
+            n *= d
+        return (n,), dtypes[0]
+
+    def build_ff(self, model, inputs):
+        return model.flat(inputs[0], name=self.name)
+
+
+class Reshape(Layer):
+    def __init__(self, target_shape: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.target_shape = tuple(target_shape)
+
+    def compute_output_shape(self, shapes, dtypes):
+        return self.target_shape, dtypes[0]
+
+    def build_ff(self, model, inputs):
+        batch = inputs[0].shape[0]
+        return model.reshape(inputs[0], (batch,) + self.target_shape, name=self.name)
+
+
+class Conv2D(Layer):
+    """NCHW sample shape (C, H, W) — reference keras frontend convention
+    (``keras/layers/convolutional.py``)."""
+
+    def __init__(self, filters: int, kernel_size, strides=(1, 1),
+                 padding: Union[str, Tuple[int, int]] = "valid",
+                 activation=None, use_bias: bool = True, groups: int = 1,
+                 name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.filters = filters
+        self.kernel = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.strides = (strides, strides) if isinstance(strides, int) else tuple(strides)
+        self.padding = padding
+        self.activation = activation
+        self.use_bias = use_bias
+        self.groups = groups
+
+    def _pads(self):
+        if self.padding == "valid":
+            return 0, 0
+        if self.padding == "same":
+            return self.kernel[0] // 2, self.kernel[1] // 2
+        return tuple(self.padding)
+
+    def compute_output_shape(self, shapes, dtypes):
+        c, h, w = shapes[0]
+        ph, pw = self._pads()
+        oh = (h + 2 * ph - self.kernel[0]) // self.strides[0] + 1
+        ow = (w + 2 * pw - self.kernel[1]) // self.strides[1] + 1
+        return (self.filters, oh, ow), dtypes[0]
+
+    def build_ff(self, model, inputs):
+        ph, pw = self._pads()
+        act = _ACTIVATIONS[self.activation]
+        assert not isinstance(act, str), f"{self.activation} not fusable into conv"
+        return model.conv2d(inputs[0], self.filters, *self.kernel,
+                            *self.strides, ph, pw, act, groups=self.groups,
+                            use_bias=self.use_bias, name=self.name)
+
+
+class _Pool2D(Layer):
+    pool_type = PoolType.MAX
+
+    def __init__(self, pool_size=(2, 2), strides=None,
+                 padding: str = "valid", name: Optional[str] = None):
+        super().__init__(name)
+        self.pool = (pool_size, pool_size) if isinstance(pool_size, int) else tuple(pool_size)
+        strides = strides if strides is not None else self.pool
+        self.strides = (strides, strides) if isinstance(strides, int) else tuple(strides)
+        self.padding = padding
+
+    def _pads(self):
+        if self.padding == "valid":
+            return 0, 0
+        return self.pool[0] // 2, self.pool[1] // 2
+
+    def compute_output_shape(self, shapes, dtypes):
+        c, h, w = shapes[0]
+        ph, pw = self._pads()
+        oh = (h + 2 * ph - self.pool[0]) // self.strides[0] + 1
+        ow = (w + 2 * pw - self.pool[1]) // self.strides[1] + 1
+        return (c, oh, ow), dtypes[0]
+
+    def build_ff(self, model, inputs):
+        ph, pw = self._pads()
+        return model.pool2d(inputs[0], *self.pool, *self.strides, ph, pw,
+                            self.pool_type, name=self.name)
+
+
+class MaxPooling2D(_Pool2D):
+    pool_type = PoolType.MAX
+
+
+class AveragePooling2D(_Pool2D):
+    pool_type = PoolType.AVG
+
+
+class BatchNormalization(Layer):
+    def __init__(self, relu: bool = False, name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.relu = relu
+
+    def build_ff(self, model, inputs):
+        return model.batch_norm(inputs[0], relu=self.relu, name=self.name)
+
+
+class LayerNormalization(Layer):
+    def __init__(self, epsilon: float = 1e-5, name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.epsilon = epsilon
+
+    def build_ff(self, model, inputs):
+        return model.layer_norm(inputs[0], axes=[-1], eps=self.epsilon, name=self.name)
+
+
+class Embedding(Layer):
+    def __init__(self, input_dim: int, output_dim: int, name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+
+    def compute_output_shape(self, shapes, dtypes):
+        return shapes[0] + (self.output_dim,), DataType.FLOAT
+
+    def build_ff(self, model, inputs):
+        return model.embedding(inputs[0], self.input_dim, self.output_dim,
+                               name=self.name)
+
+
+class _Merge(Layer):
+    fn = "add"
+
+    def compute_output_shape(self, shapes, dtypes):
+        return shapes[0], dtypes[0]
+
+    def build_ff(self, model, inputs):
+        fn = getattr(model, self.fn)
+        out = inputs[0]
+        for t in inputs[1:]:
+            out = fn(out, t, name=self.name)
+        return out
+
+
+class Add(_Merge):
+    fn = "add"
+
+
+class Subtract(_Merge):
+    fn = "subtract"
+
+
+class Multiply(_Merge):
+    fn = "multiply"
+
+
+class Concatenate(Layer):
+    def __init__(self, axis: int = -1, name: Optional[str] = None):
+        super().__init__(name)
+        self.axis = axis
+
+    def compute_output_shape(self, shapes, dtypes):
+        ax = self.axis if self.axis >= 0 else len(shapes[0]) + self.axis
+        out = list(shapes[0])
+        out[ax] = sum(s[ax] for s in shapes)
+        return tuple(out), dtypes[0]
+
+    def build_ff(self, model, inputs):
+        # sample-axis index +1 for the batch dim
+        ax = self.axis if self.axis < 0 else self.axis + 1
+        return model.concat(inputs, axis=ax, name=self.name)

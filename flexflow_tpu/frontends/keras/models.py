@@ -1,0 +1,243 @@
+"""Keras ``Model``/``Sequential`` (reference
+``python/flexflow/keras/models/{base_model,sequential,model}.py``).
+
+``compile`` replays the recorded layer trace onto an ``FFModel``
+(reference ``BaseModel._create_flexflow_layers``), ``fit`` runs the
+canonical loop with callbacks (reference ``BaseModel.fit``,
+``base_model.py:198-260``), ``evaluate`` reports metrics on held-out data.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.dataloader import BatchIterator, SingleDataLoader
+from flexflow_tpu.fftype import LossType, MetricsType
+from flexflow_tpu.frontends.keras.layers import KTensor, Layer, Node
+from flexflow_tpu.frontends.keras.optimizers import SGD, Adam, KOptimizer
+from flexflow_tpu.metrics import PerfMetrics
+from flexflow_tpu.model import FFModel
+
+_LOSSES = {
+    "categorical_crossentropy": LossType.CATEGORICAL_CROSSENTROPY,
+    "sparse_categorical_crossentropy": LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+    "mse": LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+}
+
+_METRICS = {
+    "accuracy": MetricsType.ACCURACY,
+    "categorical_crossentropy": MetricsType.CATEGORICAL_CROSSENTROPY,
+    "sparse_categorical_crossentropy": MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": MetricsType.MEAN_SQUARED_ERROR,
+    "mse": MetricsType.MEAN_SQUARED_ERROR,
+    "root_mean_squared_error": MetricsType.ROOT_MEAN_SQUARED_ERROR,
+}
+
+
+def _toposort(outputs: List[KTensor]) -> List[Node]:
+    order: List[Node] = []
+    seen = set()
+
+    def visit(t: KTensor):
+        node = t.node
+        if node is None or id(node) in seen:
+            return
+        seen.add(id(node))
+        for i in node.inputs:
+            visit(i)
+        order.append(node)
+
+    for t in outputs:
+        visit(t)
+    return order
+
+
+class Model:
+    """Functional model: ``Model(inputs, outputs)`` over recorded KTensors."""
+
+    def __init__(self, inputs=None, outputs=None, name: str = "model"):
+        self.name = name
+        self.inputs: List[KTensor] = (
+            list(inputs) if isinstance(inputs, (list, tuple)) else ([inputs] if inputs else [])
+        )
+        self.outputs: List[KTensor] = (
+            list(outputs) if isinstance(outputs, (list, tuple)) else ([outputs] if outputs else [])
+        )
+        self.ffmodel: Optional[FFModel] = None
+        self._compile_args = None
+
+    # --- compile ----------------------------------------------------------
+    def compile(self, optimizer="sgd", loss="sparse_categorical_crossentropy",
+                metrics: Sequence[str] = (), batch_size: Optional[int] = None,
+                **ff_kwargs):
+        """Record compile config; the FFModel is materialized lazily at
+        first ``fit``/``evaluate`` when the batch size is known (reference
+        defers to ``_create_flexflow_layers`` inside fit the same way)."""
+        if isinstance(optimizer, str):
+            optimizer = {"sgd": SGD(), "adam": Adam()}[optimizer.lower()]
+        self._compile_args = dict(
+            optimizer=optimizer, loss=loss, metrics=list(metrics),
+            batch_size=batch_size, ff_kwargs=ff_kwargs,
+        )
+
+    def _materialize(self, batch_size: int):
+        args = self._compile_args
+        assert args is not None, "call compile() first"
+        cfg = FFConfig(batch_size=batch_size)
+        ff = FFModel(cfg)
+        values: Dict[int, object] = {}
+        for kt in self.inputs:
+            values[kt.guid] = ff.create_tensor(
+                (batch_size,) + kt.shape, kt.dtype, name=f"input_{kt.guid}"
+            )
+        for node in _toposort(self.outputs):
+            ins = [values[t.guid] for t in node.inputs]
+            out = node.layer.build_ff(ff, ins)
+            values[node.outputs[0].guid] = out
+        ff.compile(
+            optimizer=args["optimizer"].to_ff(),
+            loss_type=_LOSSES[args["loss"]],
+            metrics=[_METRICS[m] for m in args["metrics"]],
+            **args["ff_kwargs"],
+        )
+        self.ffmodel = ff
+        return ff
+
+    # --- train/eval -------------------------------------------------------
+    def fit(self, x, y, batch_size: int = 32, epochs: int = 1,
+            callbacks: Sequence = (), verbose: bool = True) -> PerfMetrics:
+        xs = list(x) if isinstance(x, (list, tuple)) else [x]
+        if self.ffmodel is None or self.ffmodel.config.batch_size != batch_size:
+            # changing the batch size re-traces the step program; carry the
+            # trained weights over so incremental fit() calls keep learning
+            old = self.ffmodel.get_weights() if self.ffmodel is not None else None
+            self._materialize(batch_size)
+            if old is not None:
+                self.ffmodel.set_weights(old)
+        ff = self.ffmodel
+        for cb in callbacks:
+            cb.set_model(self)
+            cb.on_train_begin()
+        loaders = [SingleDataLoader(a, batch_size, None, None) for a in xs]
+        loaders.append(SingleDataLoader(np.asarray(y), batch_size, None, None))
+        it = BatchIterator(loaders)
+        pm = PerfMetrics()
+        logs: Dict[str, float] = {}
+        try:
+            for epoch in range(epochs):
+                for cb in callbacks:
+                    cb.on_epoch_begin(epoch)
+                it.reset()
+                for batch in it:
+                    *bx, by = batch
+                    loss, m = ff.executor.train_step(bx, by)
+                    logs = {k: float(v) for k, v in m.items()}
+                    logs["loss"] = float(loss)
+                    pm.update(logs, batch_size)
+                if verbose:
+                    print(f"epoch {epoch}: " + " ".join(f"{k}={v:.4f}" for k, v in logs.items())
+                          + f" throughput={pm.throughput():.2f} samples/s")
+                for cb in callbacks:
+                    cb.on_epoch_end(epoch, logs)
+        except StopIteration as stop:
+            if verbose:
+                print(f"early stop: {stop}")
+        for cb in callbacks:
+            cb.on_train_end(logs)
+        return pm
+
+    def evaluate(self, x, y, batch_size: int = 32) -> Dict[str, float]:
+        """Metrics over the FULL dataset, batch by batch (keras
+        semantics), weighted by batch size."""
+        xs = list(x) if isinstance(x, (list, tuple)) else [x]
+        if self.ffmodel is None:
+            self._materialize(batch_size)
+        ff = self.ffmodel
+        import jax.numpy as jnp
+
+        loaders = [SingleDataLoader(a, batch_size, None, None) for a in xs]
+        loaders.append(SingleDataLoader(np.asarray(y), batch_size, None, None))
+        it = BatchIterator(loaders)
+        totals: Dict[str, float] = {}
+        n = 0
+        for batch in it:
+            *bx, by = batch
+            logits = ff.eval_batch(bx)
+            m = ff.executor.metrics.compute(logits, jnp.asarray(by))
+            for k, v in m.items():
+                totals[k] = totals.get(k, 0.0) + float(v) * batch_size
+            n += batch_size
+        return {k: v / max(n, 1) for k, v in totals.items()}
+
+    def predict(self, x, batch_size: Optional[int] = None):
+        xs = list(x) if isinstance(x, (list, tuple)) else [x]
+        bs = batch_size or len(np.asarray(xs[0]))
+        if self.ffmodel is None:
+            self._materialize(bs)
+        return np.asarray(self.ffmodel.eval_batch(xs))
+
+    def summary(self) -> str:
+        lines = [f'Model "{self.name}"']
+        for node in _toposort(self.outputs):
+            lines.append(
+                f"  {node.layer.name:30s} {type(node.layer).__name__:20s} "
+                f"out={node.outputs[0].shape}"
+            )
+        return "\n".join(lines)
+
+    def get_weights(self):
+        assert self.ffmodel is not None
+        return self.ffmodel.get_weights()
+
+    def set_weights(self, weights):
+        assert self.ffmodel is not None
+        self.ffmodel.set_weights(weights)
+
+
+class Sequential(Model):
+    """``Sequential([layers...])`` or incremental ``.add(layer)``."""
+
+    def __init__(self, layers: Optional[Sequence[Layer]] = None, name: str = "sequential"):
+        super().__init__(name=name)
+        self._layers: List[Layer] = []
+        self._input_spec: Optional[KTensor] = None
+        for l in layers or []:
+            self.add(l)
+
+    def add(self, layer):
+        if isinstance(layer, KTensor):  # Input() passed first
+            self._input_spec = layer
+            return
+        self._layers.append(layer)
+
+    def _ensure_graph(self, sample_shape, dtype):
+        from flexflow_tpu.frontends.keras.layers import Input
+
+        if self.outputs:
+            return
+        t = self._input_spec or Input(sample_shape, dtype)
+        self.inputs = [t]
+        for l in self._layers:
+            t = l(t)
+        self.outputs = [t]
+
+    def fit(self, x, y, batch_size: int = 32, epochs: int = 1,
+            callbacks: Sequence = (), verbose: bool = True) -> PerfMetrics:
+        arr = np.asarray(x[0] if isinstance(x, (list, tuple)) else x)
+        from flexflow_tpu.fftype import DataType
+
+        dt = DataType.INT32 if np.issubdtype(arr.dtype, np.integer) else DataType.FLOAT
+        self._ensure_graph(arr.shape[1:], dt)
+        return super().fit(x, y, batch_size, epochs, callbacks, verbose)
+
+    def evaluate(self, x, y, batch_size: int = 32):
+        arr = np.asarray(x[0] if isinstance(x, (list, tuple)) else x)
+        from flexflow_tpu.fftype import DataType
+
+        dt = DataType.INT32 if np.issubdtype(arr.dtype, np.integer) else DataType.FLOAT
+        self._ensure_graph(arr.shape[1:], dt)
+        return super().evaluate(x, y, batch_size)

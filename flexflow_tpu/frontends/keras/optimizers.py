@@ -1,0 +1,35 @@
+"""Keras optimizer shims (reference ``python/flexflow/keras/optimizers.py``)."""
+
+from __future__ import annotations
+
+from flexflow_tpu.optimizer import AdamOptimizer, Optimizer, SGDOptimizer
+
+
+class KOptimizer:
+    def to_ff(self) -> Optimizer:
+        raise NotImplementedError
+
+
+class SGD(KOptimizer):
+    def __init__(self, learning_rate: float = 0.01, momentum: float = 0.0,
+                 nesterov: bool = False):
+        self.learning_rate = learning_rate
+        self.momentum = momentum
+        self.nesterov = nesterov
+
+    def to_ff(self) -> Optimizer:
+        return SGDOptimizer(lr=self.learning_rate, momentum=self.momentum,
+                            nesterov=self.nesterov)
+
+
+class Adam(KOptimizer):
+    def __init__(self, learning_rate: float = 0.001, beta_1: float = 0.9,
+                 beta_2: float = 0.999, epsilon: float = 1e-7):
+        self.learning_rate = learning_rate
+        self.beta_1 = beta_1
+        self.beta_2 = beta_2
+        self.epsilon = epsilon
+
+    def to_ff(self) -> Optimizer:
+        return AdamOptimizer(alpha=self.learning_rate, beta1=self.beta_1,
+                             beta2=self.beta_2, epsilon=self.epsilon)

@@ -1,0 +1,143 @@
+"""ONNX frontend (reference ``python/flexflow/onnx/model.py``, 375 LoC):
+translate an ONNX graph's nodes into FFModel layer calls.
+
+The ``onnx`` package is not part of this image's baked environment, so the
+importer is gated: constructing :class:`ONNXModel` without ``onnx``
+installed raises a clear ImportError.  The translation logic itself only
+touches the protobuf object API (``graph.node``, ``node.op_type``,
+``node.attribute``), matching the reference's supported op set.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Sequence, Union
+
+import numpy as np
+
+from flexflow_tpu.fftype import ActiMode, AggrMode, DataType, PoolType
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.tensor import Tensor
+
+try:
+    import onnx  # noqa: F401
+
+    _HAS_ONNX = True
+except Exception:  # pragma: no cover — onnx not in the baked image
+    _HAS_ONNX = False
+
+
+def _attrs(node) -> Dict:
+    out = {}
+    for a in node.attribute:
+        if a.type == 1:  # FLOAT
+            out[a.name] = a.f
+        elif a.type == 2:  # INT
+            out[a.name] = a.i
+        elif a.type == 7:  # INTS
+            out[a.name] = list(a.ints)
+        elif a.type == 3:  # STRING
+            out[a.name] = a.s.decode()
+    return out
+
+
+class ONNXModel:
+    """Reference ``ONNXModel(filename).apply(ffmodel, input_dict)``."""
+
+    def __init__(self, source):
+        if not _HAS_ONNX:
+            raise ImportError(
+                "the 'onnx' package is required for the ONNX frontend but is "
+                "not installed in this environment"
+            )
+        if isinstance(source, (str, bytes)):
+            self.model = onnx.load(source)
+        else:
+            self.model = source
+        self.graph = self.model.graph
+        # initializer name -> numpy array (weights baked into the graph)
+        self.inits = {
+            i.name: onnx.numpy_helper.to_array(i) for i in self.graph.initializer
+        }
+
+    def apply(self, model: FFModel, inputs: Dict[str, Tensor]) -> List[Tensor]:
+        values: Dict[str, Tensor] = dict(inputs)
+        for node in self.graph.node:
+            self._lower(model, node, values)
+        return [values[o.name] for o in self.graph.output]
+
+    def _lower(self, model: FFModel, node, values: Dict[str, Tensor]) -> None:
+        op = node.op_type
+        a = _attrs(node)
+        name = node.name or f"{op}_{len(values)}"
+        ins = [values[i] for i in node.input if i in values]
+
+        if op == "Gemm" or op == "MatMul":
+            # weight comes from an initializer; out_dim = its last dim
+            w = next((self.inits[i] for i in node.input if i in self.inits), None)
+            assert w is not None, f"{name}: missing weight initializer"
+            out_dim = w.shape[0] if a.get("transB") else w.shape[-1]
+            bias = sum(1 for i in node.input if i in self.inits) > 1
+            values[node.output[0]] = model.dense(ins[0], int(out_dim),
+                                                 use_bias=bias, name=name)
+        elif op == "Conv":
+            w = next(self.inits[i] for i in node.input if i in self.inits)
+            kh, kw = a.get("kernel_shape", w.shape[2:])
+            sh, sw = a.get("strides", [1, 1])
+            pads = a.get("pads", [0, 0, 0, 0])
+            bias = sum(1 for i in node.input if i in self.inits) > 1
+            values[node.output[0]] = model.conv2d(
+                ins[0], int(w.shape[0]), int(kh), int(kw), int(sh), int(sw),
+                int(pads[0]), int(pads[1]), groups=int(a.get("group", 1)),
+                use_bias=bias, name=name,
+            )
+        elif op in ("MaxPool", "AveragePool"):
+            kh, kw = a["kernel_shape"]
+            sh, sw = a.get("strides", [1, 1])
+            pads = a.get("pads", [0, 0, 0, 0])
+            pt = PoolType.MAX if op == "MaxPool" else PoolType.AVG
+            values[node.output[0]] = model.pool2d(
+                ins[0], int(kh), int(kw), int(sh), int(sw),
+                int(pads[0]), int(pads[1]), pt, name=name,
+            )
+        elif op == "GlobalAveragePool":
+            t = ins[0]
+            values[node.output[0]] = model.pool2d(
+                t, t.shape[2], t.shape[3], 1, 1, 0, 0, PoolType.AVG, name=name
+            )
+        elif op == "Flatten":
+            values[node.output[0]] = model.flat(ins[0], name=name)
+        elif op == "Relu":
+            values[node.output[0]] = model.relu(ins[0], name=name)
+        elif op == "Sigmoid":
+            values[node.output[0]] = model.sigmoid(ins[0], name=name)
+        elif op == "Tanh":
+            values[node.output[0]] = model.tanh(ins[0], name=name)
+        elif op == "Softmax":
+            values[node.output[0]] = model.softmax(ins[0], dim=a.get("axis", -1), name=name)
+        elif op == "Add":
+            values[node.output[0]] = model.add(ins[0], ins[1], name=name)
+        elif op == "Sub":
+            values[node.output[0]] = model.subtract(ins[0], ins[1], name=name)
+        elif op == "Mul":
+            values[node.output[0]] = model.multiply(ins[0], ins[1], name=name)
+        elif op == "Concat":
+            values[node.output[0]] = model.concat(ins, axis=a.get("axis", -1), name=name)
+        elif op == "Dropout":
+            values[node.output[0]] = model.dropout(ins[0], a.get("ratio", 0.5), name=name)
+        elif op == "Reshape":
+            shape_arr = next(self.inits[i] for i in node.input if i in self.inits)
+            shape = [int(s) for s in shape_arr]
+            x = ins[0]
+            if -1 in shape:
+                known = math.prod(s for s in shape if s != -1)
+                shape[shape.index(-1)] = math.prod(x.shape) // known
+            values[node.output[0]] = model.reshape(x, shape, name=name)
+        elif op == "Transpose":
+            values[node.output[0]] = model.transpose(ins[0], a["perm"], name=name)
+        elif op == "BatchNormalization":
+            values[node.output[0]] = model.batch_norm(ins[0], relu=False, name=name)
+        elif op == "Identity":
+            values[node.output[0]] = model.identity(ins[0], name=name)
+        else:
+            raise NotImplementedError(f"ONNX op {op}")

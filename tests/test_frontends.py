@@ -1,0 +1,218 @@
+"""Frontend tests (SURVEY §2.5): Keras-style API end-to-end, torch.fx
+import with forward numerical parity against CPU torch (the reference's
+``tests/align`` tier, SURVEY §4.3), and the .ff IR round-trip."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.frontends import keras as K
+
+
+def test_keras_sequential_mlp_converges():
+    model = K.Sequential([
+        K.Dense(64, activation="relu"),
+        K.Dropout(0.0),
+        K.Dense(10, activation="softmax"),
+    ])
+    model.compile(optimizer=K.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    rng = np.random.default_rng(0)
+    n = 512
+    centers = rng.normal(size=(10, 32)).astype(np.float32) * 3
+    y = rng.integers(0, 10, size=n)
+    x = (centers[y] + rng.normal(size=(n, 32))).astype(np.float32)
+    y = y.astype(np.int32).reshape(n, 1)
+    pm = model.fit(x, y, batch_size=64, epochs=3, verbose=False,
+                   callbacks=[K.VerifyMetrics(0.5)])
+    assert pm.accuracy > 0.5
+    ev = model.evaluate(x, y, batch_size=64)
+    assert ev["accuracy"] > 0.5
+
+
+def test_keras_functional_multi_input():
+    a = K.Input(shape=(16,))
+    b = K.Input(shape=(16,))
+    ha = K.Dense(8, activation="relu")(a)
+    hb = K.Dense(8, activation="relu")(b)
+    merged = K.Concatenate()([ha, hb])
+    out = K.Dense(4, activation="softmax")(merged)
+    model = K.Model(inputs=[a, b], outputs=out)
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    rng = np.random.default_rng(0)
+    xa = rng.normal(size=(128, 16)).astype(np.float32)
+    xb = rng.normal(size=(128, 16)).astype(np.float32)
+    y = rng.integers(0, 4, size=(128, 1)).astype(np.int32)
+    pm = model.fit([xa, xb], y, batch_size=32, epochs=2, verbose=False)
+    assert pm.train_all == 256  # 128 samples x 2 epochs
+    assert "dense" in model.summary().lower() or "Dense" in model.summary()
+
+
+def test_keras_cnn():
+    model = K.Sequential([
+        K.Conv2D(8, 3, activation="relu"),
+        K.MaxPooling2D(2),
+        K.Flatten(),
+        K.Dense(10, activation="softmax"),
+    ])
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 1, 12, 12)).astype(np.float32)
+    y = rng.integers(0, 10, size=(64, 1)).astype(np.int32)
+    model.fit(x, y, batch_size=32, epochs=1, verbose=False)
+
+
+def test_keras_lr_scheduler():
+    model = K.Sequential([K.Dense(4, activation="softmax")])
+    model.compile(optimizer=K.SGD(learning_rate=0.1),
+                  loss="sparse_categorical_crossentropy")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(64, 1)).astype(np.int32)
+    lrs = []
+    sched = K.LearningRateScheduler(lambda e: 0.1 * (0.5 ** e))
+    model.fit(x, y, batch_size=32, epochs=2, verbose=False, callbacks=[sched])
+    assert model.ffmodel.executor.optimizer.lr == pytest.approx(0.05)
+
+
+# --- torch.fx -------------------------------------------------------------
+
+torch = pytest.importorskip("torch")
+
+
+class _TorchMLP(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(32, 64)
+        self.fc2 = torch.nn.Linear(64, 10)
+
+    def forward(self, x):
+        x = torch.relu(self.fc1(x))
+        return self.fc2(x)
+
+
+class _TorchCNN(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv = torch.nn.Conv2d(1, 8, 3, padding=1)
+        self.pool = torch.nn.MaxPool2d(2)
+        self.flat = torch.nn.Flatten()
+        self.fc = torch.nn.Linear(8 * 6 * 6, 10)
+
+    def forward(self, x):
+        x = self.pool(torch.relu(self.conv(x)))
+        return self.fc(self.flat(x))
+
+
+def _apply_torch(module, in_shape, dtype=None):
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel
+
+    batch = in_shape[0]
+    ff = FFModel(FFConfig(batch_size=batch))
+    x = ff.create_tensor(in_shape, name="torch_in")
+    pt = PyTorchModel(module)
+    outs = pt.apply(ff, [x])
+    assert len(outs) == 1
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff, pt, outs[0]
+
+
+@pytest.mark.parametrize("cls,in_shape", [(_TorchMLP, (4, 32)), (_TorchCNN, (4, 1, 12, 12))])
+def test_torch_fx_forward_parity(cls, in_shape):
+    """Import a torch module, transfer its weights, and match its forward
+    output on CPU (reference tests/align tier)."""
+    torch.manual_seed(0)
+    module = cls().eval()
+    ff, pt, out = _apply_torch(module, in_shape)
+    pt.transfer_weights(ff)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=in_shape).astype(np.float32)
+    ours = np.asarray(ff.eval_batch([x]))
+    theirs = module(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-4, rtol=1e-4)
+
+
+def test_torch_ff_file_roundtrip(tmp_path):
+    """torch_to_ff writes the IR; PyTorchModel(path) rebuilds the same
+    graph (reference .ff serialization, ``string_to_ff``)."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel, torch_to_ff
+
+    path = str(tmp_path / "mlp.ff")
+    torch_to_ff(_TorchMLP(), path)
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 32))
+    outs = PyTorchModel(path).apply(ff, [x])
+    assert outs[0].shape == (4, 10)
+
+
+def test_torch_residual_and_methods():
+    class Block(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = torch.nn.Linear(16, 16)
+            self.ln = torch.nn.LayerNorm(16)
+
+        def forward(self, x):
+            h = self.fc(x)
+            x = x + h
+            x = self.ln(x)
+            return x.reshape(-1, 16)
+
+    module = Block().eval()
+    ff, pt, out = _apply_torch(module, (4, 16))
+    pt.transfer_weights(ff)
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(4, 16)).astype(np.float32)
+    ours = np.asarray(ff.eval_batch([x]))
+    theirs = module(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-4, rtol=1e-4)
+
+
+def test_torch_reflected_scalar_and_positional_args():
+    """1-x / 2/x operand order, F.softmax positional dim, flatten(start_dim)."""
+    import torch.nn.functional as F
+
+    class M(torch.nn.Module):
+        def forward(self, x):
+            a = 1.0 - x
+            b = 2.0 / (x + 2.0)
+            c = F.softmax(a + b, 1)
+            return c.flatten(1)
+
+    module = M().eval()
+    ff, pt, out = _apply_torch(module, (4, 6))
+    rng = np.random.default_rng(2)
+    x = rng.uniform(0.5, 1.5, size=(4, 6)).astype(np.float32)
+    ours = np.asarray(ff.eval_batch([x]))
+    theirs = module(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-5, rtol=1e-5)
+
+
+def test_torch_flatten_start_dim():
+    class M(torch.nn.Module):
+        def forward(self, x):  # (B, 2, 3, 4) -> (B, 2, 12)
+            return x.flatten(2)
+
+    ff, pt, out = _apply_torch(M().eval(), (4, 2, 3, 4))
+    assert out.shape == (4, 2, 12)
+
+
+def test_onnx_gated():
+    """ONNX frontend raises a clear error when onnx is missing, or works
+    when present."""
+    try:
+        import onnx  # noqa: F401
+
+        has = True
+    except ImportError:
+        has = False
+    from flexflow_tpu.frontends.onnx_model import ONNXModel
+
+    if not has:
+        with pytest.raises(ImportError, match="onnx"):
+            ONNXModel("nonexistent.onnx")

@@ -607,19 +607,39 @@ class FFModel:
         batch_size: Optional[int] = None,
         epochs: Optional[int] = None,
         verbose: bool = True,
+        shuffle: bool = False,
+        seed: int = 0,
     ) -> PerfMetrics:
         """Canonical training loop (reference ``FFModel.fit``,
         ``flexflow_cffi.py:2062-2104``).  Each iteration is one cached jit
-        call — the analog of replaying a Legion trace."""
+        call — the analog of replaying a Legion trace.
+
+        Batch assembly runs through the native C++ prefetching loader
+        (``native/ffdl.cc``) when its build is available — a producer
+        thread gathers (optionally shuffled) rows into ring buffers ahead
+        of the step loop — falling back to the pure-Python loaders."""
         assert self.executor is not None, "call compile() first"
         bs = batch_size or self.config.batch_size
         epochs = epochs or self.config.epochs
         xs = list(x) if isinstance(x, (list, tuple)) else [x]
 
-        loaders = [
-            SingleDataLoader(a, bs, None, None) for a in xs
-        ] + [SingleDataLoader(y, bs, None, None)]
-        it = BatchIterator(loaders)
+        from flexflow_tpu.runtime.native import (
+            NativeBatchIterator,
+            native_available,
+        )
+
+        if native_available():
+            it = NativeBatchIterator(
+                [np.asarray(a) for a in xs] + [np.asarray(y)], bs,
+                shuffle=shuffle, seed=seed,
+            )
+        else:
+            loaders = [
+                SingleDataLoader(a, bs, None, None, shuffle=shuffle, seed=seed)
+                for a in xs
+            ] + [SingleDataLoader(y, bs, None, None, shuffle=shuffle, seed=seed)]
+            # identical seed => identical permutation => rows stay aligned
+            it = BatchIterator(loaders)
         if it.num_batches == 0:
             raise ValueError(
                 f"dataset has {len(xs[0])} samples < batch_size {bs}: zero batches"

@@ -1,0 +1,219 @@
+// Native data loader core.
+//
+// Reference counterpart: FlexFlow's SingleDataLoader + Legion index-task
+// batch staging (include/flexflow/dataloader.h:34-110,
+// src/dataloader/dataloader.cc:232-300): the reference stages the dataset
+// into zero-copy memory once and per-batch Legion tasks copy shards to
+// device, overlapping with compute via async task issue.
+//
+// TPU-native re-design: host-side batch assembly is the only part that
+// belongs in native code (device transfer + sharding is XLA's job).  A
+// worker thread gathers shuffled sample rows into a small ring of
+// contiguous batch buffers ahead of consumption, so Python never blocks on
+// row gather/memcpy and the fancy-indexing cost disappears from the step
+// loop.  Exposed as a flat C ABI for ctypes (no pybind11 in this image).
+//
+// Threading model: one producer thread per loader over a ring of `depth`
+// slots, with the producer allowed at most `depth - 1` batches ahead of
+// the consumer.  Hence a pointer returned by `ffdl_next` for batch i
+// remains valid until `depth - 1` further `ffdl_next` calls (and until the
+// next `ffdl_reset`, which invalidates all outstanding pointers).
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Array {
+  const uint8_t* data;
+  uint64_t rows;
+  uint64_t row_bytes;
+};
+
+// xorshift128+ — deterministic, seedable, fast enough for index shuffles
+struct Rng {
+  uint64_t s0, s1;
+  explicit Rng(uint64_t seed) {
+    s0 = seed ^ 0x9e3779b97f4a7c15ull;
+    s1 = (seed << 1) | 1;
+    for (int i = 0; i < 8; ++i) next();
+  }
+  uint64_t next() {
+    uint64_t x = s0, y = s1;
+    s0 = y;
+    x ^= x << 23;
+    s1 = x ^ y ^ (x >> 17) ^ (y >> 26);
+    return s1 + y;
+  }
+};
+
+struct Slot {
+  std::vector<std::vector<uint8_t>> buffers;  // one per array
+  int64_t batch_idx = -1;                     // which batch is READY here
+};
+
+struct Loader {
+  std::vector<Array> arrays;
+  uint64_t batch_size = 0;
+  uint64_t num_samples = 0;
+  bool shuffle = false;
+  Rng rng{0};
+  std::vector<uint64_t> order;
+
+  std::vector<Slot> slots;
+  int64_t depth = 3;
+
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv_producer, cv_consumer;
+  int64_t next_to_fill = 0;     // batch the producer will claim next
+  int64_t next_to_consume = 0;  // batch the consumer reads next
+  int64_t epoch_batches = 0;
+  bool busy = false;  // producer is copying outside the lock
+  bool stop = false;
+  bool started = false;
+
+  uint64_t num_batches() const { return num_samples / batch_size; }
+
+  void reshuffle() {
+    if (order.size() != num_samples) {
+      order.resize(num_samples);
+      for (uint64_t i = 0; i < num_samples; ++i) order[i] = i;
+    }
+    if (!shuffle) return;
+    for (uint64_t i = num_samples - 1; i > 0; --i) {
+      uint64_t j = rng.next() % (i + 1);
+      std::swap(order[i], order[j]);
+    }
+  }
+
+  void gather(Slot& slot, int64_t batch) {
+    const uint64_t base = static_cast<uint64_t>(batch) * batch_size;
+    for (size_t a = 0; a < arrays.size(); ++a) {
+      const Array& arr = arrays[a];
+      uint8_t* dst = slot.buffers[a].data();
+      for (uint64_t r = 0; r < batch_size; ++r) {
+        const uint64_t src_row = order[base + r];
+        std::memcpy(dst + r * arr.row_bytes,
+                    arr.data + src_row * arr.row_bytes, arr.row_bytes);
+      }
+    }
+  }
+
+  void run() {
+    for (;;) {
+      std::unique_lock<std::mutex> lk(mu);
+      cv_producer.wait(lk, [&] {
+        return stop || (next_to_fill < epoch_batches &&
+                        next_to_fill - next_to_consume < depth - 1);
+      });
+      if (stop) return;
+      const int64_t batch = next_to_fill;
+      next_to_fill = batch + 1;
+      busy = true;
+      Slot& slot = slots[batch % depth];
+      slot.batch_idx = -1;
+      lk.unlock();
+      gather(slot, batch);
+      lk.lock();
+      slot.batch_idx = batch;  // publish under the lock
+      busy = false;
+      cv_consumer.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ffdl_create(uint64_t batch_size, uint64_t seed, int shuffle,
+                  uint64_t prefetch_depth) {
+  auto* l = new Loader();
+  l->batch_size = batch_size;
+  l->shuffle = shuffle != 0;
+  l->rng = Rng(seed);
+  l->depth = prefetch_depth < 2 ? 2 : static_cast<int64_t>(prefetch_depth);
+  return l;
+}
+
+// Register one dataset array.  `data` must stay alive for the loader's
+// lifetime (Python keeps a reference).  Returns the array index, or a
+// negative error (-1 already started, -2 row-count mismatch).
+int ffdl_add_array(void* h, const void* data, uint64_t rows,
+                   uint64_t row_bytes) {
+  auto* l = static_cast<Loader*>(h);
+  if (l->started) return -1;
+  if (!l->arrays.empty() && rows != l->num_samples) return -2;
+  l->num_samples = rows;
+  l->arrays.push_back(
+      Array{static_cast<const uint8_t*>(data), rows, row_bytes});
+  return static_cast<int>(l->arrays.size()) - 1;
+}
+
+uint64_t ffdl_num_batches(void* h) {
+  return static_cast<Loader*>(h)->num_batches();
+}
+
+// Start (or restart for a new epoch) the producer.  Reshuffles when
+// enabled — the reference's `reset()`.  Invalidates outstanding pointers.
+void ffdl_reset(void* h) {
+  auto* l = static_cast<Loader*>(h);
+  {
+    std::unique_lock<std::mutex> lk(l->mu);
+    if (!l->started) {
+      l->slots.resize(l->depth);
+      for (auto& s : l->slots) {
+        s.buffers.resize(l->arrays.size());
+        for (size_t a = 0; a < l->arrays.size(); ++a)
+          s.buffers[a].resize(l->batch_size * l->arrays[a].row_bytes);
+      }
+      l->started = true;
+      l->worker = std::thread([l] { l->run(); });
+    }
+    // producer must not be mid-copy while we rewrite the order/slots;
+    // freeze it by exhausting its fill window, then wait for !busy
+    l->epoch_batches = 0;
+    l->cv_consumer.wait(lk, [&] { return !l->busy; });
+    l->reshuffle();
+    for (auto& s : l->slots) s.batch_idx = -1;
+    l->next_to_fill = 0;
+    l->next_to_consume = 0;
+    l->epoch_batches = static_cast<int64_t>(l->num_batches());
+  }
+  l->cv_producer.notify_all();
+}
+
+// Blocking: returns pointers to the assembled buffers of the next batch.
+// out_ptrs must have space for one pointer per registered array.
+// Returns the batch index, or -1 when the epoch is exhausted.
+int64_t ffdl_next(void* h, void** out_ptrs) {
+  auto* l = static_cast<Loader*>(h);
+  std::unique_lock<std::mutex> lk(l->mu);
+  if (l->next_to_consume >= l->epoch_batches) return -1;
+  const int64_t batch = l->next_to_consume;
+  Slot& slot = l->slots[batch % l->depth];
+  l->cv_consumer.wait(lk, [&] { return slot.batch_idx == batch; });
+  for (size_t a = 0; a < l->arrays.size(); ++a)
+    out_ptrs[a] = slot.buffers[a].data();
+  l->next_to_consume = batch + 1;
+  l->cv_producer.notify_all();
+  return batch;
+}
+
+void ffdl_destroy(void* h) {
+  auto* l = static_cast<Loader*>(h);
+  {
+    std::unique_lock<std::mutex> lk(l->mu);
+    l->stop = true;
+  }
+  l->cv_producer.notify_all();
+  if (l->worker.joinable()) l->worker.join();
+  delete l;
+}
+
+}  // extern "C"

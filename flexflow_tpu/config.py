@@ -54,6 +54,13 @@ class FFConfig:
     simulator_work_space_size: int = 2 * 1024 * 1024 * 1024
     machine_model_version: int = 0
     machine_model_file: Optional[str] = None
+    # measured cost tier: search candidates costed by compiling-and-timing
+    # ops on device (the reference's default behavior,
+    # ``src/runtime/simulator.cc:537-577``); off by default here because
+    # the analytic tier is free while measuring costs a jit compile per
+    # distinct (op, local shape)
+    use_measured_cost: bool = False
+    cost_cache_file: Optional[str] = None
     # --- TPU-specific (replaces Legion -ll:gpu etc.) ---
     mesh_shape: Optional[Tuple[int, ...]] = None  # e.g. (2, 4)
     mesh_axis_names: Tuple[str, ...] = ("data", "model")
@@ -141,6 +148,10 @@ class FFConfig:
                 self.machine_model_version = int(take())
             elif a == "--machine-model-file":
                 self.machine_model_file = take()
+            elif a == "--measured-cost":
+                self.use_measured_cost = True
+            elif a == "--cost-cache":
+                self.cost_cache_file = take()
             elif a == "--simulator-workspace-size":
                 self.simulator_work_space_size = int(take())
             elif a == "--mesh-shape":

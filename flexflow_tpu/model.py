@@ -568,12 +568,24 @@ class FFModel:
             elif cfg.search_budget > 0 and not cfg.only_data_parallel:
                 from flexflow_tpu.search import unity_search
 
+                machine = None
+                if cfg.machine_model_file:
+                    from flexflow_tpu.search.cost import TPUMachineModel
+
+                    machine = TPUMachineModel.from_file(cfg.machine_model_file)
+                profiler = None
+                if cfg.use_measured_cost:
+                    from flexflow_tpu.search.simulator import OpProfiler
+
+                    profiler = OpProfiler(cfg.cost_cache_file)
                 strategy = unity_search(
                     self.layers,
                     mesh,
                     graph_inputs=self.graph_inputs,
                     budget=cfg.search_budget,
                     alpha=cfg.search_alpha,
+                    machine=machine,
+                    profiler=profiler,
                     mem_budget_bytes=(
                         cfg.device_memory_gb * (1 << 30)
                         if cfg.device_memory_gb > 0
@@ -596,6 +608,7 @@ class FFModel:
             loss_type=loss_type,
             metrics=Metrics(loss_type, metrics),
             seed=seed if seed is not None else cfg.rng_seed,
+            compute_dtype=cfg.compute_dtype,
         )
         self.executor.init_params()
 

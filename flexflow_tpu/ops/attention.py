@@ -126,11 +126,19 @@ class MultiHeadAttention(OpDef):
             out = out.transpose(0, 2, 1, 3).reshape(b, sq, h * vd)
             return [out @ params["wo"]]
 
-        use_flash = a.get("use_flash", True) and dropout == 0.0
+        use_flash = a.get("use_flash", True) and kd == vd
         if use_flash and _flash_ok(sq, sk, kd):
             from flexflow_tpu.ops.pallas.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, causal=a.get("causal", False))
+            seed = (
+                jax.random.randint(ctx.next_rng(), (), 0, 2**31 - 1)
+                if dropout > 0.0
+                else 0
+            )
+            out = flash_attention(
+                q, k, v, causal=a.get("causal", False),
+                dropout_rate=dropout, seed=seed,
+            )
         else:
             rng = ctx.next_rng() if dropout > 0.0 else None
             out = sdpa(q, k, v, causal=a.get("causal", False),
@@ -156,12 +164,17 @@ class MultiHeadAttention(OpDef):
 
 
 def _flash_ok(sq: int, sk: int, d: int) -> bool:
-    """Flash kernel needs MXU-friendly tiles; fall back otherwise."""
+    """Flash kernel needs MXU-friendly seq tiles; head dim is free (the
+    kernel zero-pads it to the 128-lane grid, so BERT's d=64 qualifies —
+    round-1 verdict dropped the old ``d % 128`` gate).  Engages on TPU, or
+    anywhere when the kernels run in interpreter mode (tests)."""
     import jax as _jax
 
-    if _jax.default_backend() != "tpu":
+    from flexflow_tpu.ops.pallas import flash_attention as _fa
+
+    if not _fa.INTERPRET and _jax.default_backend() != "tpu":
         return False
-    return sq >= 128 and sk >= 128 and sq % 128 == 0 and sk % 128 == 0 and d % 128 == 0
+    return sq >= 128 and sk >= 128 and sq % 128 == 0 and sk % 128 == 0 and d >= 8
 
 
 register_op(MultiHeadAttention())

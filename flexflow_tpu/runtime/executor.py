@@ -17,11 +17,20 @@ Gradient synchronization: none explicit.  Sharded batch + replicated (or
 sharded) weights make GSPMD emit the all-reduce (or reduce-scatter) that
 the reference's NCCL optimizer tasks performed
 (``src/runtime/optimizer_kernel.cu:85-140``).
+
+Mixed precision (``compute_dtype="bfloat16"``): master params, optimizer
+state, BN running stats, loss and metrics stay float32; activations and
+op compute run in bfloat16 (params cast at use, inputs cast at graph
+entry, logits cast back before the loss).  The cast-at-use VJP yields
+float32 gradients, so update math is exact.  The reference runs fp32 on
+GPUs (no analog); on TPU bf16 doubles MXU throughput, which the search
+cost model already assumes (``search/cost.py``).
 """
 
 from __future__ import annotations
 
 import functools
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -54,6 +63,7 @@ class Executor:
         metrics: Metrics,
         seed: int = 0,
         use_remat: bool = False,
+        compute_dtype: str = "float32",
     ) -> None:
         self.layers = layers
         self.graph_inputs = graph_inputs
@@ -65,6 +75,8 @@ class Executor:
         self.metrics = metrics
         self.seed = seed
         self.use_remat = use_remat
+        self.compute_dtype = jnp.dtype(compute_dtype)
+        self._mixed = self.compute_dtype != jnp.float32
 
         self.mesh: Optional[Mesh] = None
         if strategy.mesh.size > 1:
@@ -114,6 +126,13 @@ class Executor:
                     return None  # first consumer decides
         return None
 
+    def _cast_compute(self, x: jax.Array) -> jax.Array:
+        """float32 -> compute dtype (identity when not mixed; never touches
+        integer/bool tensors or already-low-precision arrays)."""
+        if self._mixed and hasattr(x, "dtype") and x.dtype == jnp.float32:
+            return x.astype(self.compute_dtype)
+        return x
+
     # --- forward trace -----------------------------------------------------
     def _forward(
         self,
@@ -130,7 +149,7 @@ class Executor:
         shardings: Dict[int, TensorSharding] = {}
         for t, x in zip(self.graph_inputs, inputs):
             ps = self._input_pspec(t)
-            values[t.guid] = self._constrain(x, ps)
+            values[t.guid] = self._constrain(self._cast_compute(x), ps)
             spec = tuple(ps)
             shardings[t.guid] = TensorSharding(
                 spec=spec + (None,) * (t.ndim - len(spec))
@@ -141,11 +160,12 @@ class Executor:
         for layer in self.layers:
             opdef = get_op_def(layer.op_type)
             ins = [values[t.guid] for t in layer.inputs]
-            lp = dict(params.get(layer.name, {}))
-            lp.update(state.get(layer.name, {}))
+            lp32 = dict(params.get(layer.name, {}))
+            lp32.update(state.get(layer.name, {}))
+            lp = {k: self._cast_compute(v) for k, v in lp32.items()}
             ctx = OpContext(
                 training=training,
-                rng=jax.random.fold_in(rng, hash(layer.name) % (2**31)) if rng is not None else None,
+                rng=jax.random.fold_in(rng, zlib.crc32(layer.name.encode()) % (2**31)) if rng is not None else None,
                 mesh=self.mesh,
                 input_shardings=[shardings.get(t.guid) for t in layer.inputs],
                 op_sharding=self.strategy.op_sharding(layer),
@@ -177,9 +197,14 @@ class Executor:
                 else:
                     shardings[t.guid] = TensorSharding.replicated(t.ndim)
                 values[t.guid] = y
-            # stateful ops (BN running stats)
+            # stateful ops (BN running stats) — accumulated in float32 even
+            # under bf16 compute, like the reference's fp32 cudnn stats
             if training and hasattr(opdef, "state_update") and state.get(layer.name):
-                new_state[layer.name] = opdef.state_update(layer, lp, ins)
+                ins32 = [
+                    x.astype(jnp.float32) if x.dtype == self.compute_dtype else x
+                    for x in ins
+                ] if self._mixed else ins
+                new_state[layer.name] = opdef.state_update(layer, lp32, ins32)
             # MoE aux (load-balance) loss — reference lambda_bal in aggregate
             if (
                 layer.op_type in (OperatorType.AGGREGATE, OperatorType.AGGREGATE_SPEC)
@@ -199,7 +224,10 @@ class Executor:
         for name, s in state.items():
             if name not in new_state:
                 new_state[name] = s
-        return values[self.logits.guid], new_state, aux_losses
+        logits = values[self.logits.guid]
+        if self._mixed and logits.dtype == self.compute_dtype:
+            logits = logits.astype(jnp.float32)  # loss/metrics in fp32
+        return logits, new_state, aux_losses
 
     # --- param init --------------------------------------------------------
     def init_params(self, key: Optional[jax.Array] = None) -> None:

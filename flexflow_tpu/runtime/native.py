@@ -80,10 +80,15 @@ class NativeBatchIterator:
     the C++ prefetching loader: a producer thread assembles (optionally
     shuffled) batches for all arrays into a ring of contiguous buffers.
 
-    Returned numpy arrays are **views** into ring slots — valid until
-    ``prefetch_depth - 1`` further batches are drawn (the consumer hands
-    them straight to ``device_put``, which copies synchronously for host
-    numpy inputs, so the window is never an issue in the step loop).
+    Returned numpy arrays are **owned copies** of the ring slots.  They
+    must not be views: the CPU backend zero-copy-aliases aligned host
+    buffers in ``device_put``/``asarray``, and a consumer that defers
+    synchronization (e.g. ``fit`` with no metrics) can have steps still
+    queued when the producer recycles the slot — or when this iterator is
+    garbage-collected and ``ffdl_destroy`` frees the ring (use-after-free,
+    observed as NaN weights in the round-1 DP-consistency test).  The copy
+    is a memcpy; the producer thread still overlaps gather/shuffle with
+    the step loop, which is where the win is.
     """
 
     def __init__(self, arrays: Sequence[np.ndarray], batch_size: int,
@@ -120,7 +125,9 @@ class NativeBatchIterator:
             for i, (shape, dtype) in enumerate(zip(self._shapes, self._dtypes)):
                 n = int(np.prod(shape, dtype=np.int64))
                 buf = (ctypes.c_char * (n * dtype.itemsize)).from_address(self._out[i])
-                batch.append(np.frombuffer(buf, dtype=dtype).reshape(shape))
+                # copy: see class docstring — views into ring slots are
+                # unsafe under async dispatch + zero-copy device_put
+                batch.append(np.frombuffer(buf, dtype=dtype).reshape(shape).copy())
             yield tuple(batch)
 
     def __del__(self):

@@ -55,6 +55,7 @@ class SearchHelper:
         machine: Optional[TPUMachineModel] = None,
         beam: int = 16,
         lambda_mem: float = 0.0,
+        node_time_fn=None,
     ) -> None:
         self.layers = layers
         self.graph_inputs = graph_inputs
@@ -62,6 +63,11 @@ class SearchHelper:
         self.machine = machine or TPUMachineModel()
         self.beam = beam
         self.lambda_mem = lambda_mem
+        # measured-cost tier (reference: search driven by on-device kernel
+        # timing, ``src/runtime/simulator.cc:537-577``): when provided, leaf
+        # compute times come from (layer, sharding) -> seconds instead of
+        # the analytic roofline
+        self.node_time_fn = node_time_fn
 
         # tensor guid -> list of consumer layer indices (for liveness)
         self.consumers: Dict[int, List[int]] = {}
@@ -130,6 +136,11 @@ class SearchHelper:
                         c = node_cost(
                             layer, cand, self.mesh, self.machine,
                             lambda_mem=self.lambda_mem,
+                            compute_time=(
+                                self.node_time_fn(layer, cand)
+                                if self.node_time_fn
+                                else None
+                            ),
                         )
                         for i, t in enumerate(layer.inputs):
                             want = cand.inputs[i] if i < len(cand.inputs) else None

@@ -36,6 +36,7 @@ def unity_search(
     mem_budget_bytes: Optional[float] = None,
     explore_meshes: bool = True,
     beam: int = 16,
+    profiler=None,
 ) -> Strategy:
     """Pick the cheapest (mesh factorization, per-op sharding) pair.
 
@@ -43,6 +44,13 @@ def unity_search(
     flags (``substitution.cc:2229`` loop bound / pruning threshold);
     ``mem_budget_bytes`` activates the λ memory search
     (``graph.cc:2056-2131``).
+
+    ``profiler``: an :class:`~flexflow_tpu.search.simulator.OpProfiler`
+    activates the measured cost tier — every candidate's leaf compute time
+    comes from compiling-and-timing the op at its per-shard shape (the
+    reference's on-device micro-profiling,
+    ``src/runtime/simulator.cc:537-577``), cached across meshes since the
+    cache key is (op params, local shapes).
     """
     if graph_inputs is None:
         seen = set()
@@ -67,10 +75,17 @@ def unity_search(
     best: Optional[Strategy] = None
     best_cost = float("inf")
     for mv in cands:
-        def run(lam: float, _mv=mv):
+        node_time_fn = None
+        if profiler is not None:
+            from flexflow_tpu.search.simulator import MeasuredCostModel
+
+            node_time_fn = MeasuredCostModel(profiler, mv, machine).node_time
+
+        def run(lam: float, _mv=mv, _ntf=node_time_fn):
             return graph_optimize(
                 layers, graph_inputs, _mv, machine,
                 budget=budget, alpha=alpha, beam=beam, lambda_mem=lam,
+                node_time_fn=_ntf,
             )
 
         try:
@@ -91,4 +106,6 @@ def unity_search(
             st.ops = assign
             best = st
     assert best is not None, "no feasible mesh factorization"
+    if profiler is not None:
+        profiler.save()  # persist the cost cache across sessions
     return best

@@ -226,17 +226,23 @@ def base_optimize(
     budget: int = 20,
     alpha: float = 1.05,
     lambda_mem: float = 0.0,
+    node_time_fn=None,
 ) -> Tuple[float, Dict[int, OpSharding]]:
     """Best-first backtracking over xfer applications (reference
     ``base_optimize``, ``substitution.cc:2229-2311``): pop the cheapest
     assignment, try every xfer at every match, keep candidates under
-    ``alpha * best``; ``budget`` bounds pops."""
+    ``alpha * best``; ``budget`` bounds pops.  ``node_time_fn`` plugs the
+    measured cost tier into every candidate evaluation (the reference's
+    defining feature: search driven by on-device kernel timing,
+    ``src/runtime/simulator.cc:537-577``)."""
     m = machine or TPUMachineModel()
 
     def cost_of(assign: Dict[int, OpSharding]) -> float:
         st = Strategy(mesh)
         st.ops = assign
-        return estimate_strategy_cost(layers, st, m, lambda_mem=lambda_mem)
+        return estimate_strategy_cost(
+            layers, st, m, lambda_mem=lambda_mem, node_time_fn=node_time_fn
+        )
 
     xfers = generate_all_pcg_xfers(mesh)
     matches = [(x, mt) for x in xfers for mt in x.find_matches(layers)]
@@ -310,6 +316,7 @@ def graph_optimize(
     alpha: float = 1.05,
     beam: int = 16,
     lambda_mem: float = 0.0,
+    node_time_fn=None,
     _depth: int = 0,
 ) -> Tuple[float, Dict[int, OpSharding]]:
     """Recursive optimize (reference ``GraphSearchHelper::graph_optimize``,
@@ -324,21 +331,25 @@ def graph_optimize(
             pre, post = layers[: split + 1], layers[split + 1 :]
             _, a1 = graph_optimize(
                 pre, graph_inputs, mesh, machine, budget // 2 or 1, alpha,
-                beam, lambda_mem, _depth + 1,
+                beam, lambda_mem, node_time_fn, _depth + 1,
             )
             post_inputs = [t for l in post for t in l.inputs
                            if t.owner_layer is None or t.owner_layer in pre]
             _, a2 = graph_optimize(
                 post, post_inputs, mesh, machine, budget // 2 or 1, alpha,
-                beam, lambda_mem, _depth + 1,
+                beam, lambda_mem, node_time_fn, _depth + 1,
             )
             merged = {**a1, **a2}
             return base_optimize(
-                layers, mesh, merged, machine, budget, alpha, lambda_mem
+                layers, mesh, merged, machine, budget, alpha, lambda_mem,
+                node_time_fn,
             )
 
     helper = SearchHelper(
-        layers, graph_inputs, mesh, machine, beam=beam, lambda_mem=lambda_mem
+        layers, graph_inputs, mesh, machine, beam=beam, lambda_mem=lambda_mem,
+        node_time_fn=node_time_fn,
     )
     _, assign = helper.solve()
-    return base_optimize(layers, mesh, assign, machine, budget, alpha, lambda_mem)
+    return base_optimize(
+        layers, mesh, assign, machine, budget, alpha, lambda_mem, node_time_fn
+    )

@@ -1,0 +1,118 @@
+"""bf16 mixed-precision end-to-end (VERDICT r1 item #2).
+
+Contract (executor docstring): master params + optimizer state + BN
+running stats + loss stay float32; compute runs in bfloat16; logits are
+cast back to float32 before the loss.  The reference has no mixed
+precision (fp32 CUDA kernels throughout); this is the TPU-first perf
+lever, so it gets its own test tier instead of the reference's
+example-driven coverage (SURVEY §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    ActiMode,
+    AdamOptimizer,
+    FFConfig,
+    FFModel,
+    LossType,
+    MachineMesh,
+    MetricsType,
+)
+
+
+def _mlp(cfg, batch=16, din=32, hidden=64, classes=10):
+    model = FFModel(cfg)
+    x = model.create_tensor((batch, din))
+    t = model.dense(x, hidden, ActiMode.RELU)
+    t = model.dense(t, classes)
+    model.softmax(t)
+    return model, x
+
+
+def _data(batch=16, din=32, classes=10, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(batch, din)).astype(np.float32)
+    y = rng.integers(0, classes, size=(batch, 1)).astype(np.int32)
+    return x, y
+
+
+def test_bf16_trains_and_keeps_fp32_master_state():
+    cfg = FFConfig(batch_size=16, compute_dtype="bfloat16")
+    model, _ = _mlp(cfg)
+    model.compile(
+        optimizer=AdamOptimizer(alpha=1e-2),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    ex = model.executor
+    # master params fp32
+    for lw in ex.params.values():
+        for w in lw.values():
+            assert w.dtype == jnp.float32
+    x, y = _data()
+    losses = []
+    for _ in range(30):
+        loss, m = ex.train_step([x], y)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+    # params still fp32 after updates; optimizer state fp32
+    for lw in ex.params.values():
+        for w in lw.values():
+            assert w.dtype == jnp.float32
+    flat, _ = jax.tree.flatten(ex.opt_state)
+    for leaf in flat:
+        assert leaf.dtype in (jnp.float32, jnp.int32), leaf.dtype
+
+
+def test_bf16_forward_close_to_fp32():
+    x, _ = _data()
+    outs = {}
+    for dt in ("float32", "bfloat16"):
+        cfg = FFConfig(batch_size=16, compute_dtype=dt)
+        model, _ = _mlp(cfg)
+        model.compile(optimizer=AdamOptimizer(alpha=1e-3), seed=7)
+        out = model.eval_batch([x])
+        assert out.dtype == jnp.float32  # cast back at the boundary
+        outs[dt] = np.asarray(out)
+    np.testing.assert_allclose(outs["float32"], outs["bfloat16"], atol=3e-2)
+
+
+def test_bf16_dp_mesh_train():
+    cfg = FFConfig(batch_size=16, compute_dtype="bfloat16")
+    model, _ = _mlp(cfg)
+    model.compile(
+        optimizer=AdamOptimizer(alpha=1e-2),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        mesh=MachineMesh((8,), ("data",)),
+    )
+    x, y = _data()
+    l0, _ = model.executor.train_step([x], y)
+    for _ in range(20):
+        ln, _ = model.executor.train_step([x], y)
+    assert float(ln) < float(l0)
+
+
+def test_bf16_bn_running_stats_stay_fp32():
+    cfg = FFConfig(batch_size=8, compute_dtype="bfloat16")
+    model = FFModel(cfg)
+    x = model.create_tensor((8, 3, 8, 8))
+    t = model.conv2d(x, 4, 3, 3, 1, 1, 1, 1)
+    t = model.batch_norm(t)
+    t = model.flat(t)
+    t = model.dense(t, 4)
+    model.softmax(t)
+    model.compile(optimizer=AdamOptimizer(alpha=1e-3))
+    rng = np.random.default_rng(0)
+    xb = rng.normal(size=(8, 3, 8, 8)).astype(np.float32)
+    yb = rng.integers(0, 4, size=(8, 1)).astype(np.int32)
+    model.executor.train_step([xb], yb)
+    bn_state = model.executor.state["batch_norm_0"]
+    assert bn_state["running_mean"].dtype == jnp.float32
+    assert bn_state["running_var"].dtype == jnp.float32
+    # stats actually moved off their init values
+    assert float(jnp.abs(bn_state["running_mean"]).max()) > 0.0

@@ -138,6 +138,7 @@ class OperatorType(enum.Enum):
     MULTIHEAD_ATTENTION = "multihead_attention"
     TOPK = "topk"
     GROUP_BY = "group_by"
+    EXPERTS = "experts"
     CAST = "cast"
     FUSED = "fused"
     # --- parallel ops (the resharding vocabulary, ffconst.h:152-158) ---

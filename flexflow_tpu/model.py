@@ -387,6 +387,29 @@ class FFModel:
             dict(n=n, lambda_bal=lambda_bal),
         )[0]
 
+    def experts(
+        self,
+        input: Tensor,
+        assign: Tensor,
+        gate_preds: Tensor,
+        gate_full: Tensor,
+        num_experts: int,
+        hidden: int,
+        alpha: float = 2.0,
+        lambda_bal: float = 0.0,
+        name: Optional[str] = None,
+    ) -> Tensor:
+        """Fused expert block (dispatch + batched expert FFN + combine) with
+        batched ``(n, ...)`` expert weights — the expert-parallel-ready form
+        of the reference's group_by -> dense experts -> aggregate pipeline
+        (``src/ops/moe.cc:20-44``).  See :class:`flexflow_tpu.ops.moe.Experts`."""
+        return self._add_layer(
+            OperatorType.EXPERTS,
+            self._name("experts", name),
+            [input, assign, gate_preds, gate_full],
+            dict(n_experts=num_experts, hidden=hidden, alpha=alpha, lambda_bal=lambda_bal),
+        )[0]
+
     def moe(
         self,
         input: Tensor,
@@ -395,13 +418,23 @@ class FFModel:
         expert_hidden_size: int,
         alpha: float = 2.0,
         lambda_bal: float = 0.04,
+        fused: bool = False,
         name: Optional[str] = None,
     ) -> Tensor:
         """Composite MoE — mirrors ``FFModel::moe`` (``src/ops/moe.cc:20-44``):
-        gate -> topk -> group_by -> experts -> aggregate."""
+        gate -> topk -> group_by -> experts -> aggregate.
+
+        ``fused=True`` lowers the group_by/experts/aggregate tail to the
+        single batched :meth:`experts` op — same math, expert-parallel
+        capable (weights shard over the ``expert`` mesh axis)."""
         gate = self.dense(input, num_exp, ActiMode.NONE, name=self._name("moe_gate", name))
         gate = self.softmax(gate)
         topk_out, topk_idx = self.top_k(gate, num_select)
+        if fused:
+            return self.experts(
+                input, topk_idx, topk_out, gate, num_exp, expert_hidden_size,
+                alpha, lambda_bal, name=self._name("moe_experts", name),
+            )
         grouped = self.group_by(input, topk_idx, num_exp, alpha)
         experts = [
             self.dense(

@@ -35,10 +35,14 @@ def moe_classifier(
     num_classes: int = OUT_DIM,
     alpha: float = ALPHA,
     lambda_bal: float = LAMBDA,
+    fused: bool = False,
 ) -> Tensor:
-    """``moe.cc:150-166``: moe composite + relu dense head + softmax."""
+    """``moe.cc:150-166``: moe composite + relu dense head + softmax.
+
+    ``fused=True`` uses the batched expert-parallel-capable Experts op
+    (weights shard over the ``expert`` mesh axis)."""
     t = model.create_tensor((batch, in_dim), name="features")
-    t = model.moe(t, num_exp, num_select, hidden, alpha, lambda_bal)
+    t = model.moe(t, num_exp, num_select, hidden, alpha, lambda_bal, fused=fused)
     t = model.dense(t, num_classes, ActiMode.RELU)
     return model.softmax(t)
 
@@ -55,12 +59,14 @@ def moe_encoder(
     num_classes: int = OUT_DIM,
     alpha: float = ALPHA,
     lambda_bal: float = LAMBDA,
+    fused: bool = False,
 ) -> Tensor:
     """``moe.cc:102-130`` ``create_moe_encoder``: attention + MoE-FFN
     blocks with post-LN residuals, then a classifier head over the pooled
     sequence.  The MoE composite operates on flattened (batch*seq, hidden)
     tokens — expert routing is per-token, as in the reference (group_by
-    over the sample dim)."""
+    over the sample dim).  ``fused=True`` makes the FFN expert-parallel
+    capable (batched Experts op)."""
     x = model.create_tensor((batch, seq, hidden), name="tokens")
     for i in range(num_layers):
         attn = model.multihead_attention(
@@ -69,7 +75,7 @@ def moe_encoder(
         x = model.layer_norm(model.add(attn, x), axes=[-1], name=f"moeenc{i}_ln0")
         flat = model.reshape(x, (batch * seq, hidden), name=f"moeenc{i}_flat")
         ff = model.moe(flat, num_exp, num_select, hidden, alpha, lambda_bal,
-                       name=f"moeenc{i}_moe")
+                       fused=fused, name=f"moeenc{i}_moe")
         ff = model.reshape(ff, (batch, seq, hidden), name=f"moeenc{i}_unflat")
         x = model.layer_norm(model.add(ff, x), axes=[-1], name=f"moeenc{i}_ln1")
     t = model.reduce_mean(x, axes=[1], name="pool")

@@ -144,6 +144,164 @@ class AggregateSpec(Aggregate):
     op_type = OperatorType.AGGREGATE_SPEC
 
 
+def _expert_ffn(x, w1, b1, w2, b2):
+    """Batched two-layer expert FFN: x (e, c, d) with per-expert weights."""
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", x, w1) + b1[:, None, :])
+    return jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+
+
+class Experts(OpDef):
+    """Fused MoE expert block: dispatch -> batched expert FFN -> combine.
+
+    Realizes the reference's group_by -> N dense experts -> aggregate
+    pipeline (``src/ops/{group_by,aggregate}.cc``, composite
+    ``src/ops/moe.cc:20-44``) as ONE op whose expert weights are *batched*
+    on a leading ``(n_experts, ...)`` dim — the layout that makes expert
+    parallelism a plain sharding decision: shard dim 0 of every expert
+    weight over the ``expert`` mesh axis.
+
+    Inputs: data (t, d), assign int32 (t, k), gate_preds (t, k),
+    gate_full (t, n) (for the lambda_bal aux loss).
+    Weights: w1 (n, d, h), b1 (n, h), w2 (n, h, d), b2 (n, d).
+    Output: (t, d).
+
+    Two execution paths:
+      * dense (single device / no expert axis): one-hot dispatch einsums —
+        rides the MXU, XLA fuses.
+      * expert-parallel (``w1`` arrives sharded over an ``expert`` axis):
+        GShard-style ``shard_map`` — local dispatch, ``all_to_all`` tokens
+        to the devices owning their experts, local batched FFN on the
+        expert shard, reverse ``all_to_all``, local weighted combine.  This
+        is the TPU analog of the reference placing each expert's dense ops
+        on distinct devices (SURVEY §2.4 EP checklist).
+    """
+
+    op_type = OperatorType.EXPERTS
+
+    def infer(self, layer: Layer) -> List[ShapeDtype]:
+        data = layer.inputs[0]
+        return [(data.shape, data.dtype)]
+
+    def weights(self, layer: Layer):
+        from flexflow_tpu.initializer import (
+            default_bias_initializer,
+            default_kernel_initializer,
+        )
+        from flexflow_tpu.ops.base import WeightSpec
+
+        data = layer.inputs[0]
+        n = layer.attrs["n_experts"]
+        d = data.shape[-1]
+        h = layer.attrs["hidden"]
+        init = layer.attrs.get("kernel_initializer") or default_kernel_initializer()
+        zi = default_bias_initializer()
+        dt = data.dtype
+        return [
+            WeightSpec("w1", (n, d, h), dt, init, tp_dim=0),
+            WeightSpec("b1", (n, h), dt, zi, tp_dim=0),
+            WeightSpec("w2", (n, h, d), dt, init, tp_dim=0),
+            WeightSpec("b2", (n, d), dt, zi, tp_dim=0),
+        ]
+
+    def partitionable_dims(self, layer: Layer):
+        return {0: "sample"}
+
+    def forward(self, layer, params, inputs, ctx: OpContext):
+        x, assign, gate_preds = inputs[0], inputs[1], inputs[2]
+        n = layer.attrs["n_experts"]
+        alpha = layer.attrs.get("alpha", 1.0)
+        k = assign.shape[-1]
+        t = x.shape[0]
+
+        ep_axis = ctx.weight_axis("w1", 0)
+        ep = ctx.mesh.shape[ep_axis] if (ctx.mesh is not None and ep_axis) else 1
+        if ep > 1 and n % ep == 0:
+            out = self._forward_ep(layer, params, x, assign, gate_preds, ctx, ep_axis, ep)
+            if out is not None:
+                return [out]
+
+        cap = expert_capacity(t, n, k, alpha)
+        dispatch, _, within = make_dispatch(assign, n, cap)
+        grouped = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32)).astype(x.dtype)
+        y = _expert_ffn(grouped, params["w1"], params["b1"], params["w2"], params["b2"])
+        gates = (gate_preds * within.astype(gate_preds.dtype)).astype(jnp.float32)
+        eoh = jax.nn.one_hot(assign, n, dtype=jnp.float32)
+        w_te = jnp.einsum("tk,tke->te", gates, eoh)
+        out = jnp.einsum("tec,te,ecd->td", dispatch, w_te, y.astype(jnp.float32))
+        return [out.astype(x.dtype)]
+
+    def _forward_ep(self, layer, params, x, assign, gate_preds, ctx, ep_axis, ep):
+        """Expert-parallel path under shard_map.  Tokens are sharded over
+        (dp_axis?, ep_axis); experts over ep_axis.  Returns None when shapes
+        don't divide (caller falls back to the dense path)."""
+        from jax.sharding import PartitionSpec as P
+
+        n = layer.attrs["n_experts"]
+        alpha = layer.attrs.get("alpha", 1.0)
+        t, k = assign.shape
+        b_axes = ctx.input_shardings[0].axes_of(0) if (
+            ctx.input_shardings and ctx.input_shardings[0] is not None
+        ) else ()
+        dp_axis = next((a for a in b_axes if a != ep_axis), None)
+        dp = ctx.mesh.shape[dp_axis] if dp_axis else 1
+        shards = dp * ep
+        if t % shards != 0:
+            return None
+        tok_axes = (dp_axis, ep_axis) if dp_axis else ep_axis
+        n_l = n // ep
+        t_l = t // shards
+        # local per-(source-shard, expert) capacity; global slot budget is
+        # then shards * c_l per expert — same alpha semantics as dense
+        c_l = expert_capacity(t_l, n, k, alpha)
+
+        def body(xs, asg, gts, w1, b1, w2, b2):
+            # xs (t_l, d), asg (t_l, k), gts (t_l, k); w* lead dim n_l
+            dispatch, _, within = make_dispatch(asg, n, c_l)  # (t_l, n, c_l)
+            grouped = jnp.einsum(
+                "tec,td->ecd", dispatch, xs.astype(jnp.float32)
+            ).astype(xs.dtype)  # (n, c_l, d)
+            d_model = grouped.shape[-1]
+            g = grouped.reshape(ep, n_l, c_l, d_model)
+            # device p receives, from every source shard j, the rows j
+            # dispatched to p's expert group
+            g = jax.lax.all_to_all(g, ep_axis, split_axis=0, concat_axis=0)
+            g = g.transpose(1, 0, 2, 3).reshape(n_l, ep * c_l, d_model)
+            y = _expert_ffn(g, w1, b1, w2, b2)  # (n_l, ep*c_l, d)
+            y = y.reshape(n_l, ep, c_l, d_model).transpose(1, 0, 2, 3)
+            y = jax.lax.all_to_all(y, ep_axis, split_axis=0, concat_axis=0)
+            y = y.reshape(n, c_l, d_model)  # all experts' outputs, my tokens
+            gates = (gts * within.astype(gts.dtype)).astype(jnp.float32)
+            eoh = jax.nn.one_hot(asg, n, dtype=jnp.float32)
+            w_te = jnp.einsum("tk,tke->te", gates, eoh)
+            out = jnp.einsum("tec,te,ecd->td", dispatch, w_te, y.astype(jnp.float32))
+            return out.astype(xs.dtype)
+
+        f = jax.shard_map(
+            body,
+            mesh=ctx.mesh,
+            in_specs=(
+                P(tok_axes, None), P(tok_axes, None), P(tok_axes, None),
+                P(ep_axis, None, None), P(ep_axis, None),
+                P(ep_axis, None, None), P(ep_axis, None),
+            ),
+            out_specs=P(tok_axes, None),
+            check_vma=False,
+        )
+        return f(x, assign, gate_preds,
+                 params["w1"], params["b1"], params["w2"], params["b2"])
+
+    def flops(self, layer: Layer) -> float:
+        data = layer.inputs[0]
+        t, d = data.shape[0], data.shape[-1]
+        n = layer.attrs["n_experts"]
+        h = layer.attrs["hidden"]
+        k = layer.inputs[1].shape[-1]
+        cap = expert_capacity(t, n, k, layer.attrs.get("alpha", 1.0))
+        # dispatch + combine einsums + expert FFN on n*cap rows
+        return 2.0 * t * n * cap * d * 2 + 4.0 * n * cap * d * h
+
+
 register_op(GroupBy())
 register_op(Aggregate())
 register_op(AggregateSpec())
+register_op(Experts())

@@ -236,6 +236,60 @@ def sequence_parallel_strategy(
     return st
 
 
+def expert_parallel_strategy(
+    layers: List[Layer],
+    mesh: MachineMesh,
+    ep_axis: str = "expert",
+    dp_axis: str = "data",
+    base: Optional[Strategy] = None,
+) -> Strategy:
+    """Expert parallelism: shard the batched ``(n, ...)`` expert weights of
+    every :class:`~flexflow_tpu.ops.moe.Experts` op over ``ep_axis`` and its
+    token stream over ``(dp_axis, ep_axis)``; the op's forward opens the
+    all-to-all dispatch (``Experts._forward_ep``).
+
+    TPU realization of the reference's EP (experts as separate dense ops
+    placed on distinct devices, ``src/ops/group_by.cc`` /
+    ``src/ops/aggregate.cc``; SURVEY §2.4 EP checklist).  Composes on top of
+    ``base`` (defaults to all-DP) so dp×ep hybrids come for free.
+    """
+    src = base if base is not None else data_parallel_strategy(layers, mesh)
+    ep = mesh.axis_size(ep_axis)
+    if ep <= 1:
+        return src
+    st = Strategy(mesh)
+    st.ops = {guid: s.copy() for guid, s in src.ops.items()}
+    dp = mesh.axis_size(dp_axis)
+    for layer in layers:
+        if layer.op_type is not OperatorType.EXPERTS:
+            continue
+        n = layer.attrs["n_experts"]
+        if n % ep != 0:
+            continue
+        t = layer.inputs[0].shape[0]
+        if t % (dp * ep) != 0:
+            continue
+        entry = st.ops[int(layer.layer_guid)]
+        for w in get_op_def(layer.op_type).weights(layer):
+            spec = [None] * len(w.shape)
+            spec[0] = ep_axis
+            entry.weights[w.name] = TensorSharding(spec=tuple(spec))
+        # tokens sharded over (dp, ep) into the op; output returns to the
+        # base distribution via the op's out_specs + output constraint
+        tok = (dp_axis, ep_axis) if dp > 1 else ep_axis
+        entry.inputs = []
+        for it in layer.inputs:
+            spec = [None] * it.ndim
+            spec[0] = tok
+            entry.inputs.append(TensorSharding(spec=tuple(spec)))
+        o = entry.output[0]
+        ospec = list(o.spec)
+        ospec[0] = tok
+        entry.output[0] = TensorSharding(spec=tuple(ospec), partial_axes=o.partial_axes)
+        entry.extras["ep_axis"] = ep_axis
+    return st
+
+
 def tensor_parallel_strategy(
     layers: List[Layer],
     mesh: MachineMesh,

@@ -207,18 +207,20 @@ class Executor:
                 new_state[layer.name] = opdef.state_update(layer, lp32, ins32)
             # MoE aux (load-balance) loss — reference lambda_bal in aggregate
             if (
-                layer.op_type in (OperatorType.AGGREGATE, OperatorType.AGGREGATE_SPEC)
+                layer.op_type
+                in (OperatorType.AGGREGATE, OperatorType.AGGREGATE_SPEC, OperatorType.EXPERTS)
                 and layer.attrs.get("lambda_bal", 0.0) > 0.0
             ):
                 from flexflow_tpu.ops.moe import Aggregate
 
                 # inputs[3] is the full softmax gate (t, n) — see Aggregate
-                # docstring; inputs[0] is only the top-k slice.
+                # docstring; inputs[0] of aggregate is only the top-k slice.
                 gate_probs = values[layer.inputs[3].guid]
                 assign = values[layer.inputs[1].guid]
+                n = layer.attrs.get("n", layer.attrs.get("n_experts"))
                 aux_losses.append(
                     layer.attrs["lambda_bal"]
-                    * Aggregate.aux_loss(gate_probs, assign, layer.attrs["n"])
+                    * Aggregate.aux_loss(gate_probs, assign, n)
                 )
         # carry over unchanged state
         for name, s in state.items():

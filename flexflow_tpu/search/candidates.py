@@ -249,4 +249,25 @@ def op_candidates(layer: Layer, mesh: MachineMesh) -> List[OpSharding]:
                 )
                 add([out], wspec, [_spec_with(ids.ndim, batch)])
 
+    # expert parallelism: batched expert weights shard over the 'expert'
+    # axis; the op's forward opens the all-to-all dispatch internally
+    # (reference EP = experts placed on distinct devices, SURVEY §2.4)
+    epd = mesh.axis_size("expert")
+    if (
+        layer.op_type is OperatorType.EXPERTS
+        and epd > 1
+        and layer.attrs["n_experts"] % epd == 0
+        and layer.inputs[0].shape[0] % (epd * max(dp, 1)) == 0
+    ):
+        wspec = {}
+        for w in get_op_def(layer.op_type).weights(layer):
+            spec: List = [None] * len(w.shape)
+            spec[0] = "expert"
+            wspec[w.name] = TensorSharding(spec=tuple(spec))
+        t = layer.inputs[0]
+        batch = {0: "data"} if dp > 1 and t.shape[0] % dp == 0 else {}
+        out = _spec_with(len(outs[0][0]), batch)
+        inputs = [_spec_with(i.ndim, batch) for i in layer.inputs]
+        add([out], wspec, inputs)
+
     return _dedup(cands)

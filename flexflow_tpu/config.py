@@ -64,6 +64,11 @@ class FFConfig:
     # --- TPU-specific (replaces Legion -ll:gpu etc.) ---
     mesh_shape: Optional[Tuple[int, ...]] = None  # e.g. (2, 4)
     mesh_axis_names: Tuple[str, ...] = ("data", "model")
+    # --- multi-host (reference MULTI-NODE.md: GASNet/MPI launcher) ---
+    coordinator_address: Optional[str] = None  # host:port of process 0
+    num_nodes_cli: Optional[int] = None  # process count (None = env/auto)
+    node_id: Optional[int] = None  # this process's index
+    dcn_axis: str = "data"  # mesh axis that spans hosts
     compute_dtype: str = "float32"  # params/compute dtype; "bfloat16" for perf
     rng_seed: int = 0
     memory_search_budget: int = -1  # lambda search iterations (graph.cc:2075)
@@ -162,6 +167,14 @@ class FFConfig:
                 self.rng_seed = int(take())
             elif a == "--device-memory-gb":
                 self.device_memory_gb = float(take())
+            elif a == "--coordinator-address":
+                self.coordinator_address = take()
+            elif a == "--num-nodes":
+                self.num_nodes_cli = int(take())
+            elif a == "--node-id":
+                self.node_id = int(take())
+            elif a == "--dcn-axis":
+                self.dcn_axis = take()
             else:
                 rest.append(a)
             i += 1

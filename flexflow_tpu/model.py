@@ -55,6 +55,19 @@ from flexflow_tpu.tensor import Layer, Tensor
 class FFModel:
     def __init__(self, config: Optional[FFConfig] = None) -> None:
         self.config = config or FFConfig()
+        # multi-host bootstrap before any device query (the reference starts
+        # the Legion/GASNet runtime in the FFModel ctor, model.cc:1160)
+        if (
+            self.config.coordinator_address is not None
+            or self.config.num_nodes_cli is not None
+        ):
+            from flexflow_tpu.runtime.distributed import initialize_distributed
+
+            initialize_distributed(
+                self.config.coordinator_address,
+                self.config.num_nodes_cli,
+                self.config.node_id,
+            )
         self.layers: List[Layer] = []
         self.graph_inputs: List[Tensor] = []
         self._name_counts: Dict[str, int] = {}
@@ -642,6 +655,7 @@ class FFModel:
             metrics=Metrics(loss_type, metrics),
             seed=seed if seed is not None else cfg.rng_seed,
             compute_dtype=cfg.compute_dtype,
+            dcn_axis=cfg.dcn_axis,
         )
         self.executor.init_params()
 

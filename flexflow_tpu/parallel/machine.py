@@ -100,7 +100,13 @@ class MachineMesh:
         assert self.shape[idx] % n_proc == 0
         ici[idx] = self.shape[idx] // n_proc
         dcn[idx] = n_proc
-        devs = mesh_utils.create_hybrid_device_mesh(tuple(ici), tuple(dcn))
+        # granule = slice on real multi-slice TPU pods (devices carry
+        # slice_index); on CPU/single-slice multi-process runs the granule
+        # is the process itself
+        has_slices = len({getattr(d, "slice_index", 0) for d in jax.devices()}) == n_proc
+        devs = mesh_utils.create_hybrid_device_mesh(
+            tuple(ici), tuple(dcn), process_is_granule=not has_slices
+        )
         return Mesh(devs, self.axis_names)
 
     # --- search-side enumeration ------------------------------------------

@@ -1,0 +1,79 @@
+"""Multi-host bootstrap — the TPU-native replacement for the reference's
+multi-node stack (``MULTI-NODE.md``: GASNet-EX/UCX conduits for Legion data
+movement + MPI as launcher + NCCL for gradient allreduce,
+``CMakeLists.txt:47-52``, ``src/runtime/model.cc:3129-3167``).
+
+On TPU one mechanism replaces all three: ``jax.distributed.initialize``
+creates the multi-controller runtime (one process per host), the strategy's
+mesh gains a host-spanning (DCN) outer axis via
+``MachineMesh.build_hybrid``, and XLA routes collectives over ICI within a
+slice and DCN across slices.  The launcher is anything that sets the
+coordinator env vars (mpirun, SLURM, GKE — same role as the reference's
+``mpi_wrapper1.sh``, ``tests/multinode_helpers/``).
+
+Env/flag contract (either works; flags win):
+  * ``--coordinator-address host:port`` / ``FF_COORDINATOR_ADDRESS``
+  * ``--num-nodes N``                  / ``FF_NUM_NODES``
+  * ``--node-id I``                    / ``FF_NODE_ID``
+On real TPU pods all three are auto-detected by jax from the TPU metadata
+server, so ``initialize_distributed()`` with no args is correct there.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized or jax.process_count() > 1
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> None:
+    """Start the multi-controller runtime.  Idempotent; a no-op for
+    single-process runs (nothing configured and no env vars set).
+
+    Mirrors the role of the reference's Legion ``Runtime::start`` +
+    GASNet bootstrap (``src/runtime/cpp_driver.cc:26-46`` under mpirun);
+    here every process runs the same program and jax stitches them into
+    one logical device world.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("FF_COORDINATOR_ADDRESS")
+    if num_processes is None and os.environ.get("FF_NUM_NODES"):
+        num_processes = int(os.environ["FF_NUM_NODES"])
+    if process_id is None and os.environ.get("FF_NODE_ID"):
+        process_id = int(os.environ["FF_NODE_ID"])
+    if coordinator_address is None and num_processes is None:
+        # single-process or TPU-pod auto-detection: only call into
+        # jax.distributed when the TPU runtime can self-configure
+        if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+            jax.distributed.initialize()
+            _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()

@@ -64,6 +64,7 @@ class Executor:
         seed: int = 0,
         use_remat: bool = False,
         compute_dtype: str = "float32",
+        dcn_axis: str = "data",
     ) -> None:
         self.layers = layers
         self.graph_inputs = graph_inputs
@@ -80,7 +81,14 @@ class Executor:
 
         self.mesh: Optional[Mesh] = None
         if strategy.mesh.size > 1:
-            self.mesh = strategy.mesh.build()
+            if jax.process_count() > 1:
+                # multi-host: the dcn axis spans processes so its
+                # collectives ride DCN, everything else stays on ICI
+                # (replaces the reference's GASNet+NCCL split,
+                # MULTI-NODE.md / model.cc:3129-3167)
+                self.mesh = strategy.mesh.build_hybrid(dcn_axis=dcn_axis)
+            else:
+                self.mesh = strategy.mesh.build()
 
         # split weight declarations into trainable params vs state
         self._wspecs: Dict[int, List] = {}
@@ -299,8 +307,11 @@ class Executor:
     def train_step(self, inputs: Sequence[Any], labels: Any) -> Tuple[float, Dict[str, float]]:
         if self._step_jit is None:
             self._step_jit = self._build_step()
-        inputs = [self._place(x, self._input_pspec(t)) for x, t in zip(inputs, self.graph_inputs)]
-        labels = self._place(labels, self._label_pspec())
+        inputs = [
+            self._place(x, self._input_pspec(t), t.shape[0])
+            for x, t in zip(inputs, self.graph_inputs)
+        ]
+        labels = self._place(labels, self._label_pspec(), self.graph_inputs[0].shape[0])
         rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._step_count)
         self._step_count += 1
         self.params, self.state, self.opt_state, loss, m = self._step_jit(
@@ -311,7 +322,10 @@ class Executor:
     def forward(self, inputs: Sequence[Any]) -> jax.Array:
         if self._fwd_jit is None:
             self._fwd_jit = self._build_fwd()
-        inputs = [self._place(x, self._input_pspec(t)) for x, t in zip(inputs, self.graph_inputs)]
+        inputs = [
+            self._place(x, self._input_pspec(t), t.shape[0])
+            for x, t in zip(inputs, self.graph_inputs)
+        ]
         return self._fwd_jit(self.params, self.state, inputs)
 
     def _label_pspec(self) -> PartitionSpec:
@@ -319,14 +333,29 @@ class Executor:
             return PartitionSpec("data")
         return PartitionSpec()
 
-    def _place(self, x: Any, pspec: PartitionSpec):
+    def _place(self, x: Any, pspec: PartitionSpec, global_batch: Optional[int] = None):
+        """Host->device placement.  Multi-process: every process may feed
+        either the full global batch (each process then device_puts only its
+        addressable shards, via ``make_array_from_callback``) or just its
+        process-local rows (``make_array_from_process_local_data`` — the
+        analog of the reference's per-node zero-copy staging,
+        ``src/dataloader/dataloader.cc:232-300``).  Which one arrived is
+        disambiguated by the leading-dim size against ``global_batch``."""
         if isinstance(x, jax.Array) and x.committed:
             return x
         arr = np.asarray(x)
         if self.mesh is not None:
             ns = NamedSharding(self.mesh, pspec)
             if jax.process_count() > 1:
-                return jax.make_array_from_process_local_data(ns, arr)
+                if (
+                    global_batch is not None
+                    and arr.ndim > 0
+                    and arr.shape[0] != global_batch
+                ):
+                    return jax.make_array_from_process_local_data(ns, arr)
+                return jax.make_array_from_callback(
+                    arr.shape, ns, lambda idx: arr[idx]
+                )
             return jax.device_put(arr, ns)
         return jnp.asarray(arr)
 

@@ -14,7 +14,7 @@ available.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from flexflow_tpu.ops.base import get_op_def
 from flexflow_tpu.parallel.machine import MachineMesh
@@ -40,12 +40,16 @@ class TPUMachineModel:
         ici_bw: float = 9e10,  # bytes/s per link direction
         dcn_bw: float = 6.25e9,  # bytes/s per host
         latency: float = 1e-6,  # per-collective latency (s)
+        dcn_latency: float = 1e-5,  # cross-host collective latency (s)
+        dcn_axes: Tuple[str, ...] = (),  # mesh axes that span hosts (DCN)
     ) -> None:
         self.peak_flops = peak_flops
         self.hbm_bw = hbm_bw
         self.ici_bw = ici_bw
         self.dcn_bw = dcn_bw
         self.latency = latency
+        self.dcn_latency = dcn_latency
+        self.dcn_axes = tuple(dcn_axes)
 
     @staticmethod
     def from_file(path: str) -> "TPUMachineModel":
@@ -53,28 +57,40 @@ class TPUMachineModel:
 
         with open(path) as f:
             d = json.load(f)
+        if "dcn_axes" in d:
+            d["dcn_axes"] = tuple(d["dcn_axes"])
         return TPUMachineModel(**d)
 
-    # --- collective time estimates (ring algorithms over ICI) -------------
-    def all_reduce(self, nbytes: float, n: int) -> float:
-        if n <= 1:
-            return 0.0
-        return self.latency * math.log2(max(2, n)) + 2 * nbytes * (n - 1) / (n * self.ici_bw)
+    def _bw(self, axis: Optional[str]) -> float:
+        """Link bandwidth for a collective over ``axis``: DCN when the axis
+        spans hosts (multi-slice outer axis — the reference's GASNet path,
+        ``MULTI-NODE.md``), ICI otherwise."""
+        return self.dcn_bw if axis in self.dcn_axes else self.ici_bw
 
-    def all_gather(self, nbytes_out: float, n: int) -> float:
-        if n <= 1:
-            return 0.0
-        return self.latency + nbytes_out * (n - 1) / (n * self.ici_bw)
+    def _lat(self, axis: Optional[str]) -> float:
+        return self.dcn_latency if axis in self.dcn_axes else self.latency
 
-    def reduce_scatter(self, nbytes_in: float, n: int) -> float:
+    # --- collective time estimates (ring algorithms over ICI/DCN) ---------
+    def all_reduce(self, nbytes: float, n: int, axis: Optional[str] = None) -> float:
         if n <= 1:
             return 0.0
-        return self.latency + nbytes_in * (n - 1) / (n * self.ici_bw)
+        bw = self._bw(axis)
+        return self._lat(axis) * math.log2(max(2, n)) + 2 * nbytes * (n - 1) / (n * bw)
 
-    def all_to_all(self, nbytes: float, n: int) -> float:
+    def all_gather(self, nbytes_out: float, n: int, axis: Optional[str] = None) -> float:
         if n <= 1:
             return 0.0
-        return self.latency + nbytes * (n - 1) / (n * self.ici_bw)
+        return self._lat(axis) + nbytes_out * (n - 1) / (n * self._bw(axis))
+
+    def reduce_scatter(self, nbytes_in: float, n: int, axis: Optional[str] = None) -> float:
+        if n <= 1:
+            return 0.0
+        return self._lat(axis) + nbytes_in * (n - 1) / (n * self._bw(axis))
+
+    def all_to_all(self, nbytes: float, n: int, axis: Optional[str] = None) -> float:
+        if n <= 1:
+            return 0.0
+        return self._lat(axis) + nbytes * (n - 1) / (n * self._bw(axis))
 
 
 def op_compute_time(
@@ -127,7 +143,7 @@ def reshard_cost(
     for a in pending:
         n = mesh.axis_size(a)
         if n > 1:
-            cost += machine.all_reduce(total / shard_deg, n)
+            cost += machine.all_reduce(total / shard_deg, n, axis=a)
 
     src_map = {a: d for d in range(len(src.spec)) for a in src.axes_of(d)}
     dst_map = {a: d for d in range(len(dst.spec)) for a in dst.axes_of(d)}
@@ -142,12 +158,15 @@ def reshard_cost(
     for a in moved:
         n = mesh.axis_size(a)
         if n > 1:
-            cost += machine.all_to_all(bytes_per_dev_dst, n)
+            cost += machine.all_to_all(bytes_per_dev_dst, n, axis=a)
     gather_factor = 1
+    gather_axis = None
     for a in removed:
         gather_factor *= mesh.axis_size(a)
+        if a in machine.dcn_axes:
+            gather_axis = a  # any DCN participant prices the whole gather
     if gather_factor > 1:
-        cost += machine.all_gather(bytes_per_dev_dst, gather_factor)
+        cost += machine.all_gather(bytes_per_dev_dst, gather_factor, axis=gather_axis)
     # axes only in dst: local dynamic-slice, charge latency once
     added = [a for a in dst_map if a not in src_map]
     if added:
@@ -197,10 +216,13 @@ def node_cost(
         wd = ws.total_degree(mesh) if ws is not None else 1
         waxes = set(ws.used_axes()) if ws is not None else set()
         sync = 1
+        sync_axis = None
         for a in data_axes - waxes:
             sync *= mesh.axis_size(a)
+            if a in m.dcn_axes:
+                sync_axis = a  # DCN participant dominates the ring
         if sync > 1:
-            t += m.all_reduce(wb / wd, sync)
+            t += m.all_reduce(wb / wd, sync, axis=sync_axis)
         if lambda_mem > 0.0:
             t += lambda_mem * (wb / wd)
     if lambda_mem > 0.0 and out0 is not None:

@@ -1,0 +1,71 @@
+"""Worker for the 2-process multi-host test (launched by
+tests/test_multihost.py as ``python -m tests._multihost_worker`` — the TPU
+analog of the reference's MPI-wrapped multinode CI,
+``tests/multinode_helpers/mpi_wrapper1.sh``: real processes on one box).
+
+Each process owns 2 virtual CPU devices; the (4, 1) data mesh therefore
+spans processes, so the gradient all-reduce crosses the process boundary
+the way DCN traffic does on a multi-slice pod.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=2").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu import (  # noqa: E402
+    ActiMode,
+    FFConfig,
+    FFModel,
+    LossType,
+    MachineMesh,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.runtime.distributed import initialize_distributed  # noqa: E402
+
+
+def main() -> None:
+    initialize_distributed()  # FF_COORDINATOR_ADDRESS / FF_NUM_NODES / FF_NODE_ID
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, len(jax.devices())
+
+    cfg = FFConfig(batch_size=32, epochs=1, learning_rate=0.05)
+    model = FFModel(cfg)
+    t = model.create_tensor((32, 16))
+    t = model.dense(t, 32, ActiMode.RELU)
+    t = model.dense(t, 10)
+    model.softmax(t)
+    mesh = MachineMesh((4, 1), ("data", "model"))
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+        mesh=mesh,
+        seed=0,
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 16)).astype(np.float32)
+    y = rng.integers(0, 10, size=(32, 1)).astype(np.int32)
+    losses = []
+    for _ in range(3):
+        loss, _ = model.executor.train_step([x], y)
+        losses.append(float(loss))
+    if jax.process_index() == 0:
+        print("LOSSES " + json.dumps(losses))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Multi-host execution: 2 real processes, one logical device world.
+
+Reference analog: multinode CI via MPI wrappers on one box
+(``.github/workflows/multinode-test.yml``,
+``tests/multinode_helpers/mpi_wrapper1.sh``) — GASNet for data movement +
+NCCL for grad allreduce.  TPU-native: ``jax.distributed.initialize``
+multi-controller (``flexflow_tpu/runtime/distributed.py``) + a mesh whose
+``data`` axis spans processes; XLA emits the cross-process collectives.
+
+Asserts (VERDICT r1 item 6): 2-process DP training produces the same loss
+trajectory as the same mesh in a single process.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexflow_tpu import (
+    ActiMode,
+    FFConfig,
+    FFModel,
+    LossType,
+    MachineMesh,
+    MetricsType,
+    SGDOptimizer,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _single_process_reference():
+    """Same model/mesh/data on 4 devices in ONE process."""
+    cfg = FFConfig(batch_size=32, epochs=1, learning_rate=0.05)
+    model = FFModel(cfg)
+    t = model.create_tensor((32, 16))
+    t = model.dense(t, 32, ActiMode.RELU)
+    t = model.dense(t, 10)
+    model.softmax(t)
+    mesh = MachineMesh((4, 1), ("data", "model"))
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+        mesh=mesh,
+        seed=0,
+    )
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 16)).astype(np.float32)
+    y = rng.integers(0, 10, size=(32, 1)).astype(np.int32)
+    return [float(model.executor.train_step([x], y)[0]) for _ in range(3)]
+
+
+def test_two_process_dp_matches_single_process():
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # worker sets its own 2-device flag
+        env.update(
+            FF_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            FF_NUM_NODES="2",
+            FF_NODE_ID=str(rank),
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, os.path.join(REPO, "tests", "_multihost_worker.py")],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost worker timed out")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err}"
+    line = next(
+        (ln for ln in outs[0][1].splitlines() if ln.startswith("LOSSES ")), None
+    )
+    assert line is not None, f"no LOSSES line in rank-0 output: {outs[0][1]}"
+    multi = json.loads(line[len("LOSSES "):])
+
+    ref = _single_process_reference()
+    np.testing.assert_allclose(multi, ref, rtol=1e-5, atol=1e-6)
+    assert ref[-1] < ref[0], "did not learn"
+
+
+def test_dcn_axis_prices_collectives_higher():
+    """The machine model must charge DCN bandwidth for collectives over a
+    host-spanning axis (reference: 3-tier machine models with inter-node
+    bandwidth, ``include/flexflow/simulator.h:212-605``)."""
+    from flexflow_tpu.search.cost import TPUMachineModel
+
+    ici = TPUMachineModel()
+    dcn = TPUMachineModel(dcn_axes=("data",))
+    nb = 1e8
+    assert dcn.all_reduce(nb, 4, axis="data") > 5 * ici.all_reduce(nb, 4, axis="data")
+    # non-DCN axes are unaffected
+    assert dcn.all_reduce(nb, 4, axis="model") == ici.all_reduce(nb, 4, axis="model")
+    assert dcn.all_gather(nb, 4, axis="data") > 5 * ici.all_gather(nb, 4, axis="data")

@@ -56,18 +56,16 @@ class FFModel:
     def __init__(self, config: Optional[FFConfig] = None) -> None:
         self.config = config or FFConfig()
         # multi-host bootstrap before any device query (the reference starts
-        # the Legion/GASNet runtime in the FFModel ctor, model.cc:1160)
-        if (
-            self.config.coordinator_address is not None
-            or self.config.num_nodes_cli is not None
-        ):
-            from flexflow_tpu.runtime.distributed import initialize_distributed
+        # the Legion/GASNet runtime in the FFModel ctor, model.cc:1160).
+        # Unconditional: initialize_distributed is a no-op when neither
+        # flags, FF_* env vars, nor TPU-pod metadata are present.
+        from flexflow_tpu.runtime.distributed import initialize_distributed
 
-            initialize_distributed(
-                self.config.coordinator_address,
-                self.config.num_nodes_cli,
-                self.config.node_id,
-            )
+        initialize_distributed(
+            self.config.coordinator_address,
+            self.config.num_nodes_cli,
+            self.config.node_id,
+        )
         self.layers: List[Layer] = []
         self.graph_inputs: List[Tensor] = []
         self._name_counts: Dict[str, int] = {}
@@ -614,11 +612,18 @@ class FFModel:
             elif cfg.search_budget > 0 and not cfg.only_data_parallel:
                 from flexflow_tpu.search import unity_search
 
+                from flexflow_tpu.search.cost import TPUMachineModel
+
                 machine = None
                 if cfg.machine_model_file:
-                    from flexflow_tpu.search.cost import TPUMachineModel
-
                     machine = TPUMachineModel.from_file(cfg.machine_model_file)
+                # multi-host: the dcn axis spans processes — price its
+                # collectives at DCN bandwidth in the search
+                if jax.process_count() > 1:
+                    if machine is None:
+                        machine = TPUMachineModel(dcn_axes=(cfg.dcn_axis,))
+                    elif not machine.dcn_axes:
+                        machine.dcn_axes = (cfg.dcn_axis,)
                 profiler = None
                 if cfg.use_measured_cost:
                     from flexflow_tpu.search.simulator import OpProfiler

@@ -81,6 +81,17 @@ class OpContext:
         axes = self.op_sharding.weights[wname].axes_of(dim)
         return axes[0] if axes else None
 
+    def batch_axis(self, exclude: Optional[str] = None, input_idx: int = 0) -> Optional[str]:
+        """Mesh axis sharding dim 0 of input ``input_idx`` (the batch/token
+        dim), skipping ``exclude`` — shared by shard_map ops (EP dispatch,
+        vocab-sharded embedding) that compose with DP."""
+        if not self.input_shardings or input_idx >= len(self.input_shardings):
+            return None
+        sh = self.input_shardings[input_idx]
+        if sh is None or not len(sh.spec):
+            return None
+        return next((a for a in sh.axes_of(0) if a != exclude), None)
+
     def seq_axis(self, input_idx: int = 0, dim: int = 1) -> Optional[str]:
         """Mesh axis sharding ``dim`` of input ``input_idx`` (None if
         replicated or no sharding context) — the signal sequence-parallel

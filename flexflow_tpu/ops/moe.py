@@ -239,10 +239,7 @@ class Experts(OpDef):
         n = layer.attrs["n_experts"]
         alpha = layer.attrs.get("alpha", 1.0)
         t, k = assign.shape
-        b_axes = ctx.input_shardings[0].axes_of(0) if (
-            ctx.input_shardings and ctx.input_shardings[0] is not None
-        ) else ()
-        dp_axis = next((a for a in b_axes if a != ep_axis), None)
+        dp_axis = ctx.batch_axis(exclude=ep_axis)
         dp = ctx.mesh.shape[dp_axis] if dp_axis else 1
         shards = dp * ep
         if t % shards != 0:

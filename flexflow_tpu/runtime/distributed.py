@@ -29,10 +29,6 @@ import jax
 _initialized = False
 
 
-def is_initialized() -> bool:
-    return _initialized or jax.process_count() > 1
-
-
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -57,10 +53,22 @@ def initialize_distributed(
         process_id = int(os.environ["FF_NODE_ID"])
     if coordinator_address is None and num_processes is None:
         # single-process or TPU-pod auto-detection: only call into
-        # jax.distributed when the TPU runtime can self-configure
+        # jax.distributed when the TPU runtime can self-configure.
+        # Best-effort: pod-ish env vars may be present on single-chip
+        # setups (e.g. tunneled dev chips) where autodetection cannot
+        # complete — stay single-process then.
         if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
-            jax.distributed.initialize()
-            _initialized = True
+            try:
+                jax.distributed.initialize()
+                _initialized = True
+            except (RuntimeError, ValueError) as e:
+                import warnings
+
+                warnings.warn(
+                    f"multi-host auto-detection failed ({e}); continuing "
+                    "single-process. If this is a real pod, pass "
+                    "--coordinator-address/--num-nodes/--node-id explicitly."
+                )
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
@@ -69,11 +77,3 @@ def initialize_distributed(
         local_device_ids=local_device_ids,
     )
     _initialized = True
-
-
-def process_count() -> int:
-    return jax.process_count()
-
-
-def process_index() -> int:
-    return jax.process_index()

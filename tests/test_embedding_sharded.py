@@ -1,0 +1,132 @@
+"""Vocab-sharded embedding-bag path (DLRM parameter parallelism).
+
+Reference: ``src/ops/embedding.cc:162-196`` — vocab partition via replica
+dims + region movement.  TPU-native: explicit shard_map (masked local
+gather, local bag reduction, one psum over the vocab axis) — see
+``Embedding._forward_vocab_sharded``.  VERDICT r1 item 9.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flexflow_tpu.fftype import AggrMode, DataType
+from flexflow_tpu.ops.base import OpContext, get_op_def
+from flexflow_tpu.fftype import OperatorType
+from flexflow_tpu.parallel.spec import TensorSharding
+from flexflow_tpu.parallel.strategy import OpSharding
+from flexflow_tpu.tensor import Layer, Tensor
+
+VOCAB, DIM, B, BAG = 512, 16, 8, 4
+
+
+def _layer(aggr):
+    ids = Tensor(shape=(B, BAG), dtype=DataType.INT32, name="ids")
+    layer = Layer(
+        op_type=OperatorType.EMBEDDING,
+        name="emb",
+        inputs=[ids],
+        attrs=dict(num_entries=VOCAB, out_dim=DIM, aggr=aggr, dtype=DataType.FLOAT),
+    )
+    opdef = get_op_def(OperatorType.EMBEDDING)
+    shape, dt = opdef.infer(layer)[0]
+    layer.outputs = [Tensor(shape=shape, dtype=dt, name="emb_out", owner_layer=layer)]
+    return layer
+
+
+def _ctx(mesh, vp_axis, dp_axis):
+    op_sh = OpSharding(
+        output=[],
+        weights={"kernel": TensorSharding(spec=(vp_axis, None))},
+        inputs=[],
+    )
+    in_sh = TensorSharding(spec=((dp_axis, None) if dp_axis else (None, None)))
+    return OpContext(
+        training=True, rng=None, mesh=mesh, input_shardings=[in_sh], op_sharding=op_sh
+    )
+
+
+@pytest.mark.parametrize("aggr", [AggrMode.SUM, AggrMode.AVG, AggrMode.NONE])
+@pytest.mark.parametrize("dp_axis", [None, "data"])
+def test_sharded_matches_replicated(aggr, dp_axis):
+    opdef = get_op_def(OperatorType.EMBEDDING)
+    layer = _layer(aggr)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, VOCAB, size=(B, BAG)), dtype=jnp.int32)
+    table = jnp.asarray(rng.normal(size=(VOCAB, DIM)), dtype=jnp.float32)
+
+    # replicated reference (no mesh)
+    ref_ctx = OpContext(training=True)
+    (ref,) = opdef.forward(layer, {"kernel": table}, [ids], ref_ctx)
+
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("data", "model"))
+    table_sharded = jax.device_put(table, NamedSharding(mesh, P("model", None)))
+    ctx = _ctx(mesh, "model", dp_axis)
+
+    def fwd(tab):
+        (out,) = opdef.forward(layer, {"kernel": tab}, [ids], ctx)
+        return out
+
+    got = jax.jit(fwd)(table_sharded)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6, atol=1e-6)
+
+    # gradients must match the replicated-table gradient
+    def loss_sharded(tab):
+        return jnp.sum(fwd(tab) ** 2)
+
+    def loss_ref(tab):
+        (out,) = opdef.forward(layer, {"kernel": tab}, [ids], OpContext(training=True))
+        return jnp.sum(out**2)
+
+    g_sh = jax.jit(jax.grad(loss_sharded))(table_sharded)
+    g_ref = jax.grad(loss_ref)(table)
+    np.testing.assert_allclose(np.asarray(g_sh), np.asarray(g_ref), rtol=1e-5, atol=1e-6)
+
+
+def test_out_of_range_ids_match_replicated_clamp():
+    """Invalid ids must clamp to the last row exactly like jnp.take's clip
+    mode in the replicated path — numerics may not depend on sharding."""
+    opdef = get_op_def(OperatorType.EMBEDDING)
+    layer = _layer(AggrMode.SUM)
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, VOCAB, size=(B, BAG)), dtype=jnp.int32)
+    ids = ids.at[0, 0].set(VOCAB + 7).at[1, 2].set(-3)
+    table = jnp.asarray(rng.normal(size=(VOCAB, DIM)), dtype=jnp.float32)
+
+    (ref,) = opdef.forward(layer, {"kernel": table}, [ids], OpContext(training=True))
+
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("data", "model"))
+    table_sharded = jax.device_put(table, NamedSharding(mesh, P("model", None)))
+    ctx = _ctx(mesh, "model", None)
+    (got,) = jax.jit(
+        lambda tab: opdef.forward(layer, {"kernel": tab}, [ids], ctx)
+    )(table_sharded)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6, atol=1e-6)
+
+
+def test_wire_bytes_independent_of_table_size():
+    """The compiled sharded lookup must not all-gather the table: no HLO
+    operand anywhere near table size crosses the wire — assert the only
+    collective is the output-sized psum (all-reduce)."""
+    opdef = get_op_def(OperatorType.EMBEDDING)
+    layer = _layer(AggrMode.SUM)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, VOCAB, size=(B, BAG)), dtype=jnp.int32)
+    table = jnp.asarray(rng.normal(size=(VOCAB, DIM)), dtype=jnp.float32)
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("data", "model"))
+    table_sharded = jax.device_put(table, NamedSharding(mesh, P("model", None)))
+    ctx = _ctx(mesh, "model", None)
+
+    def fwd(tab):
+        (out,) = opdef.forward(layer, {"kernel": tab}, [ids], ctx)
+        return out
+
+    hlo = jax.jit(fwd).lower(table_sharded).compile().as_text()
+    assert "all-reduce" in hlo, "psum missing"
+    assert "all-gather" not in hlo, "table was all-gathered"

@@ -39,20 +39,24 @@ class FFConfig:
     search_budget: int = -1
     search_alpha: float = 1.2
     only_data_parallel: bool = False
-    enable_parameter_parallel: bool = False
-    enable_attribute_parallel: bool = False
-    enable_inplace_optimizations: bool = True
-    search_overlap_backward_update: bool = False
+    # NOTE: defaults True (reference defaults these off, model.cc:3620-3630,
+    # because its parameter/attribute parallel paths were experimental; here
+    # they are first-class tested candidates). --disable-* flags opt out.
+    enable_parameter_parallel: bool = True
+    enable_attribute_parallel: bool = True
     export_strategy_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
+    # NOTE deliberately absent vs the reference FFConfig: perform_fusion /
+    # enable_inplace_optimizations / search_overlap_backward_update (XLA
+    # fuses, in-places, and overlaps inside the single jitted step program),
+    # simulator_work_space_size (no simulator workspace exists — op timing
+    # compiles real sub-programs), machine_model_version (one TPU machine
+    # model, parameterized via --machine-model-file).
     # --- observability (reference model.cc:3650-3670) ---
     profiling: bool = False
-    perform_fusion: bool = True
     export_strategy_computation_graph_file: Optional[str] = None
     taskgraph_file: Optional[str] = None
     # --- simulator (reference config.h:127-136) ---
-    simulator_work_space_size: int = 2 * 1024 * 1024 * 1024
-    machine_model_version: int = 0
     machine_model_file: Optional[str] = None
     # measured cost tier: search candidates costed by compiling-and-timing
     # ops on device (the reference's default behavior,
@@ -133,14 +137,14 @@ class FFConfig:
                 self.only_data_parallel = True
             elif a == "--enable-parameter-parallel":
                 self.enable_parameter_parallel = True
+            elif a == "--disable-parameter-parallel":
+                self.enable_parameter_parallel = False
             elif a == "--enable-attribute-parallel":
                 self.enable_attribute_parallel = True
+            elif a == "--disable-attribute-parallel":
+                self.enable_attribute_parallel = False
             elif a == "--profiling":
                 self.profiling = True
-            elif a == "--fusion":
-                self.perform_fusion = True
-            elif a == "--no-fusion":
-                self.perform_fusion = False
             elif a == "--export-strategy" or a == "--export":
                 self.export_strategy_file = take()
             elif a == "--import-strategy" or a == "--import":
@@ -149,16 +153,12 @@ class FFConfig:
                 self.taskgraph_file = take()
             elif a == "--compgraph":
                 self.export_strategy_computation_graph_file = take()
-            elif a == "--machine-model-version":
-                self.machine_model_version = int(take())
             elif a == "--machine-model-file":
                 self.machine_model_file = take()
             elif a == "--measured-cost":
                 self.use_measured_cost = True
             elif a == "--cost-cache":
                 self.cost_cache_file = take()
-            elif a == "--simulator-workspace-size":
-                self.simulator_work_space_size = int(take())
             elif a == "--mesh-shape":
                 self.mesh_shape = tuple(int(x) for x in take().split("x"))
             elif a == "--dtype":
@@ -167,6 +167,8 @@ class FFConfig:
                 self.rng_seed = int(take())
             elif a == "--device-memory-gb":
                 self.device_memory_gb = float(take())
+            elif a == "--memory-search-budget":
+                self.memory_search_budget = int(take())
             elif a == "--coordinator-address":
                 self.coordinator_address = take()
             elif a == "--num-nodes":

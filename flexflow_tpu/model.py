@@ -605,30 +605,35 @@ class FFModel:
                 mesh = MachineMesh(cfg.mesh_shape, cfg.mesh_axis_names[: len(cfg.mesh_shape)])
             else:
                 mesh = default_mesh()
+        # machine model + profiler, shared by the search AND the
+        # observability exports below so --taskgraph/--profiling report the
+        # same costs the search optimized
+        from flexflow_tpu.search.cost import TPUMachineModel
+
+        machine = None
+        if cfg.machine_model_file:
+            machine = TPUMachineModel.from_file(cfg.machine_model_file)
+        # multi-host: the dcn axis spans processes — price its collectives
+        # at DCN bandwidth
+        if jax.process_count() > 1:
+            if machine is None:
+                machine = TPUMachineModel(dcn_axes=(cfg.dcn_axis,))
+            elif not machine.dcn_axes:
+                machine.dcn_axes = (cfg.dcn_axis,)
+        profiler = None
+        if cfg.use_measured_cost:
+            from flexflow_tpu.search.simulator import OpProfiler
+
+            profiler = OpProfiler(cfg.cost_cache_file)
+
         if strategy is None:
             if cfg.import_strategy_file:
                 with open(cfg.import_strategy_file) as f:
                     strategy = Strategy.from_json(f.read())
             elif cfg.search_budget > 0 and not cfg.only_data_parallel:
                 from flexflow_tpu.search import unity_search
+                from flexflow_tpu.search.candidates import SearchOptions
 
-                from flexflow_tpu.search.cost import TPUMachineModel
-
-                machine = None
-                if cfg.machine_model_file:
-                    machine = TPUMachineModel.from_file(cfg.machine_model_file)
-                # multi-host: the dcn axis spans processes — price its
-                # collectives at DCN bandwidth in the search
-                if jax.process_count() > 1:
-                    if machine is None:
-                        machine = TPUMachineModel(dcn_axes=(cfg.dcn_axis,))
-                    elif not machine.dcn_axes:
-                        machine.dcn_axes = (cfg.dcn_axis,)
-                profiler = None
-                if cfg.use_measured_cost:
-                    from flexflow_tpu.search.simulator import OpProfiler
-
-                    profiler = OpProfiler(cfg.cost_cache_file)
                 strategy = unity_search(
                     self.layers,
                     mesh,
@@ -642,6 +647,15 @@ class FFModel:
                         if cfg.device_memory_gb > 0
                         else None
                     ),
+                    options=SearchOptions(
+                        param_parallel=cfg.enable_parameter_parallel,
+                        attribute_parallel=cfg.enable_attribute_parallel,
+                    ),
+                    mem_search_iters=(
+                        cfg.memory_search_budget
+                        if cfg.memory_search_budget > 0
+                        else 8
+                    ),
                 )
             else:
                 strategy = data_parallel_strategy(self.layers, mesh)
@@ -649,6 +663,39 @@ class FFModel:
         if cfg.export_strategy_file:
             with open(cfg.export_strategy_file, "w") as f:
                 f.write(strategy.to_json())
+        # observability exports (reference --compgraph/--taskgraph/--profiling,
+        # model.cc:3650-3670)
+        if cfg.export_strategy_computation_graph_file:
+            from flexflow_tpu.utils import export_dot
+
+            export_dot(
+                self.layers,
+                cfg.export_strategy_computation_graph_file,
+                strategy=strategy,
+                graph_inputs=self.graph_inputs,
+            )
+        if cfg.taskgraph_file:
+            from flexflow_tpu.utils import export_taskgraph
+
+            node_time_fn = None
+            if profiler is not None:
+                from flexflow_tpu.search.simulator import MeasuredCostModel
+
+                node_time_fn = MeasuredCostModel(
+                    profiler, strategy.mesh, machine
+                ).node_time
+            export_taskgraph(
+                self.layers, strategy, cfg.taskgraph_file,
+                machine=machine, node_time_fn=node_time_fn,
+            )
+        if cfg.profiling:
+            from flexflow_tpu.utils import format_profiling_table, profiling_rows
+
+            print(format_profiling_table(
+                profiling_rows(
+                    self.layers, strategy, machine=machine, profiler=profiler
+                )
+            ))
 
         self.executor = Executor(
             layers=self.layers,

@@ -59,9 +59,34 @@ def initialize_distributed(
         # complete — stay single-process then.
         if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
             try:
+                import jax._src.xla_bridge as _xb
+
+                backends_up = _xb.backends_are_initialized()
+            except (ImportError, AttributeError):
+                backends_up = False  # unknown — attempt init, let jax decide
+            if backends_up:
+                # too late to bootstrap (something touched jax first).
+                # Single-chip dev envs with pod-ish shim vars land here
+                # benignly (1 process); on a real pod this is a
+                # misconfiguration worth flagging.
+                if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+                    import warnings
+
+                    warnings.warn(
+                        "pod env detected but JAX was already initialized; "
+                        "running single-process. Construct FFModel (or call "
+                        "initialize_distributed) before any other JAX use, "
+                        "or pass --coordinator-address/--num-nodes/--node-id."
+                    )
+                return
+            try:
                 jax.distributed.initialize()
                 _initialized = True
-            except (RuntimeError, ValueError) as e:
+            except ValueError:
+                # pod-ish env vars but nothing to autodetect (e.g. tunneled
+                # single-chip dev setups) — genuinely single-process
+                pass
+            except RuntimeError as e:
                 import warnings
 
                 warnings.warn(

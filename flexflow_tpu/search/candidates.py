@@ -24,6 +24,8 @@ reference's substitution targets:
 
 from __future__ import annotations
 
+import contextlib
+import dataclasses
 from typing import Dict, List, Optional, Tuple
 
 from flexflow_tpu.fftype import OperatorType
@@ -32,6 +34,34 @@ from flexflow_tpu.parallel.machine import MachineMesh
 from flexflow_tpu.parallel.spec import TensorSharding
 from flexflow_tpu.parallel.strategy import OpSharding
 from flexflow_tpu.tensor import Layer
+
+@dataclasses.dataclass
+class SearchOptions:
+    """Candidate-space gates mirroring the reference's search flags
+    (``--enable-parameter-parallel`` / ``--enable-attribute-parallel``,
+    ``src/runtime/model.cc:3620-3630``): parameter parallelism = weight
+    sharding with partial-sum outputs (linear in-dim, embedding vocab);
+    attribute parallelism = conv channel-dim sharding."""
+
+    param_parallel: bool = True
+    attribute_parallel: bool = True
+
+
+_ACTIVE_OPTIONS = SearchOptions()
+
+
+@contextlib.contextmanager
+def search_options(opts: SearchOptions):
+    """Scope the candidate gates for one search run (keeps the three
+    ``op_candidates`` call sites in dp/substitution signature-free)."""
+    global _ACTIVE_OPTIONS
+    prev = _ACTIVE_OPTIONS
+    _ACTIVE_OPTIONS = opts
+    try:
+        yield
+    finally:
+        _ACTIVE_OPTIONS = prev
+
 
 # which mesh axes may shard which semantic dim kinds
 KIND_AXES = {
@@ -157,6 +187,12 @@ def op_candidates(layer: Layer, mesh: MachineMesh) -> List[OpSharding]:
     # axis assignments: every subset of {dim->axis} with distinct axes
     options: List[Tuple[int, str]] = []
     for d, kind in sorted(pdims.items()):
+        if (
+            kind == "channel"
+            and layer.op_type is OperatorType.CONV2D
+            and not _ACTIVE_OPTIONS.attribute_parallel
+        ):
+            continue  # conv attribute parallelism gated (model.cc:3627)
         for ax in KIND_AXES.get(kind, ()):
             if mesh.axis_size(ax) > 1 and outs[0][0][d] % mesh.axis_size(ax) == 0:
                 options.append((d, ax))
@@ -194,11 +230,13 @@ def op_candidates(layer: Layer, mesh: MachineMesh) -> List[OpSharding]:
 
     gen(0, {}, frozenset())
 
-    # non-local candidates (partial-sum outputs)
+    # non-local candidates (partial-sum outputs); linear in-dim and
+    # embedding vocab partition are *parameter parallelism* and gated on
+    # the reference's --enable-parameter-parallel (model.cc:3620)
     tp = mesh.axis_size("model")
     dp = mesh.axis_size("data")
     if tp > 1:
-        if layer.op_type is OperatorType.LINEAR:
+        if layer.op_type is OperatorType.LINEAR and _ACTIVE_OPTIONS.param_parallel:
             t = layer.inputs[0]
             in_dim = t.shape[-1]
             if in_dim % tp == 0:
@@ -235,7 +273,7 @@ def op_candidates(layer: Layer, mesh: MachineMesh) -> List[OpSharding]:
                 )
                 inputs = [_spec_with(t.ndim, batch) for t in layer.inputs]
                 add([out], wspec, inputs)
-        elif layer.op_type is OperatorType.EMBEDDING:
+        elif layer.op_type is OperatorType.EMBEDDING and _ACTIVE_OPTIONS.param_parallel:
             n_entries = layer.attrs["num_entries"]
             if n_entries % tp == 0:
                 kshape = get_op_def(layer.op_type).weights(layer)[0].shape

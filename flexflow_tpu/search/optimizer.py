@@ -37,6 +37,8 @@ def unity_search(
     explore_meshes: bool = True,
     beam: int = 16,
     profiler=None,
+    options=None,
+    mem_search_iters: int = 8,
 ) -> Strategy:
     """Pick the cheapest (mesh factorization, per-op sharding) pair.
 
@@ -51,7 +53,26 @@ def unity_search(
     reference's on-device micro-profiling,
     ``src/runtime/simulator.cc:537-577``), cached across meshes since the
     cache key is (op params, local shapes).
+
+    ``options``: :class:`~flexflow_tpu.search.candidates.SearchOptions`
+    gating parameter/attribute-parallel candidates (the reference's
+    ``--enable-parameter-parallel``/``--enable-attribute-parallel``);
+    ``mem_search_iters`` bounds the λ binary search
+    (``--memory-search-budget``, ``graph.cc:2075``).
     """
+    from flexflow_tpu.search.candidates import SearchOptions, search_options
+
+    with search_options(options if options is not None else SearchOptions()):
+        return _unity_search_impl(
+            layers, mesh, graph_inputs, budget, alpha, machine,
+            mem_budget_bytes, explore_meshes, beam, profiler, mem_search_iters,
+        )
+
+
+def _unity_search_impl(
+    layers, mesh, graph_inputs, budget, alpha, machine,
+    mem_budget_bytes, explore_meshes, beam, profiler, mem_search_iters,
+) -> Strategy:
     if graph_inputs is None:
         seen = set()
         graph_inputs = []
@@ -91,7 +112,8 @@ def unity_search(
         try:
             if mem_budget_bytes is not None:
                 cost, assign = optimize_with_memory_budget(
-                    run, layers, mv, mem_budget_bytes, machine=machine
+                    run, layers, mv, mem_budget_bytes,
+                    iters=mem_search_iters, machine=machine,
                 )
             else:
                 cost, assign = run(0.0)

@@ -215,7 +215,8 @@ def simulate_strategy(
     strategy: Strategy,
     machine: Optional[TPUMachineModel] = None,
     node_time_fn: Optional[Callable[[Layer, Optional[OpSharding]], float]] = None,
-) -> float:
+    return_tasks: bool = False,
+):
     """Event-driven makespan of one training step (reference
     ``simulate_runtime``, ``src/runtime/simulator.cc:822-1250``).
 
@@ -308,7 +309,10 @@ def simulate_strategy(
         task.start = max(ready, stream_free[task.stream])
         task.end = task.start + task.duration
         stream_free[task.stream] = task.end
-    return max((t.end for t in tasks), default=0.0)
+    makespan = max((t.end for t in tasks), default=0.0)
+    if return_tasks:
+        return makespan, tasks
+    return makespan
 
 
 def profile_strategy(

@@ -1,0 +1,13 @@
+from flexflow_tpu.utils.export import (
+    export_dot,
+    export_taskgraph,
+    format_profiling_table,
+    profiling_rows,
+)
+
+__all__ = [
+    "export_dot",
+    "export_taskgraph",
+    "profiling_rows",
+    "format_profiling_table",
+]

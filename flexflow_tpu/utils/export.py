@@ -1,0 +1,198 @@
+"""Observability exports: PCG/strategy dot graphs, simulated step
+timelines, per-op profiling tables.
+
+Reference: ``--compgraph`` strategy/PCG dot export
+(``export_strategy_computation_graph``, ``include/flexflow/graph.h:337-344``,
+``src/utils/dot/``), ``--taskgraph`` task-graph export for offline analysis
+(``src/runtime/model.cc:3666-3668``, ``src/runtime/simulator.cc:822``), and
+the ``--profiling`` per-op kernel timing printouts
+(``src/runtime/model.cc:3650-3653``).
+
+TPU-native: the dot graph annotates each PCG node with its strategy
+sharding (mesh-axis assignment instead of MachineView device ranges); the
+task graph is the two-stream event simulation's schedule serialized as
+JSON; the profiling table prices every op under the chosen strategy with
+the analytic roofline, upgraded to measured times when an
+``OpProfiler`` cache is supplied.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+from flexflow_tpu.ops.base import get_op_def
+from flexflow_tpu.parallel.strategy import Strategy
+from flexflow_tpu.tensor import Layer, Tensor
+
+
+def _esc(s: str) -> str:
+    """Escape dot record-label metacharacters in user-supplied names."""
+    out = str(s).replace("\\", "\\\\").replace('"', '\\"')
+    for ch in "|{}<>":
+        out = out.replace(ch, "\\" + ch)
+    return out.replace("\n", " ")
+
+
+def _sharding_label(strategy: Optional[Strategy], layer: Layer) -> str:
+    if strategy is None:
+        return ""
+    s = strategy.op_sharding(layer)
+    if s is None or not s.output:
+        return ""
+    o = s.output[0]
+    parts = []
+    for d in range(len(o.spec)):
+        axes = o.axes_of(d)
+        if axes:
+            parts.append(f"d{d}:{'+'.join(axes)}")
+    if o.partial_axes:
+        parts.append(f"partial:{'+'.join(o.partial_axes)}")
+    for name, w in sorted(s.weights.items()):
+        waxes = [a for d in range(len(w.spec)) for a in w.axes_of(d)]
+        if waxes:
+            parts.append(f"{name}:{'+'.join(waxes)}")
+    return "\\n" + " ".join(parts) if parts else ""
+
+
+def export_dot(
+    layers: Sequence[Layer],
+    path: str,
+    strategy: Optional[Strategy] = None,
+    graph_inputs: Sequence[Tensor] = (),
+) -> None:
+    """Write the PCG (+ per-op sharding when ``strategy`` given) as dot.
+
+    Analog of ``--compgraph`` / ``export_strategy_computation_graph``
+    (``graph.h:337-344``); strategy nodes carry mesh-axis assignments the
+    way the reference's carry MachineView device ranges.
+    """
+    lines = ["digraph PCG {", "  rankdir=TB;", "  node [shape=record, fontsize=10];"]
+    if strategy is not None:
+        mesh = strategy.mesh
+        lines.append(
+            f'  label="mesh {tuple(mesh.shape)} {tuple(mesh.axis_names)}"; labelloc=t;'
+        )
+    for t in graph_inputs:
+        lines.append(
+            f'  t{t.guid} [shape=ellipse, label="{_esc(t.name or t.guid)}\\n{tuple(t.shape)}"];'
+        )
+    for layer in layers:
+        shapes = ",".join(str(tuple(o.shape)) for o in layer.outputs)
+        label = f"{_esc(layer.name)}\\n{layer.op_type.value} {shapes}{_sharding_label(strategy, layer)}"
+        lines.append(f'  n{int(layer.layer_guid)} [label="{label}"];')
+    produced = {o.guid: layer for layer in layers for o in layer.outputs}
+    input_guids = {t.guid for t in graph_inputs}
+    for layer in layers:
+        for t in layer.inputs:
+            if t.guid in produced:
+                src = f"n{int(produced[t.guid].layer_guid)}"
+            elif t.guid in input_guids:
+                src = f"t{t.guid}"
+            else:
+                continue
+            lines.append(f"  {src} -> n{int(layer.layer_guid)};")
+    lines.append("}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def export_taskgraph(
+    layers: Sequence[Layer],
+    strategy: Strategy,
+    path: str,
+    machine=None,
+    node_time_fn=None,
+) -> float:
+    """Serialize the event-simulated step schedule as JSON
+    (``--taskgraph`` parity, ``simulator.cc:822`` export_file_name).
+
+    Returns the simulated makespan (seconds).  Schema:
+    ``{"makespan_s", "mesh", "tasks": [{name, stream, start_s, end_s,
+    duration_s, deps}]}`` — streams are the two-engine model (compute vs
+    ICI comm).
+    """
+    from flexflow_tpu.search.simulator import simulate_strategy
+
+    makespan, tasks = simulate_strategy(
+        list(layers), strategy, machine, node_time_fn=node_time_fn, return_tasks=True
+    )
+    doc = {
+        "makespan_s": makespan,
+        "mesh": {
+            "shape": list(strategy.mesh.shape),
+            "axes": list(strategy.mesh.axis_names),
+        },
+        "tasks": [
+            {
+                "name": t.name,
+                "stream": t.stream,
+                "start_s": t.start,
+                "end_s": t.end,
+                "duration_s": t.duration,
+                "deps": [d.name for d in t.deps],
+            }
+            for t in tasks
+        ],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return makespan
+
+
+def profiling_rows(
+    layers: Sequence[Layer],
+    strategy: Strategy,
+    machine=None,
+    profiler=None,
+) -> List[Dict]:
+    """Per-op cost table under the chosen strategy — the ``--profiling``
+    analog (per-op timing printouts, ``model.cc:3650``).  Uses measured
+    times when an OpProfiler is given (reference CUDA-event path,
+    ``model.cu:38``), the analytic roofline otherwise."""
+    from flexflow_tpu.search.cost import TPUMachineModel, node_cost
+    from flexflow_tpu.parallel.spec import TensorSharding
+    from flexflow_tpu.parallel.strategy import OpSharding
+
+    m = machine or TPUMachineModel()
+    node_time_fn = None
+    if profiler is not None:
+        from flexflow_tpu.search.simulator import MeasuredCostModel
+
+        node_time_fn = MeasuredCostModel(profiler, strategy.mesh, m).node_time
+
+    rows = []
+    for layer in layers:
+        if layer.op_type.is_parallel_op:
+            continue
+        opdef = get_op_def(layer.op_type)
+        s = strategy.op_sharding(layer) or OpSharding(
+            output=[
+                TensorSharding.replicated(len(sh)) for sh, _ in opdef.infer(layer)
+            ]
+        )
+        t = node_time_fn(layer, s) if node_time_fn else node_cost(layer, s, strategy.mesh, m)
+        rows.append(
+            {
+                "name": layer.name,
+                "op": layer.op_type.value,
+                "flops": opdef.flops(layer),
+                "time_s": t,
+                "source": "measured" if node_time_fn else "analytic",
+            }
+        )
+    rows.sort(key=lambda r: -r["time_s"])
+    return rows
+
+
+def format_profiling_table(rows: List[Dict]) -> str:
+    total = sum(r["time_s"] for r in rows)
+    out = [f"{'op':<28} {'type':<20} {'time':>10} {'%':>6}  src"]
+    for r in rows:
+        pct = 100.0 * r["time_s"] / total if total > 0 else 0.0
+        out.append(
+            f"{r['name'][:28]:<28} {r['op'][:20]:<20} "
+            f"{r['time_s'] * 1e6:>8.1f}us {pct:>5.1f}%  {r['source']}"
+        )
+    out.append(f"{'TOTAL':<28} {'':<20} {total * 1e6:>8.1f}us")
+    return "\n".join(out)

@@ -1,0 +1,128 @@
+"""Observability exports + zero-dead-flags guard (VERDICT r1 item 7).
+
+Reference: ``--compgraph`` dot export (``graph.h:337-344``,
+``src/utils/dot/``), ``--taskgraph`` task-graph export
+(``model.cc:3666-3668``), ``--profiling`` per-op timing
+(``model.cc:3650-3653``).
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+
+import pytest
+
+from flexflow_tpu import (
+    ActiMode,
+    FFConfig,
+    FFModel,
+    LossType,
+    MachineMesh,
+    MetricsType,
+    SGDOptimizer,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_and_compile(tmp_path, **cfg_kw):
+    cfg = FFConfig(batch_size=16, **cfg_kw)
+    model = FFModel(cfg)
+    t = model.create_tensor((16, 32), name="x")
+    t = model.dense(t, 64, ActiMode.RELU, name="fc1")
+    t = model.dense(t, 10, name="fc2")
+    model.softmax(t, name="probs")
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+        mesh=MachineMesh((4, 2), ("data", "model")),
+    )
+    return model
+
+
+def test_compgraph_dot_export(tmp_path):
+    dot_path = str(tmp_path / "pcg.dot")
+    _build_and_compile(tmp_path, export_strategy_computation_graph_file=dot_path)
+    text = open(dot_path).read()
+    assert text.startswith("digraph")
+    for name in ("fc1", "fc2", "probs"):
+        assert name in text
+    assert "mesh (4, 2)" in text
+    assert "->" in text  # edges present
+
+
+def test_taskgraph_json_export(tmp_path):
+    tg_path = str(tmp_path / "taskgraph.json")
+    _build_and_compile(tmp_path, taskgraph_file=tg_path)
+    doc = json.load(open(tg_path))
+    assert doc["makespan_s"] > 0
+    assert doc["mesh"]["shape"] == [4, 2]
+    names = {t["name"] for t in doc["tasks"]}
+    assert {"fc1", "fc2", "probs"} <= names
+    for t in doc["tasks"]:
+        assert t["stream"] in ("compute", "comm")
+        assert t["end_s"] >= t["start_s"] >= 0
+        for d in t["deps"]:
+            assert d in names
+    assert doc["makespan_s"] == pytest.approx(
+        max(t["end_s"] for t in doc["tasks"])
+    )
+
+
+def test_profiling_table(capsys):
+    cfg = FFConfig(batch_size=16, profiling=True)
+    model = FFModel(cfg)
+    t = model.create_tensor((16, 32), name="x")
+    t = model.dense(t, 64, ActiMode.RELU, name="fc1")
+    model.softmax(t, name="probs")
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        mesh=MachineMesh((1, 1), ("data", "model")),
+    )
+    out = capsys.readouterr().out
+    assert "fc1" in out and "TOTAL" in out and "us" in out
+
+
+def test_no_dead_config_flags():
+    """Every FFConfig field must be consumed somewhere in the package
+    outside config.py — 'a flag that does nothing is worse than no flag'
+    (VERDICT r1)."""
+    fields = [f.name for f in dataclasses.fields(FFConfig)]
+    src = ""
+    for root, _, files in os.walk(os.path.join(REPO, "flexflow_tpu")):
+        for fn in files:
+            if fn.endswith(".py") and fn != "config.py":
+                src += open(os.path.join(root, fn)).read()
+    dead = [f for f in fields if f not in src]
+    assert not dead, f"parsed-but-unused config flags: {dead}"
+
+
+def test_search_options_gate_param_parallel():
+    """--enable-parameter-parallel gates vocab/in-dim partition candidates
+    (reference model.cc:3620)."""
+    from flexflow_tpu.search.candidates import (
+        SearchOptions,
+        op_candidates,
+        search_options,
+    )
+
+    model = FFModel(FFConfig(batch_size=16))
+    t = model.create_tensor((16, 32), name="x")
+    model.dense(t, 64, name="fc")
+    layer = model.layers[0]
+    mesh = MachineMesh((2, 4), ("data", "model"))
+
+    def has_in_dim_partition(cands):
+        return any(
+            c.output and c.output[0].partial_axes and "model" in c.output[0].partial_axes
+            for c in cands
+        )
+
+    with search_options(SearchOptions(param_parallel=False)):
+        assert not has_in_dim_partition(op_candidates(layer, mesh))
+    with search_options(SearchOptions(param_parallel=True)):
+        assert has_in_dim_partition(op_candidates(layer, mesh))

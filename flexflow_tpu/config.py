@@ -46,6 +46,9 @@ class FFConfig:
     enable_attribute_parallel: bool = True
     export_strategy_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
+    # TASO-style JSON substitution rules (reference substitution_loader.cc,
+    # substitutions/graph_subst_3_v2.json); "default" loads the bundled set
+    substitution_json_file: Optional[str] = None
     # NOTE deliberately absent vs the reference FFConfig: perform_fusion /
     # enable_inplace_optimizations / search_overlap_backward_update (XLA
     # fuses, in-places, and overlaps inside the single jitted step program),
@@ -149,6 +152,8 @@ class FFConfig:
                 self.export_strategy_file = take()
             elif a == "--import-strategy" or a == "--import":
                 self.import_strategy_file = take()
+            elif a == "--substitution-json":
+                self.substitution_json_file = take()
             elif a == "--taskgraph":
                 self.taskgraph_file = take()
             elif a == "--compgraph":

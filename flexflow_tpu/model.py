@@ -634,6 +634,21 @@ class FFModel:
                 from flexflow_tpu.search import unity_search
                 from flexflow_tpu.search.candidates import SearchOptions
 
+                extra_xfers = None
+                if cfg.substitution_json_file:
+                    import os as _os
+
+                    from flexflow_tpu.search.substitution import (
+                        load_xfers_from_json,
+                    )
+
+                    rules_path = cfg.substitution_json_file
+                    if rules_path == "default":
+                        rules_path = _os.path.join(
+                            _os.path.dirname(__file__), "search", "substitutions.json"
+                        )
+                    extra_xfers = load_xfers_from_json(rules_path)
+
                 strategy = unity_search(
                     self.layers,
                     mesh,
@@ -656,15 +671,38 @@ class FFModel:
                         if cfg.memory_search_budget > 0
                         else 8
                     ),
+                    extra_xfers=extra_xfers,
                 )
             else:
                 strategy = data_parallel_strategy(self.layers, mesh)
         self.strategy = strategy
+        # exports + profiling print only on process 0 (multi-host runs share
+        # the filesystem/stdout; the reference's exports run in the
+        # singleton GRAPH_OPTIMIZE task, mapper.cc:274)
+        if jax.process_index() == 0:
+            self._write_exports(cfg, strategy, machine, profiler)
+
+        self.executor = Executor(
+            layers=self.layers,
+            graph_inputs=self.graph_inputs,
+            logits=logits,
+            strategy=strategy,
+            optimizer=self._optimizer,
+            loss_type=loss_type,
+            metrics=Metrics(loss_type, metrics),
+            seed=seed if seed is not None else cfg.rng_seed,
+            compute_dtype=cfg.compute_dtype,
+            dcn_axis=cfg.dcn_axis,
+        )
+        self.executor.init_params()
+
+    def _write_exports(self, cfg, strategy, machine, profiler) -> None:
+        """Strategy/observability outputs (reference --export-strategy /
+        --compgraph / --taskgraph / --profiling, model.cc:3609-3670).
+        Called on process 0 only."""
         if cfg.export_strategy_file:
             with open(cfg.export_strategy_file, "w") as f:
                 f.write(strategy.to_json())
-        # observability exports (reference --compgraph/--taskgraph/--profiling,
-        # model.cc:3650-3670)
         if cfg.export_strategy_computation_graph_file:
             from flexflow_tpu.utils import export_dot
 
@@ -696,20 +734,6 @@ class FFModel:
                     self.layers, strategy, machine=machine, profiler=profiler
                 )
             ))
-
-        self.executor = Executor(
-            layers=self.layers,
-            graph_inputs=self.graph_inputs,
-            logits=logits,
-            strategy=strategy,
-            optimizer=self._optimizer,
-            loss_type=loss_type,
-            metrics=Metrics(loss_type, metrics),
-            seed=seed if seed is not None else cfg.rng_seed,
-            compute_dtype=cfg.compute_dtype,
-            dcn_axis=cfg.dcn_axis,
-        )
-        self.executor.init_params()
 
     # ------------------------------------------------------------------- fit
     def fit(

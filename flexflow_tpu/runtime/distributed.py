@@ -82,10 +82,18 @@ def initialize_distributed(
             try:
                 jax.distributed.initialize()
                 _initialized = True
-            except ValueError:
-                # pod-ish env vars but nothing to autodetect (e.g. tunneled
-                # single-chip dev setups) — genuinely single-process
-                pass
+            except ValueError as e:
+                # pod-ish env vars but nothing to autodetect — usually a
+                # tunneled single-chip dev setup (benign), occasionally
+                # malformed pod metadata (not benign).  Info-level so a
+                # debugging session can see it without spamming dev envs.
+                import logging
+
+                logging.getLogger("flexflow_tpu").info(
+                    "multi-host autodetection found nothing (%s); "
+                    "continuing single-process. On a real pod pass "
+                    "--coordinator-address/--num-nodes/--node-id.", e
+                )
             except RuntimeError as e:
                 import warnings
 

@@ -174,6 +174,20 @@ def reshard_cost(
     return cost
 
 
+def default_op_sharding(layer: Layer) -> "OpSharding":
+    """Fully-replicated OpSharding for a layer with no strategy entry —
+    the shared fallback used by the event simulator and profiling table so
+    they always agree on unassigned ops."""
+    from flexflow_tpu.parallel.spec import TensorSharding
+
+    return OpSharding(
+        output=[
+            TensorSharding.replicated(len(sh))
+            for sh, _ in get_op_def(layer.op_type).infer(layer)
+        ]
+    )
+
+
 def node_cost(
     layer: Layer,
     sharding: "OpSharding",

@@ -39,6 +39,7 @@ def unity_search(
     profiler=None,
     options=None,
     mem_search_iters: int = 8,
+    extra_xfers=None,
 ) -> Strategy:
     """Pick the cheapest (mesh factorization, per-op sharding) pair.
 
@@ -66,12 +67,14 @@ def unity_search(
         return _unity_search_impl(
             layers, mesh, graph_inputs, budget, alpha, machine,
             mem_budget_bytes, explore_meshes, beam, profiler, mem_search_iters,
+            extra_xfers,
         )
 
 
 def _unity_search_impl(
     layers, mesh, graph_inputs, budget, alpha, machine,
     mem_budget_bytes, explore_meshes, beam, profiler, mem_search_iters,
+    extra_xfers,
 ) -> Strategy:
     if graph_inputs is None:
         seen = set()
@@ -106,7 +109,7 @@ def _unity_search_impl(
             return graph_optimize(
                 layers, graph_inputs, _mv, machine,
                 budget=budget, alpha=alpha, beam=beam, lambda_mem=lam,
-                node_time_fn=_ntf,
+                node_time_fn=_ntf, extra_xfers=extra_xfers,
             )
 
         try:

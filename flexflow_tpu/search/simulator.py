@@ -290,13 +290,9 @@ def simulate_strategy(
         if node_time_fn is not None:
             dur = node_time_fn(layer, s)
         else:
-            s_eff = s or OpSharding(
-                output=[
-                    TensorSharding.replicated(len(sh))
-                    for sh, _ in get_op_def(layer.op_type).infer(layer)
-                ]
-            )
-            dur = node_cost(layer, s_eff, mesh, m)
+            from flexflow_tpu.search.cost import default_op_sharding
+
+            dur = node_cost(layer, s or default_op_sharding(layer), mesh, m)
         task = SimTask(layer.name, dur, "compute", deps + comm_deps)
         tasks.append(task)
         for o in layer.outputs:

@@ -41,10 +41,18 @@ from flexflow_tpu.tensor import Layer
 @dataclasses.dataclass
 class OpX:
     """One pattern node (reference ``OpX``, ``substitution.h:85-111``):
-    an op-type plus an optional attribute constraint."""
+    an op-type, an optional attribute constraint, and — for DAG patterns —
+    explicit input wiring.
+
+    ``deps``: indices of earlier pattern nodes whose outputs this node must
+    consume (the reference's ``TensorX`` input wiring,
+    ``substitution.h:39-83``).  ``None`` keeps the legacy chain default
+    (consume the previous node); ``()`` matches anywhere.
+    """
 
     op_type: OperatorType
     constraint: Optional[Callable[[Layer], bool]] = None
+    deps: Optional[Tuple[int, ...]] = None
 
     def matches(self, layer: Layer) -> bool:
         if layer.op_type is not self.op_type:
@@ -54,18 +62,31 @@ class OpX:
 
 @dataclasses.dataclass
 class GraphXfer:
-    """A chain pattern + a per-matched-op candidate selector.
+    """A DAG pattern + a per-matched-op candidate selector.
 
     ``select[i](candidates)`` picks the replacement OpSharding for the i-th
     matched op from its enumerated candidate list (None = leave unchanged).
+
+    General (multi-input) pattern graphs match the reference's capability
+    (``substitution.h:169-247`` matches arbitrary pattern graphs, not just
+    chains): each :class:`OpX` wires its ``deps`` to earlier pattern nodes,
+    so two-branch shapes like ``add(linear(x), linear(x))`` are expressible.
     """
 
     name: str
     pattern: List[OpX]
     select: List[Optional[Callable[[List[OpSharding]], Optional[OpSharding]]]]
 
+    def _deps(self, i: int) -> Tuple[int, ...]:
+        d = self.pattern[i].deps
+        if d is not None:
+            assert all(0 <= j < i for j in d), f"{self.name}: bad deps at {i}"
+            return d
+        return (i - 1,) if i > 0 else ()
+
     def find_matches(self, layers: List[Layer]) -> List[Tuple[Layer, ...]]:
-        """All chains l0 -> l1 -> ... where l{i+1} consumes l{i}'s output."""
+        """All injective assignments pattern-node -> layer respecting op
+        types, constraints, and ``deps`` wiring."""
         by_producer: Dict[int, List[Layer]] = {}
         for layer in layers:
             for t in layer.inputs:
@@ -75,19 +96,25 @@ class GraphXfer:
                     ).append(layer)
         out: List[Tuple[Layer, ...]] = []
 
-        def extend(chain: Tuple[Layer, ...]) -> None:
-            i = len(chain)
+        def extend(match: Tuple[Layer, ...]) -> None:
+            i = len(match)
             if i == len(self.pattern):
-                out.append(chain)
+                out.append(match)
                 return
+            deps = self._deps(i)
             cands = (
-                layers
-                if i == 0
-                else by_producer.get(int(chain[-1].layer_guid), [])
+                by_producer.get(int(match[deps[0]].layer_guid), [])
+                if deps
+                else layers
             )
             for layer in cands:
-                if self.pattern[i].matches(layer):
-                    extend(chain + (layer,))
+                if layer in match or not self.pattern[i].matches(layer):
+                    continue
+                if all(
+                    any(t.owner_layer is match[d] for t in layer.inputs)
+                    for d in deps
+                ):
+                    extend(match + (layer,))
 
         extend(())
         return out
@@ -151,6 +178,64 @@ def _sel_data_parallel(cands: List[OpSharding]) -> Optional[OpSharding]:
 
 def _sel_replicated(cands: List[OpSharding]) -> Optional[OpSharding]:
     return cands[0] if cands else None
+
+
+# named selector registry — the vocabulary JSON rules may reference
+SELECTORS: Dict[str, Callable[[List[OpSharding]], Optional[OpSharding]]] = {
+    "channel_sharded": _sel_channel_sharded,
+    "partial": _sel_partial,
+    "data_parallel": _sel_data_parallel,
+    "replicated": _sel_replicated,
+}
+
+
+def load_xfers_from_json(text_or_path: str) -> List[GraphXfer]:
+    """TASO-style JSON rule loader (reference ``substitution_loader.cc`` +
+    ``substitutions/graph_subst_3_v2.json``), adapted to the TPU IR: a rule
+    is a DAG pattern over op types (``deps`` wiring = the reference's
+    ``srcOp``/``TensorX`` input maps) plus a named target-sharding selector
+    per node (the TPU form of the reference's ``dstOp`` rewrite — sharding
+    transitions instead of inserted parallel-op nodes).
+
+    Schema::
+
+        {"rules": [{
+            "name": "...",
+            "pattern": [{"op": "linear", "deps": []},
+                        {"op": "ew_add", "deps": [0]}],
+            "select": ["channel_sharded", "channel_sharded" | null]
+        }]}
+    """
+    import json
+
+    if text_or_path.lstrip().startswith("{"):
+        doc = json.loads(text_or_path)
+    else:
+        with open(text_or_path) as f:  # mistyped paths -> FileNotFoundError
+            doc = json.load(f)
+    xfers: List[GraphXfer] = []
+    for rule in doc["rules"]:
+        name = rule["name"]
+        pattern = []
+        for i, p in enumerate(rule["pattern"]):
+            deps = tuple(p["deps"]) if "deps" in p else None
+            if deps is not None and not all(0 <= j < i for j in deps):
+                raise ValueError(
+                    f"rule {name!r}: node {i} deps {deps} must reference "
+                    "earlier pattern nodes only"
+                )
+            pattern.append(OpX(OperatorType(p["op"]), deps=deps))
+        unknown = [s for s in rule["select"] if s is not None and s not in SELECTORS]
+        if unknown:
+            raise ValueError(
+                f"rule {name!r}: unknown selectors {unknown}; "
+                f"known: {sorted(SELECTORS)}"
+            )
+        select = [None if s is None else SELECTORS[s] for s in rule["select"]]
+        if len(pattern) != len(select):
+            raise ValueError(f"rule {name!r}: pattern/select length mismatch")
+        xfers.append(GraphXfer(name, pattern, select))
+    return xfers
 
 
 def generate_all_pcg_xfers(mesh: MachineMesh) -> List[GraphXfer]:
@@ -227,6 +312,7 @@ def base_optimize(
     alpha: float = 1.05,
     lambda_mem: float = 0.0,
     node_time_fn=None,
+    extra_xfers: Optional[Sequence[GraphXfer]] = None,
 ) -> Tuple[float, Dict[int, OpSharding]]:
     """Best-first backtracking over xfer applications (reference
     ``base_optimize``, ``substitution.cc:2229-2311``): pop the cheapest
@@ -234,7 +320,8 @@ def base_optimize(
     ``alpha * best``; ``budget`` bounds pops.  ``node_time_fn`` plugs the
     measured cost tier into every candidate evaluation (the reference's
     defining feature: search driven by on-device kernel timing,
-    ``src/runtime/simulator.cc:537-577``)."""
+    ``src/runtime/simulator.cc:537-577``).  ``extra_xfers`` appends
+    JSON-loaded rules to the generator set (``substitution_loader.cc``)."""
     m = machine or TPUMachineModel()
 
     def cost_of(assign: Dict[int, OpSharding]) -> float:
@@ -244,7 +331,7 @@ def base_optimize(
             layers, st, m, lambda_mem=lambda_mem, node_time_fn=node_time_fn
         )
 
-    xfers = generate_all_pcg_xfers(mesh)
+    xfers = generate_all_pcg_xfers(mesh) + list(extra_xfers or ())
     matches = [(x, mt) for x in xfers for mt in x.find_matches(layers)]
     cand_cache: Dict[int, List[OpSharding]] = {}
 
@@ -317,6 +404,7 @@ def graph_optimize(
     beam: int = 16,
     lambda_mem: float = 0.0,
     node_time_fn=None,
+    extra_xfers: Optional[Sequence[GraphXfer]] = None,
     _depth: int = 0,
 ) -> Tuple[float, Dict[int, OpSharding]]:
     """Recursive optimize (reference ``GraphSearchHelper::graph_optimize``,
@@ -331,18 +419,18 @@ def graph_optimize(
             pre, post = layers[: split + 1], layers[split + 1 :]
             _, a1 = graph_optimize(
                 pre, graph_inputs, mesh, machine, budget // 2 or 1, alpha,
-                beam, lambda_mem, node_time_fn, _depth + 1,
+                beam, lambda_mem, node_time_fn, extra_xfers, _depth + 1,
             )
             post_inputs = [t for l in post for t in l.inputs
                            if t.owner_layer is None or t.owner_layer in pre]
             _, a2 = graph_optimize(
                 post, post_inputs, mesh, machine, budget // 2 or 1, alpha,
-                beam, lambda_mem, node_time_fn, _depth + 1,
+                beam, lambda_mem, node_time_fn, extra_xfers, _depth + 1,
             )
             merged = {**a1, **a2}
             return base_optimize(
                 layers, mesh, merged, machine, budget, alpha, lambda_mem,
-                node_time_fn,
+                node_time_fn, extra_xfers,
             )
 
     helper = SearchHelper(
@@ -351,5 +439,6 @@ def graph_optimize(
     )
     _, assign = helper.solve()
     return base_optimize(
-        layers, mesh, assign, machine, budget, alpha, lambda_mem, node_time_fn
+        layers, mesh, assign, machine, budget, alpha, lambda_mem, node_time_fn,
+        extra_xfers,
     )

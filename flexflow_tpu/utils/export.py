@@ -150,9 +150,7 @@ def profiling_rows(
     analog (per-op timing printouts, ``model.cc:3650``).  Uses measured
     times when an OpProfiler is given (reference CUDA-event path,
     ``model.cu:38``), the analytic roofline otherwise."""
-    from flexflow_tpu.search.cost import TPUMachineModel, node_cost
-    from flexflow_tpu.parallel.spec import TensorSharding
-    from flexflow_tpu.parallel.strategy import OpSharding
+    from flexflow_tpu.search.cost import TPUMachineModel, default_op_sharding, node_cost
 
     m = machine or TPUMachineModel()
     node_time_fn = None
@@ -166,11 +164,7 @@ def profiling_rows(
         if layer.op_type.is_parallel_op:
             continue
         opdef = get_op_def(layer.op_type)
-        s = strategy.op_sharding(layer) or OpSharding(
-            output=[
-                TensorSharding.replicated(len(sh)) for sh, _ in opdef.infer(layer)
-            ]
-        )
+        s = strategy.op_sharding(layer) or default_op_sharding(layer)
         t = node_time_fn(layer, s) if node_time_fn else node_cost(layer, s, strategy.mesh, m)
         rows.append(
             {

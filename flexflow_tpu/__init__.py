@@ -27,6 +27,7 @@ from flexflow_tpu.initializer import (
 from flexflow_tpu.model import FFModel
 from flexflow_tpu.optimizer import AdamOptimizer, SGDOptimizer
 from flexflow_tpu.parallel.machine import MachineMesh
+from flexflow_tpu.runtime.recompile import RecompileState
 from flexflow_tpu.parallel.spec import TensorSharding
 from flexflow_tpu.parallel.strategy import (
     Strategy,
@@ -55,6 +56,7 @@ __all__ = [
     "Strategy",
     "data_parallel_strategy",
     "tensor_parallel_strategy",
+    "RecompileState",
     "GlorotUniform",
     "ZeroInitializer",
     "OnesInitializer",

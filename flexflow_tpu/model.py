@@ -49,6 +49,7 @@ from flexflow_tpu.parallel.strategy import (
     tensor_parallel_strategy,
 )
 from flexflow_tpu.runtime.executor import Executor
+from flexflow_tpu.runtime.recompile import RecompileState
 from flexflow_tpu.tensor import Layer, Tensor
 
 
@@ -595,6 +596,12 @@ class FFModel:
         """
         assert self.layers, "empty model"
         cfg = self.config
+        # pre-resolution args retained for recompile() (R17): a None mesh/
+        # strategy re-resolves against the altered graph
+        self._compile_call = dict(
+            optimizer=optimizer, loss_type=loss_type, metrics=list(metrics),
+            mesh=mesh, strategy=strategy, seed=seed,
+        )
         self._optimizer = optimizer or SGDOptimizer(
             lr=cfg.learning_rate, weight_decay=cfg.weight_decay
         )
@@ -735,6 +742,28 @@ class FFModel:
                 )
             ))
 
+    def recompile(self, preserve_weights: bool = True) -> None:
+        """Rebuild the step program after a model alteration (R17:
+        reference ``RecompileState`` recompilation path,
+        ``recompile.h:26-41``).  Re-runs :meth:`compile` with the original
+        arguments (auto-derived mesh/strategy re-resolve against the
+        altered graph) and restores every weight whose (layer, name,
+        shape) survived."""
+        assert self.executor is not None, "call compile() first"
+        snapshot = self.get_weights() if preserve_weights else None
+        self.compile(**self._compile_call)
+        if snapshot is None:
+            return
+        ex = self.executor
+        keep: Dict[str, Dict[str, np.ndarray]] = {}
+        for lname, ws in snapshot.items():
+            for wname, arr in ws.items():
+                bucket = self._weight_bucket(ex, lname, wname)
+                if bucket is not None and bucket[lname][wname].shape == arr.shape:
+                    keep.setdefault(lname, {})[wname] = arr
+        if keep:
+            self.set_weights(keep)
+
     # ------------------------------------------------------------------- fit
     def fit(
         self,
@@ -745,6 +774,7 @@ class FFModel:
         verbose: bool = True,
         shuffle: bool = False,
         seed: int = 0,
+        recompile_state: Optional["RecompileState"] = None,
     ) -> PerfMetrics:
         """Canonical training loop (reference ``FFModel.fit``,
         ``flexflow_cffi.py:2062-2104``).  Each iteration is one cached jit
@@ -788,6 +818,14 @@ class FFModel:
                 *bx, by = batch
                 loss, m = self.executor.train_step(bx, by)
                 pm.update({k: float(v) for k, v in m.items()}, bs)
+                # R17 recompile hook: per-iteration trigger/alter, like the
+                # reference's recompile_on_condition in the train loop
+                # (moe.cc:180)
+                if recompile_state is not None:
+                    recompile_state.observe(
+                        float(loss), {k: float(v) for k, v in m.items()}
+                    )
+                    recompile_state.maybe_recompile(self)
             if verbose:
                 print(
                     f"epoch {epoch}: loss={float(loss):.4f} "
@@ -814,6 +852,17 @@ class FFModel:
             out.setdefault(lname, {}).update(ws)
         return out
 
+    @staticmethod
+    def _weight_bucket(ex: Executor, lname: str, wname: str):
+        """The executor store (params vs state) holding weight
+        (lname, wname), or None — single source of routing truth for
+        set_weights and recompile."""
+        if lname in ex.params and wname in ex.params[lname]:
+            return ex.params
+        if lname in ex.state and wname in ex.state[lname]:
+            return ex.state
+        return None
+
     def set_weights(self, weights: Dict[str, Dict[str, np.ndarray]]) -> None:
         """Reference ``set_tensor``/numpy attach
         (``examples/python/native/mnist_mlp_attach.py`` pattern)."""
@@ -821,11 +870,8 @@ class FFModel:
         ex = self.executor
         for lname, ws in weights.items():
             for wname, arr in ws.items():
-                bucket = (
-                    ex.params
-                    if lname in ex.params and wname in ex.params[lname]
-                    else ex.state
-                )
+                bucket = self._weight_bucket(ex, lname, wname)
+                assert bucket is not None, f"unknown weight {lname}/{wname}"
                 cur = bucket[lname][wname]
                 bucket[lname][wname] = jax.device_put(
                     np.asarray(arr, dtype=np.asarray(cur).dtype), cur.sharding

@@ -1,0 +1,57 @@
+"""Recompile hooks — dynamic trigger/alter recompilation (R17).
+
+Reference: ``RecompileState`` (``include/flexflow/recompile.h:26-41``,
+``src/recompile/recompile_state.cc:7-24``): a trigger function evaluated
+every training iteration and an alter function that mutates the model,
+after which the runtime recompiles.  Used for adaptive model alteration —
+e.g. MoE capacity rebalancing (``examples/cpp/mixture_of_experts/moe.cc:180``).
+
+TPU-native: "recompile" = rebuild the jitted step program.  ``FFModel.fit``
+evaluates the trigger after every step; on fire it runs ``alter_fn(model)``
+(mutate layer attrs, e.g. the experts' capacity factor ``alpha``) and calls
+``FFModel.recompile()``, which re-resolves the strategy, rebuilds the
+Executor, and restores every weight whose (layer, name, shape) survived
+the alteration.  XLA retraces on the next step — the analog of the
+reference re-running its compile pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+
+class RecompileState:
+    """Per-run trigger/alter state (reference ``recompile.h:26-41``).
+
+    ``trigger_fn(state) -> bool`` — evaluated after every training step;
+    sees ``iteration``, ``last_loss``, ``last_metrics``.
+    ``alter_fn(model) -> None`` — mutates the model (layer attrs / graph);
+    the runtime recompiles afterwards.
+    """
+
+    def __init__(
+        self,
+        trigger_fn: Callable[["RecompileState"], bool],
+        alter_fn: Callable[[object], None],
+    ) -> None:
+        self.trigger_fn = trigger_fn
+        self.alter_fn = alter_fn
+        self.iteration = 0
+        self.last_loss: Optional[float] = None
+        self.last_metrics: Dict[str, float] = {}
+        self.recompilations = 0
+
+    def observe(self, loss: float, metrics: Dict[str, float]) -> None:
+        self.iteration += 1
+        self.last_loss = loss
+        self.last_metrics = metrics
+
+    def maybe_recompile(self, model) -> bool:
+        """Reference ``FFModel::recompile_on_condition`` analog: fire the
+        trigger, run alter + recompile when true."""
+        if not self.trigger_fn(self):
+            return False
+        self.alter_fn(model)
+        model.recompile()
+        self.recompilations += 1
+        return True

@@ -227,7 +227,7 @@ def simulate_strategy(
     """
     m = machine or TPUMachineModel()
     mesh = strategy.mesh
-    from flexflow_tpu.search.cost import node_cost
+    from flexflow_tpu.search.cost import default_op_sharding, node_cost
 
     from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
     from flexflow_tpu.parallel.spec import TensorSharding
@@ -290,8 +290,6 @@ def simulate_strategy(
         if node_time_fn is not None:
             dur = node_time_fn(layer, s)
         else:
-            from flexflow_tpu.search.cost import default_op_sharding
-
             dur = node_cost(layer, s or default_op_sharding(layer), mesh, m)
         task = SimTask(layer.name, dur, "compute", deps + comm_deps)
         tasks.append(task)

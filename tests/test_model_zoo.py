@@ -72,34 +72,36 @@ def test_resnet_builds_and_trains_dp():
                  mesh=mesh)
 
 
-def test_resnext_builds():
+def test_resnext_builds_and_trains():
     batch = 2
     model = FFModel(FFConfig(batch_size=batch))
     out = resnext50(model, batch, num_classes=10, height=64, width=64)
     assert out.shape == (batch, 10)
     # grouped conv present
     assert any(l.attrs.get("groups", 1) == 32 for l in model.layers)
-    # shape-infer + param init only (full fwd is CPU-heavy); the graph is
-    # validated by compile
-    model.compile(
-        optimizer=SGDOptimizer(lr=0.01),
-        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
-        mesh=MachineMesh((1, 1), ("data", "model")),
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 3, 64, 64)).astype(np.float32)
+    y = rng.integers(0, 10, size=(batch, 1)).astype(np.int32)
+    losses = _train_steps(
+        model, out, [x], y, LossType.SPARSE_CATEGORICAL_CROSSENTROPY, steps=2
     )
     assert model.num_parameters > 1e6
+    assert losses[1] != losses[0], "no parameter movement"
 
 
-def test_inception_builds():
+def test_inception_builds_and_trains():
     batch = 2
     model = FFModel(FFConfig(batch_size=batch))
     out = inception_v3(model, batch, num_classes=10, height=75, width=75)
     assert out.shape == (batch, 10)
-    model.compile(
-        optimizer=SGDOptimizer(lr=0.01),
-        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
-        mesh=MachineMesh((1, 1), ("data", "model")),
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 3, 75, 75)).astype(np.float32)
+    y = rng.integers(0, 10, size=(batch, 1)).astype(np.int32)
+    losses = _train_steps(
+        model, out, [x], y, LossType.SPARSE_CATEGORICAL_CROSSENTROPY, steps=2
     )
     assert model.num_parameters > 1e6
+    assert losses[1] != losses[0], "no parameter movement"
 
 
 def test_dlrm_trains_param_parallel():

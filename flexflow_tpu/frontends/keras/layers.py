@@ -280,8 +280,12 @@ class _Merge(Layer):
     def build_ff(self, model, inputs):
         fn = getattr(model, self.fn)
         out = inputs[0]
-        for t in inputs[1:]:
-            out = fn(out, t, name=self.name)
+        for i, t in enumerate(inputs[1:]):
+            # suffix chained ops: user-supplied names are not uniquified by
+            # FFModel._name, so 3+-input merges would collide (round-1
+            # advisor finding)
+            nm = self.name if i == 0 else f"{self.name}_{i}"
+            out = fn(out, t, name=nm)
         return out
 
 

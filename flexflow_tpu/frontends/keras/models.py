@@ -109,7 +109,10 @@ class Model:
 
     # --- train/eval -------------------------------------------------------
     def fit(self, x, y, batch_size: int = 32, epochs: int = 1,
-            callbacks: Sequence = (), verbose: bool = True) -> PerfMetrics:
+            callbacks: Sequence = (), verbose: bool = True,
+            shuffle: bool = True, seed: int = 0) -> PerfMetrics:
+        # shuffle defaults True like real Keras Model.fit (round-1 advisor
+        # finding); identical seed keeps multi-input rows aligned
         xs = list(x) if isinstance(x, (list, tuple)) else [x]
         if self.ffmodel is None or self.ffmodel.config.batch_size != batch_size:
             # changing the batch size re-traces the step program; carry the
@@ -122,8 +125,15 @@ class Model:
         for cb in callbacks:
             cb.set_model(self)
             cb.on_train_begin()
-        loaders = [SingleDataLoader(a, batch_size, None, None) for a in xs]
-        loaders.append(SingleDataLoader(np.asarray(y), batch_size, None, None))
+        loaders = [
+            SingleDataLoader(a, batch_size, None, None, shuffle=shuffle, seed=seed)
+            for a in xs
+        ]
+        loaders.append(
+            SingleDataLoader(
+                np.asarray(y), batch_size, None, None, shuffle=shuffle, seed=seed
+            )
+        )
         it = BatchIterator(loaders)
         pm = PerfMetrics()
         logs: Dict[str, float] = {}
@@ -226,13 +236,15 @@ class Sequential(Model):
         self.outputs = [t]
 
     def fit(self, x, y, batch_size: int = 32, epochs: int = 1,
-            callbacks: Sequence = (), verbose: bool = True) -> PerfMetrics:
+            callbacks: Sequence = (), verbose: bool = True,
+            shuffle: bool = True, seed: int = 0) -> PerfMetrics:
         arr = np.asarray(x[0] if isinstance(x, (list, tuple)) else x)
         from flexflow_tpu.fftype import DataType
 
         dt = DataType.INT32 if np.issubdtype(arr.dtype, np.integer) else DataType.FLOAT
         self._ensure_graph(arr.shape[1:], dt)
-        return super().fit(x, y, batch_size, epochs, callbacks, verbose)
+        return super().fit(x, y, batch_size, epochs, callbacks, verbose,
+                           shuffle, seed)
 
     def evaluate(self, x, y, batch_size: int = 32):
         arr = np.asarray(x[0] if isinstance(x, (list, tuple)) else x)

@@ -55,6 +55,12 @@ class ONNXModel:
         else:
             self.model = source
         self.graph = self.model.graph
+        # default-domain opset version — op defaults depend on it (e.g.
+        # Softmax axis, round-1 advisor finding)
+        self.opset = next(
+            (o.version for o in self.model.opset_import if o.domain in ("", "ai.onnx")),
+            13,
+        )
         # initializer name -> numpy array (weights baked into the graph)
         self.inits = {
             i.name: onnx.numpy_helper.to_array(i) for i in self.graph.initializer
@@ -73,7 +79,21 @@ class ONNXModel:
         ins = [values[i] for i in node.input if i in values]
 
         if op == "Gemm" or op == "MatMul":
-            # weight comes from an initializer; out_dim = its last dim
+            # weight comes from an initializer; out_dim = its last dim.
+            # Gemm attributes the dense layer cannot represent must fail
+            # loudly, not silently mistranslate (round-1 advisor finding).
+            if op == "Gemm":
+                if a.get("transA", 0):
+                    raise NotImplementedError(f"{name}: Gemm transA=1")
+                if a.get("alpha", 1.0) != 1.0:
+                    raise NotImplementedError(
+                        f"{name}: Gemm alpha={a.get('alpha')} != 1"
+                    )
+                # beta only scales the C (bias) input — irrelevant without it
+                if len(node.input) > 2 and a.get("beta", 1.0) != 1.0:
+                    raise NotImplementedError(
+                        f"{name}: Gemm beta={a.get('beta')} != 1 with C input"
+                    )
             w = next((self.inits[i] for i in node.input if i in self.inits), None)
             assert w is not None, f"{name}: missing weight initializer"
             out_dim = w.shape[0] if a.get("transB") else w.shape[-1]
@@ -114,7 +134,16 @@ class ONNXModel:
         elif op == "Tanh":
             values[node.output[0]] = model.tanh(ins[0], name=name)
         elif op == "Softmax":
-            values[node.output[0]] = model.softmax(ins[0], dim=a.get("axis", -1), name=name)
+            # opset >= 13 defaults axis to -1; older opsets default to 1
+            # (coalesced trailing dims) — round-1 advisor finding
+            default_axis = -1 if self.opset >= 13 else 1
+            axis = a.get("axis", default_axis)
+            if self.opset < 13 and axis not in (-1, ins[0].ndim - 1):
+                raise NotImplementedError(
+                    f"{name}: opset-{self.opset} Softmax axis={axis} has "
+                    "flatten-then-softmax semantics the importer does not model"
+                )
+            values[node.output[0]] = model.softmax(ins[0], dim=axis, name=name)
         elif op == "Add":
             values[node.output[0]] = model.add(ins[0], ins[1], name=name)
         elif op == "Sub":
@@ -129,6 +158,13 @@ class ONNXModel:
             shape_arr = next(self.inits[i] for i in node.input if i in self.inits)
             shape = [int(s) for s in shape_arr]
             x = ins[0]
+            # ONNX: 0 means "copy the input dim at this position" (unless
+            # allowzero) — round-1 advisor finding
+            if not a.get("allowzero", 0):
+                shape = [
+                    x.shape[i] if s == 0 and i < x.ndim else s
+                    for i, s in enumerate(shape)
+                ]
             if -1 in shape:
                 known = math.prod(s for s in shape if s != -1)
                 shape[shape.index(-1)] = math.prod(x.shape) // known

@@ -751,6 +751,7 @@ class FFModel:
         shape) survived."""
         assert self.executor is not None, "call compile() first"
         snapshot = self.get_weights() if preserve_weights else None
+        old_opt = jax.tree.map(np.asarray, self.executor.opt_state) if preserve_weights else None
         self.compile(**self._compile_call)
         if snapshot is None:
             return
@@ -763,6 +764,24 @@ class FFModel:
                     keep.setdefault(lname, {})[wname] = arr
         if keep:
             self.set_weights(keep)
+        # carry optimizer state (Adam moments / SGD momentum / step count)
+        # for surviving weights — a mid-training recompile must not reset
+        # the trajectory of unaltered layers
+        if old_opt is not None:
+            new_opt = ex.opt_state
+            for key, old_val in old_opt.items():
+                if key not in new_opt:
+                    continue
+                if not isinstance(old_val, dict):  # e.g. the step counter
+                    new_opt[key] = jax.device_put(old_val)
+                    continue
+                for lname, ws in old_val.items():
+                    for wname, arr in ws.items():
+                        cur = new_opt.get(key, {}).get(lname, {}).get(wname)
+                        if cur is not None and cur.shape == arr.shape:
+                            new_opt[key][lname][wname] = jax.device_put(
+                                np.asarray(arr, np.asarray(cur).dtype), cur.sharding
+                            )
 
     # ------------------------------------------------------------------- fit
     def fit(

@@ -96,7 +96,14 @@ class MultiHeadAttention(OpDef):
         # ragged cross-attention) fall back to the global path.
         sp_axis = ctx.seq_axis(0, dim=1)
         sp = ctx.mesh.shape[sp_axis] if sp_axis is not None else 1
-        if sp_axis is not None and sq % sp == 0 and sk % sp == 0:
+        # causal ragged cross-attention (sq != sk) has rows with zero
+        # attendable keys whose sharded/global semantics diverge — use the
+        # global path there (self-attention, the only causal use, has
+        # sq == sk)
+        sp_ok = sq % sp == 0 and sk % sp == 0 and (
+            not a.get("causal", False) or sq == sk
+        )
+        if sp_axis is not None and sp_ok:
             from flexflow_tpu.parallel.sequence import (
                 ring_attention,
                 ulysses_attention,

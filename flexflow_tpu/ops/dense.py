@@ -95,7 +95,7 @@ class Linear(OpDef):
     def partitionable_dims(self, layer):
         t = layer.inputs[0]
         d = {0: "sample", t.ndim - 1: "channel"}
-        if t.ndim >= 3:
+        if t.ndim == 3:  # (B,S,H) only — not NCHW channels
             d[1] = "seq"
         return d
 

@@ -68,13 +68,15 @@ class ElementUnary(OpDef):
 
     def partitionable_dims(self, layer):
         # Elementwise ops preserve any input sharding; every dim is legal.
-        # Rank>=3 activations are (B, S, ...): dim 1 is the sequence dim, so
+        # Rank-3 activations are (B, S, H): dim 1 is the sequence dim, so
         # seq-parallel strategies can keep residual adds seq-sharded.
+        # Rank-4 NCHW dim 1 is channels — 'seq' there would lose the
+        # model-axis option for CNNs (round-1 advisor finding).
         t = layer.inputs[0]
         d = {0: "sample"}
         for i in range(1, t.ndim):
             d[i] = "channel"
-        if t.ndim >= 3:
+        if t.ndim == 3:
             d[1] = "seq"
         return d
 
@@ -100,8 +102,8 @@ class ElementBinary(OpDef):
         d = {0: "sample"}
         for i in range(1, len(shape)):
             d[i] = "channel"
-        if len(shape) >= 3:
-            d[1] = "seq"  # (B, S, ...) activations: dim 1 is sequence
+        if len(shape) == 3:
+            d[1] = "seq"  # (B, S, H) only — rank-4 NCHW dim 1 is channels
         return d
 
 

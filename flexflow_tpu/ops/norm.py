@@ -67,7 +67,8 @@ class LayerNorm(OpDef):
         for i in range(t.ndim):
             if i in axes:
                 continue
-            d[i] = "sample" if i == 0 else ("seq" if i == 1 and t.ndim >= 3 else "channel")
+            # rank-3 (B,S,H) only: rank-4 NCHW dim 1 is channels
+            d[i] = "sample" if i == 0 else ("seq" if i == 1 and t.ndim == 3 else "channel")
         return d
 
 
@@ -91,7 +92,7 @@ class RMSNorm(OpDef):
     def partitionable_dims(self, layer):
         t = layer.inputs[0]
         d = {0: "sample"}
-        if t.ndim >= 3:
+        if t.ndim == 3:  # (B,S,H) only — not NCHW channels
             d[1] = "seq"
         return d
 
@@ -135,8 +136,8 @@ class Dropout(OpDef):
     def partitionable_dims(self, layer):
         t = layer.inputs[0]
         d = {i: ("sample" if i == 0 else "channel") for i in range(t.ndim)}
-        if t.ndim >= 3:
-            d[1] = "seq"  # (B, S, ...) activations: dim 1 is sequence
+        if t.ndim == 3:
+            d[1] = "seq"  # (B, S, H) only — rank-4 NCHW dim 1 is channels
         return d
 
 

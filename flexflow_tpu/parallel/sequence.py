@@ -108,7 +108,12 @@ def _ring_local(q, k, v, rng, *, axis_name: str, axis_size: int, causal: bool,
         step, (o0, m0, l0, k, v), jnp.arange(axis_size - 1)
     )
     o, _, l = fold(o, m, l, kb, vb, axis_size - 1)
-    return (o / l).astype(q.dtype)
+    # belt-and-braces NaN guard: l == 0 requires a causal row with zero
+    # attendable keys, which the attention op excludes from this path
+    # (causal implies sq == sk there); guarded rows would yield zeros,
+    # which differs from global sdpa's uniform-softmax limit — hence the
+    # exclusion rather than reliance on this guard (round-1 advisor finding)
+    return (o / jnp.maximum(l, jnp.finfo(jnp.float32).tiny)).astype(q.dtype)
 
 
 def _specs(batch_axis, head_axis, axis):

@@ -222,10 +222,11 @@ def sequence_parallel_strategy(
             spec: List = [None] * t.ndim
             if dp > 1 and t.shape[0] % dp == 0:
                 spec[0] = dp_axis
-            # dim 1 of a graph input is "sequence" for rank>=3 activations
-            # and for token-id inputs (B, S) feeding an embedding; for
-            # rank-2 feature inputs it is a channel dim — leave it alone
-            seq_like = t.ndim >= 3 or layer.op_type is OperatorType.EMBEDDING
+            # dim 1 of a graph input is "sequence" for rank-3 (B,S,H)
+            # activations and token-id inputs (B, S) feeding an embedding;
+            # rank-2 feature inputs and rank-4 NCHW images keep dim 1 as a
+            # channel dim (round-1 advisor finding)
+            seq_like = t.ndim == 3 or layer.op_type is OperatorType.EMBEDDING
             if seq_like and t.shape[1] % sp == 0:
                 spec[1] = sp_axis
             while len(entry.inputs) <= j:

@@ -78,14 +78,41 @@ def test_recompile_alters_capacity_and_preserves_weights():
 
 
 def test_recompile_preserves_exact_values_without_steps():
-    """recompile() alone (no intervening steps) must round-trip weights."""
-    model = _moe_model()
+    """recompile() alone (no intervening steps) must round-trip weights
+    AND optimizer state (Adam moments must not reset mid-training)."""
+    from flexflow_tpu import AdamOptimizer
+
+    cfg = FFConfig(batch_size=B, learning_rate=0.05)
+    model = FFModel(cfg)
+    moe_classifier(model, batch=B, in_dim=D, num_exp=4, num_select=2,
+                   hidden=24, num_classes=C, alpha=1.0, fused=True)
+    model.compile(
+        optimizer=AdamOptimizer(alpha=1e-3),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        mesh=MachineMesh((1, 1), ("data", "model")),
+        seed=0,
+    )
+    x, y = _data(B)
+    for _ in range(2):  # populate Adam moments + step count
+        model.executor.train_step([x[:B]], y[:B])
     before = model.get_weights()
+    import jax
+
+    opt_before = jax.tree.map(np.asarray, model.executor.opt_state)
     model.recompile()
     after = model.get_weights()
     for lname, ws in before.items():
         for wname, arr in ws.items():
             np.testing.assert_array_equal(after[lname][wname], arr)
+    opt_after = jax.tree.map(np.asarray, model.executor.opt_state)
+    np.testing.assert_array_equal(opt_after["step"], opt_before["step"])
+    assert int(opt_after["step"]) == 2
+    for key in ("m", "v"):
+        for lname, ws in opt_before[key].items():
+            for wname, arr in ws.items():
+                np.testing.assert_array_equal(opt_after[key][lname][wname], arr)
+                assert np.any(arr != 0), f"{key}/{lname}/{wname} never updated"
 
 
 def test_trigger_on_loss_plateau():

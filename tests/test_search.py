@@ -151,6 +151,36 @@ def test_linear_candidates_cover_reference_xfers():
     assert cands[0].output[0].spec == (None, None)  # replicated first
 
 
+def test_nchw_dim1_stays_channel_not_seq():
+    """Rank-4 NCHW activations keep dim 1 as a 'channel' dim so CNN search
+    retains the model-axis option there; only rank-3 (B,S,H) activations
+    label dim 1 'seq' (round-1 advisor finding)."""
+    from flexflow_tpu.fftype import ActiMode
+    from flexflow_tpu.ops.base import get_op_def
+
+    model = FFModel(FFConfig(batch_size=8))
+    t = model.create_tensor((8, 32, 16, 16), name="img")  # NCHW
+    r4 = model.relu(t, name="r4")
+    a4 = model.add(r4, r4, name="residual4")  # binary op (residual add)
+    d4 = model.dropout(a4, 0.1, name="drop4")
+    model.flat(d4)
+    for lname in ("r4", "residual4", "drop4"):
+        layer = next(l for l in model.layers if l.name == lname)
+        pdims = get_op_def(layer.op_type).partitionable_dims(layer)
+        assert pdims[1] == "channel", f"{lname}: dim1 labeled {pdims[1]}"
+    relu_layer = model.layers[0]
+    mesh = MachineMesh((2, 4, 1), ("data", "model", "seq"))
+    cands = op_candidates(relu_layer, mesh)
+    assert any("model" in c.output[0].axes_of(1) for c in cands)
+    assert not any("seq" in c.output[0].axes_of(1) for c in cands)
+
+    m2 = FFModel(FFConfig(batch_size=8))
+    t3 = m2.create_tensor((8, 16, 32), name="bsh")  # (B,S,H)
+    m2.relu(t3, name="r3")
+    r3_layer = m2.layers[0]
+    assert get_op_def(r3_layer.op_type).partitionable_dims(r3_layer)[1] == "seq"
+
+
 def test_candidates_deterministic():
     model = build_mlp()
     lin = model.layers[0]

@@ -780,7 +780,7 @@ class FFModel:
                         cur = new_opt.get(key, {}).get(lname, {}).get(wname)
                         if cur is not None and cur.shape == arr.shape:
                             new_opt[key][lname][wname] = jax.device_put(
-                                np.asarray(arr, np.asarray(cur).dtype), cur.sharding
+                                np.asarray(arr, cur.dtype), cur.sharding
                             )
 
     # ------------------------------------------------------------------- fit

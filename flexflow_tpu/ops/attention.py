@@ -134,7 +134,17 @@ class MultiHeadAttention(OpDef):
             return [out @ params["wo"]]
 
         use_flash = a.get("use_flash", True) and kd == vd
-        if use_flash and _flash_ok(sq, sk, kd):
+        # the memory threshold is per-DEVICE: divide the global (b, h)
+        # extent by whatever mesh axes shard the batch and head dims
+        shard_deg = 1
+        if ctx.mesh is not None:
+            if ctx.input_shardings and ctx.input_shardings[0] is not None:
+                for ax in ctx.input_shardings[0].axes_of(0):
+                    shard_deg *= ctx.mesh.shape[ax]
+            head_ax = ctx.weight_axis("wq", 1)
+            if head_ax is not None:
+                shard_deg *= ctx.mesh.shape[head_ax]
+        if use_flash and _flash_ok(sq, sk, kd, max(1, b * h // shard_deg)):
             from flexflow_tpu.ops.pallas.flash_attention import flash_attention
 
             seed = (
@@ -170,18 +180,33 @@ class MultiHeadAttention(OpDef):
         return {0: "sample", 1: "seq", 2: "channel"}
 
 
-def _flash_ok(sq: int, sk: int, d: int) -> bool:
+# Above this many bytes of materialized (b, h, sq, sk) score matrix the
+# O(S^2) sdpa path becomes memory-prohibitive and flash pays; below it,
+# XLA's fused attention measured ~2x faster than the Pallas kernel on v5e
+# (BERT-Base s=512: 43 vs 85 ms/step; s=2048: 419 vs 907) — so dispatch is
+# by memory need, not by default.
+_FLASH_SCORE_BYTES_THRESHOLD = float(2 * (1 << 30))
+
+
+def _flash_ok(sq: int, sk: int, d: int, bh_local: int = 1) -> bool:
     """Flash kernel needs MXU-friendly seq tiles; head dim is free (the
     kernel zero-pads it to the 128-lane grid, so BERT's d=64 qualifies —
-    round-1 verdict dropped the old ``d % 128`` gate).  Engages on TPU, or
-    anywhere when the kernels run in interpreter mode (tests)."""
+    round-1 verdict dropped the old ``d % 128`` gate).  Engages on TPU (or
+    anywhere in interpreter mode, for tests) when the alternative would
+    materialize a PER-DEVICE score matrix past the memory threshold
+    (``bh_local`` = batch*heads on one device after sharding)."""
     import jax as _jax
 
     from flexflow_tpu.ops.pallas import flash_attention as _fa
 
     if not _fa.INTERPRET and _jax.default_backend() != "tpu":
         return False
-    return sq >= 128 and sk >= 128 and sq % 128 == 0 and sk % 128 == 0 and d >= 8
+    if not (sq >= 128 and sk >= 128 and sq % 128 == 0 and sk % 128 == 0 and d >= 8):
+        return False
+    if _fa.INTERPRET:
+        return True  # tests exercise the kernel path regardless of size
+    score_bytes = 4.0 * bh_local * sq * sk  # fwd f32 scores (bwd recompute)
+    return score_bytes >= _FLASH_SCORE_BYTES_THRESHOLD
 
 
 register_op(MultiHeadAttention())

@@ -91,8 +91,8 @@ def _fwd_kernel(
 
     def body(kb, carry):
         acc, m_prev, l_prev = carry
-        k = jax.lax.dynamic_slice(k_ref[:], (kb * block_k, 0), (block_k, d))
-        v = jax.lax.dynamic_slice(v_ref[:], (kb * block_k, 0), (block_k, d))
+        k = k_ref[pl.ds(kb * block_k, block_k), :]
+        v = v_ref[pl.ds(kb * block_k, block_k), :]
         s = jnp.dot(q, k.T.astype(jnp.float32), preferred_element_type=jnp.float32)
         q_pos, k_pos = _positions(q_idx * block_q, kb * block_k, block_q, block_k)
         if causal:
@@ -120,7 +120,10 @@ def _fwd_kernel(
     acc, m, l = jax.lax.fori_loop(0, n_kb_eff, body, (acc0, m0, l0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[:] = (m + jnp.log(l_safe))[None, :]
+    # lse block spans all n_q rows (a (1, block_q) block violates the TPU
+    # sublane rule: penultimate block dim must divide 8 or equal the array
+    # dim); each grid step writes only its own row
+    lse_ref[pl.ds(q_idx, 1), :] = (m + jnp.log(l_safe))[None, :]
 
 
 def _flash_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k):
@@ -148,7 +151,9 @@ def _flash_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, 1, block_q), lambda bh, qi: (bh, qi, 0)),
+            # full n_q rows per block: constant index map keeps the block
+            # live in VMEM across the qi loop; kernel writes row qi only
+            pl.BlockSpec((None, n_q, block_q), lambda bh, qi: (bh, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
@@ -170,8 +175,10 @@ def _dq_kernel(
     bh = pl.program_id(0)
     q = q_ref[:].astype(jnp.float32) * sm_scale
     do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:].reshape(block_q)
-    delta = delta_ref[:].reshape(block_q)
+    # lse/delta blocks span all n_q rows (TPU sublane rule); take this
+    # program's row
+    lse = lse_ref[pl.ds(q_idx, 1), :].reshape(block_q)
+    delta = delta_ref[pl.ds(q_idx, 1), :].reshape(block_q)
 
     n_kb = sk // block_k
     if causal:
@@ -181,8 +188,8 @@ def _dq_kernel(
         n_kb_eff = n_kb
 
     def body(kb, dq):
-        k = jax.lax.dynamic_slice(k_ref[:], (kb * block_k, 0), (block_k, d)).astype(jnp.float32)
-        v = jax.lax.dynamic_slice(v_ref[:], (kb * block_k, 0), (block_k, d)).astype(jnp.float32)
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         q_pos, k_pos = _positions(q_idx * block_q, kb * block_k, block_q, block_k)
         if causal:
@@ -222,10 +229,10 @@ def _dkv_kernel(
 
     def body(qb, carry):
         dk, dv = carry
-        q = jax.lax.dynamic_slice(q_ref[:], (qb * block_q, 0), (block_q, d)).astype(jnp.float32) * sm_scale
-        do = jax.lax.dynamic_slice(do_ref[:], (qb * block_q, 0), (block_q, d)).astype(jnp.float32)
-        lse = jax.lax.dynamic_slice(lse_ref[:], (qb, 0), (1, block_q)).reshape(block_q)
-        delta = jax.lax.dynamic_slice(delta_ref[:], (qb, 0), (1, block_q)).reshape(block_q)
+        q = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32) * sm_scale
+        do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(qb, 1), :].reshape(block_q)
+        delta = delta_ref[pl.ds(qb, 1), :].reshape(block_q)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         q_pos, k_pos = _positions(qb * block_q, k_idx * block_k, block_q, block_k)
         if causal:
@@ -281,8 +288,8 @@ def _flash_bwd(q, k, v, out, lse, do, seed, causal, dropout_rate, block_q, block
             pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, 1, block_q), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, 1, block_q), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, n_q, block_q), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, n_q, block_q), lambda bh, qi: (bh, 0, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),

@@ -1,0 +1,46 @@
+"""Build helper for the C API shared library (R16).
+
+Compiles ``native/flexflow_c.cc`` (the CPython-embedding C ABI — reference
+``src/c/flexflow_c.cc``) into ``native/build/libflexflow_c.so`` on demand
+with g++, mirroring the dataloader's build path
+(:mod:`flexflow_tpu.runtime.native`).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+from typing import List, Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native")
+_BUILD_DIR = os.path.join(_NATIVE_DIR, "build")
+
+
+def _python_flags() -> List[str]:
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_python_version()
+    return [f"-I{inc}", f"-L{libdir}", f"-lpython{ver}",
+            f"-Wl,-rpath,{libdir}", "-ldl", "-lm"]
+
+
+def build_capi(force: bool = False) -> Optional[str]:
+    """Returns the path to libflexflow_c.so, building it if stale."""
+    src = os.path.join(_NATIVE_DIR, "flexflow_c.cc")
+    if not os.path.exists(src):
+        return None
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    so = os.path.join(_BUILD_DIR, "libflexflow_c.so")
+    if (
+        force
+        or not os.path.exists(so)
+        or os.path.getmtime(so) < os.path.getmtime(src)
+    ):
+        tmp = so + ".tmp"
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src, "-o", tmp]
+        cmd += _python_flags()
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, so)
+    return so

@@ -341,23 +341,26 @@ class Executor:
         analog of the reference's per-node zero-copy staging,
         ``src/dataloader/dataloader.cc:232-300``).  Which one arrived is
         disambiguated by the leading-dim size against ``global_batch``."""
-        if isinstance(x, jax.Array) and x.committed:
-            return x
+        # device arrays NEVER round-trip through host numpy (np.asarray on a
+        # jax.Array is a D2H fetch — catastrophic over a tunneled link);
+        # device_put reshards on-device when needed and no-ops when not
+        if self.mesh is None:
+            return x if isinstance(x, jax.Array) else jnp.asarray(np.asarray(x))
+        ns = NamedSharding(self.mesh, pspec)
+        if isinstance(x, jax.Array):
+            return x if x.sharding == ns else jax.device_put(x, ns)
         arr = np.asarray(x)
-        if self.mesh is not None:
-            ns = NamedSharding(self.mesh, pspec)
-            if jax.process_count() > 1:
-                if (
-                    global_batch is not None
-                    and arr.ndim > 0
-                    and arr.shape[0] != global_batch
-                ):
-                    return jax.make_array_from_process_local_data(ns, arr)
-                return jax.make_array_from_callback(
-                    arr.shape, ns, lambda idx: arr[idx]
-                )
-            return jax.device_put(arr, ns)
-        return jnp.asarray(arr)
+        if jax.process_count() > 1:
+            if (
+                global_batch is not None
+                and arr.ndim > 0
+                and arr.shape[0] != global_batch
+            ):
+                return jax.make_array_from_process_local_data(ns, arr)
+            return jax.make_array_from_callback(
+                arr.shape, ns, lambda idx: arr[idx]
+            )
+        return jax.device_put(arr, ns)
 
 
 _REMAT_OPS = frozenset({OperatorType.MULTIHEAD_ATTENTION})

@@ -1,0 +1,446 @@
+// Flat C API over the TPU-native FFModel (R16).
+//
+// Reference: src/c/flexflow_c.cc (1,930 LoC) + include/flexflow/flexflow_c.h
+// (706 LoC) — the flat `flexflow_model_*` ABI the reference's Python cffi
+// binding calls INTO its C++ runtime.  Here the direction inverts: the
+// runtime is Python/JAX, so the C ABI embeds CPython and drives FFModel —
+// the same handle-based surface (create/config/layers/compile/fit/eval),
+// letting C/C++ applications (the analog of the reference's cpp apps +
+// cpp_driver.cc) train models without writing Python.
+//
+// Build (see flexflow_tpu/runtime/capi.py and tests/test_c_api.py):
+//   g++ -O2 -std=c++17 -shared -fPIC flexflow_c.cc -o libflexflow_c.so \
+//       $(python3-config --includes) $(python3-config --ldflags --embed)
+//
+// Thread model: single-threaded C caller; every entry point runs under the
+// GIL acquired at flexflow_init.  Errors: functions return NULL/-1 and
+// flexflow_last_error() returns the Python traceback text.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdarg>
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+extern "C" {
+
+// ---------------------------------------------------------------- errors
+static std::string g_last_error;
+
+const char* flexflow_last_error() { return g_last_error.c_str(); }
+
+}  // extern "C" (reopened below; helpers are C++)
+
+static void capture_py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* msg = PyUnicode_AsUTF8(s);
+      if (msg) g_last_error = msg;  // AsUTF8 can fail (lone surrogates)
+      PyErr_Clear();
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// A handle is just an owned PyObject*.
+struct ff_handle {
+  PyObject* obj;
+};
+
+static ff_handle* wrap(PyObject* obj) {
+  if (obj == nullptr) {
+    capture_py_error();
+    return nullptr;
+  }
+  ff_handle* h = new ff_handle{obj};
+  return h;
+}
+
+static PyObject* ff_module() {
+  static PyObject* mod = nullptr;
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("flexflow_tpu");
+    if (mod == nullptr) capture_py_error();
+  }
+  return mod;
+}
+
+static PyObject* np_module() {
+  static PyObject* np = nullptr;
+  if (np == nullptr) {
+    np = PyImport_ImportModule("numpy");
+    if (np == nullptr) capture_py_error();
+  }
+  return np;
+}
+
+// numpy array owning a COPY of caller memory: np.frombuffer(mv, dtype)
+// .reshape(dims).copy()
+static PyObject* np_array_copy(const void* data, const int64_t* dims,
+                               int ndim, const char* dtype) {
+  PyObject* np = np_module();
+  if (!np) return nullptr;
+  int64_t count = 1;
+  for (int i = 0; i < ndim; ++i) count *= dims[i];
+  int64_t itemsize = std::strcmp(dtype, "float32") == 0 ? 4
+                     : std::strcmp(dtype, "int32") == 0 ? 4
+                     : std::strcmp(dtype, "int64") == 0 ? 8
+                                                        : 4;
+  PyObject* mv = PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)), count * itemsize,
+      PyBUF_READ);
+  if (!mv) {
+    capture_py_error();
+    return nullptr;
+  }
+  PyObject* flat = PyObject_CallMethod(np, "frombuffer", "Os", mv, dtype);
+  Py_DECREF(mv);
+  if (!flat) {
+    capture_py_error();
+    return nullptr;
+  }
+  PyObject* shape = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shape, i, PyLong_FromLongLong(dims[i]));
+  PyObject* shaped = PyObject_CallMethod(flat, "reshape", "O", shape);
+  Py_DECREF(flat);
+  Py_DECREF(shape);
+  if (!shaped) {
+    capture_py_error();
+    return nullptr;
+  }
+  PyObject* owned = PyObject_CallMethod(shaped, "copy", nullptr);
+  Py_DECREF(shaped);
+  if (!owned) capture_py_error();
+  return owned;
+}
+
+extern "C" {
+
+// ------------------------------------------------------------- lifecycle
+// Reference: flexflow_init / Legion Runtime::start (cpp_driver.cc:26-46).
+int flexflow_init() {
+  if (!Py_IsInitialized()) Py_InitializeEx(0);
+  return ff_module() != nullptr ? 0 : -1;
+}
+
+void flexflow_finalize() {
+  // Embedded JAX runtimes do not tear down cleanly mid-process; leave the
+  // interpreter up (reference keeps Legion up until process exit too).
+}
+
+// ------------------------------------------------------------- config
+// Reference: flexflow_config_create / parse_args (flexflow_c.cc).
+ff_handle* flexflow_config_create(int argc, char** argv) {
+  PyObject* mod = ff_module();
+  if (!mod) return nullptr;
+  PyObject* cfg = PyObject_CallMethod(mod, "FFConfig", nullptr);
+  if (!cfg) return wrap(nullptr);
+  if (argc > 0) {
+    PyObject* args = PyList_New(argc);
+    for (int i = 0; i < argc; ++i)
+      PyList_SET_ITEM(args, i, PyUnicode_FromString(argv[i]));
+    PyObject* rest = PyObject_CallMethod(cfg, "parse_args", "O", args);
+    Py_DECREF(args);
+    if (!rest) {
+      Py_DECREF(cfg);
+      return wrap(nullptr);
+    }
+    Py_DECREF(rest);
+  }
+  return wrap(cfg);
+}
+
+int flexflow_config_set_batch_size(ff_handle* cfg, int bs) {
+  PyObject* v = PyLong_FromLong(bs);
+  int rc = PyObject_SetAttrString(cfg->obj, "batch_size", v);
+  Py_DECREF(v);
+  if (rc != 0) capture_py_error();
+  return rc;
+}
+
+// ------------------------------------------------------------- model
+ff_handle* flexflow_model_create(ff_handle* cfg) {
+  PyObject* mod = ff_module();
+  if (!mod) return nullptr;
+  return wrap(PyObject_CallMethod(mod, "FFModel", "O", cfg->obj));
+}
+
+void flexflow_handle_destroy(ff_handle* h) {
+  if (h) {
+    Py_XDECREF(h->obj);
+    delete h;
+  }
+}
+
+// dtype: 0=float32 1=int32 int64=2 (reference DataType enum subset)
+ff_handle* flexflow_model_create_tensor(ff_handle* model, int ndim,
+                                        const int64_t* dims, int dtype,
+                                        const char* name) {
+  PyObject* shape = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shape, i, PyLong_FromLongLong(dims[i]));
+  PyObject* mod = ff_module();
+  PyObject* dt_cls = PyObject_GetAttrString(mod, "DataType");
+  const char* dt_name = dtype == 1 ? "INT32" : dtype == 2 ? "INT64" : "FLOAT";
+  PyObject* dt = PyObject_GetAttrString(dt_cls, dt_name);
+  Py_DECREF(dt_cls);
+  PyObject* t = PyObject_CallMethod(model->obj, "create_tensor", "OOs", shape,
+                                    dt, name);
+  Py_XDECREF(dt);
+  Py_DECREF(shape);
+  return wrap(t);
+}
+
+// activation: 0=none 1=relu 2=sigmoid 3=tanh 4=gelu (reference ActiMode)
+static PyObject* acti_mode(int activation) {
+  PyObject* mod = ff_module();
+  PyObject* cls = PyObject_GetAttrString(mod, "ActiMode");
+  const char* name = activation == 1   ? "RELU"
+                     : activation == 2 ? "SIGMOID"
+                     : activation == 3 ? "TANH"
+                     : activation == 4 ? "GELU"
+                                       : "NONE";
+  PyObject* v = PyObject_GetAttrString(cls, name);
+  Py_DECREF(cls);
+  return v;
+}
+
+ff_handle* flexflow_model_dense(ff_handle* model, ff_handle* input,
+                                int out_dim, int activation) {
+  PyObject* act = acti_mode(activation);
+  PyObject* t = PyObject_CallMethod(model->obj, "dense", "OiO", input->obj,
+                                    out_dim, act);
+  Py_XDECREF(act);
+  return wrap(t);
+}
+
+ff_handle* flexflow_model_conv2d(ff_handle* model, ff_handle* input,
+                                 int out_channels, int kh, int kw, int sh,
+                                 int sw, int ph, int pw, int activation) {
+  PyObject* act = acti_mode(activation);
+  PyObject* t = PyObject_CallMethod(model->obj, "conv2d", "OiiiiiiiO",
+                                    input->obj, out_channels, kh, kw, sh, sw,
+                                    ph, pw, act);
+  Py_XDECREF(act);
+  return wrap(t);
+}
+
+// pool_type: 0=max 1=avg
+ff_handle* flexflow_model_pool2d(ff_handle* model, ff_handle* input, int kh,
+                                 int kw, int sh, int sw, int ph, int pw,
+                                 int pool_type) {
+  PyObject* mod = ff_module();
+  PyObject* cls = PyObject_GetAttrString(mod, "PoolType");
+  PyObject* pt = PyObject_GetAttrString(cls, pool_type == 1 ? "AVG" : "MAX");
+  Py_DECREF(cls);
+  PyObject* t = PyObject_CallMethod(model->obj, "pool2d", "OiiiiiiO",
+                                    input->obj, kh, kw, sh, sw, ph, pw, pt);
+  Py_XDECREF(pt);
+  return wrap(t);
+}
+
+ff_handle* flexflow_model_flat(ff_handle* model, ff_handle* input) {
+  return wrap(PyObject_CallMethod(model->obj, "flat", "O", input->obj));
+}
+
+ff_handle* flexflow_model_relu(ff_handle* model, ff_handle* input) {
+  return wrap(PyObject_CallMethod(model->obj, "relu", "O", input->obj));
+}
+
+ff_handle* flexflow_model_softmax(ff_handle* model, ff_handle* input) {
+  return wrap(PyObject_CallMethod(model->obj, "softmax", "O", input->obj));
+}
+
+ff_handle* flexflow_model_add(ff_handle* model, ff_handle* a, ff_handle* b) {
+  return wrap(PyObject_CallMethod(model->obj, "add", "OO", a->obj, b->obj));
+}
+
+ff_handle* flexflow_model_concat(ff_handle* model, ff_handle** ins, int n,
+                                 int axis) {
+  PyObject* lst = PyList_New(n);
+  for (int i = 0; i < n; ++i) {
+    Py_INCREF(ins[i]->obj);
+    PyList_SET_ITEM(lst, i, ins[i]->obj);
+  }
+  PyObject* t = PyObject_CallMethod(model->obj, "concat", "Oi", lst, axis);
+  Py_DECREF(lst);
+  return wrap(t);
+}
+
+ff_handle* flexflow_model_embedding(ff_handle* model, ff_handle* input,
+                                    int num_entries, int out_dim) {
+  return wrap(PyObject_CallMethod(model->obj, "embedding", "Oii", input->obj,
+                                  num_entries, out_dim));
+}
+
+ff_handle* flexflow_model_dropout(ff_handle* model, ff_handle* input,
+                                  double rate) {
+  return wrap(
+      PyObject_CallMethod(model->obj, "dropout", "Od", input->obj, rate));
+}
+
+ff_handle* flexflow_model_multihead_attention(ff_handle* model, ff_handle* q,
+                                              ff_handle* k, ff_handle* v,
+                                              int embed_dim, int num_heads) {
+  return wrap(PyObject_CallMethod(model->obj, "multihead_attention", "OOOii",
+                                  q->obj, k->obj, v->obj, embed_dim,
+                                  num_heads));
+}
+
+// -------------------------------------------------------------- compile
+// loss: 0=sparse-cce 1=cce 2=mse-avg; optimizer: 0=SGD(lr) 1=Adam(lr)
+int flexflow_model_compile(ff_handle* model, int loss, int optimizer,
+                           double lr) {
+  PyObject* mod = ff_module();
+  PyObject* opt =
+      optimizer == 1
+          ? PyObject_CallMethod(mod, "AdamOptimizer", nullptr)
+          : PyObject_CallMethod(mod, "SGDOptimizer", nullptr);
+  if (!opt) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* lrv = PyFloat_FromDouble(lr);
+  PyObject_SetAttrString(opt, optimizer == 1 ? "alpha" : "lr", lrv);
+  Py_DECREF(lrv);
+  PyObject* loss_cls = PyObject_GetAttrString(mod, "LossType");
+  const char* lname = loss == 1   ? "CATEGORICAL_CROSSENTROPY"
+                      : loss == 2 ? "MEAN_SQUARED_ERROR_AVG_REDUCE"
+                                  : "SPARSE_CATEGORICAL_CROSSENTROPY";
+  PyObject* lt = PyObject_GetAttrString(loss_cls, lname);
+  Py_DECREF(loss_cls);
+  PyObject* m_cls = PyObject_GetAttrString(mod, "MetricsType");
+  PyObject* acc = PyObject_GetAttrString(m_cls, "ACCURACY");
+  Py_DECREF(m_cls);
+  PyObject* metrics = PyList_New(1);
+  PyList_SET_ITEM(metrics, 0, acc);
+  PyObject* kwargs = PyDict_New();
+  PyDict_SetItemString(kwargs, "optimizer", opt);
+  PyDict_SetItemString(kwargs, "loss_type", lt);
+  PyDict_SetItemString(kwargs, "metrics", metrics);
+  PyObject* meth = PyObject_GetAttrString(model->obj, "compile");
+  PyObject* empty = PyTuple_New(0);
+  PyObject* r = PyObject_Call(meth, empty, kwargs);
+  Py_DECREF(empty);
+  Py_DECREF(meth);
+  Py_DECREF(kwargs);
+  Py_DECREF(metrics);
+  Py_DECREF(lt);
+  Py_DECREF(opt);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+// ------------------------------------------------------------------ fit
+// Single float32 input + int32 labels (n, 1); returns accuracy in
+// *out_accuracy and throughput (samples/s) in *out_throughput.
+int flexflow_model_fit_f32(ff_handle* model, const float* x,
+                           const int64_t* xdims, int x_ndim, const int32_t* y,
+                           int epochs, double* out_accuracy,
+                           double* out_throughput) {
+  PyObject* xa = np_array_copy(x, xdims, x_ndim, "float32");
+  if (!xa) return -1;
+  int64_t ydims[2] = {xdims[0], 1};
+  PyObject* ya = np_array_copy(y, ydims, 2, "int32");
+  if (!ya) {
+    Py_DECREF(xa);
+    return -1;
+  }
+  PyObject* kwargs = PyDict_New();
+  PyObject* ep = PyLong_FromLong(epochs);
+  PyDict_SetItemString(kwargs, "epochs", ep);
+  Py_DECREF(ep);
+  PyDict_SetItemString(kwargs, "verbose", Py_False);
+  PyObject* meth = PyObject_GetAttrString(model->obj, "fit");
+  PyObject* args = PyTuple_Pack(2, xa, ya);
+  PyObject* pm = PyObject_Call(meth, args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(meth);
+  Py_DECREF(kwargs);
+  Py_DECREF(xa);
+  Py_DECREF(ya);
+  if (!pm) {
+    capture_py_error();
+    return -1;
+  }
+  if (out_accuracy) {
+    PyObject* acc = PyObject_GetAttrString(pm, "accuracy");
+    *out_accuracy = acc ? PyFloat_AsDouble(acc) : -1.0;
+    Py_XDECREF(acc);
+  }
+  if (out_throughput) {
+    PyObject* th = PyObject_CallMethod(pm, "throughput", nullptr);
+    *out_throughput = th ? PyFloat_AsDouble(th) : -1.0;
+    Py_XDECREF(th);
+  }
+  Py_DECREF(pm);
+  return 0;
+}
+
+// Forward one float32 batch; writes the flattened logits into out
+// (caller-sized out_len floats).  Returns number of floats written or -1.
+int64_t flexflow_model_eval_f32(ff_handle* model, const float* x,
+                                const int64_t* xdims, int x_ndim, float* out,
+                                int64_t out_len) {
+  PyObject* xa = np_array_copy(x, xdims, x_ndim, "float32");
+  if (!xa) return -1;
+  PyObject* lst = PyList_New(1);
+  PyList_SET_ITEM(lst, 0, xa);  // steals
+  PyObject* r = PyObject_CallMethod(model->obj, "eval_batch", "O", lst);
+  Py_DECREF(lst);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* np = np_module();
+  PyObject* arr = PyObject_CallMethod(np, "asarray", "Os", r, "float32");
+  Py_DECREF(r);
+  if (!arr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* flat = PyObject_CallMethod(arr, "ravel", nullptr);
+  Py_DECREF(arr);
+  PyObject* bytes = PyObject_CallMethod(flat, "tobytes", nullptr);
+  Py_DECREF(flat);
+  if (!bytes) {
+    capture_py_error();
+    return -1;
+  }
+  char* buf;
+  Py_ssize_t blen;
+  PyBytes_AsStringAndSize(bytes, &buf, &blen);
+  int64_t n = blen / (int64_t)sizeof(float);
+  if (n > out_len) n = out_len;
+  std::memcpy(out, buf, n * sizeof(float));
+  Py_DECREF(bytes);
+  return n;
+}
+
+int64_t flexflow_model_num_parameters(ff_handle* model) {
+  PyObject* n = PyObject_GetAttrString(model->obj, "num_parameters");
+  if (!n) {
+    capture_py_error();
+    return -1;
+  }
+  int64_t v = PyLong_AsLongLong(n);
+  Py_DECREF(n);
+  return v;
+}
+
+}  // extern "C"

@@ -1,0 +1,77 @@
+/* Flat C API over the TPU-native FFModel (R16).
+ *
+ * Reference: include/flexflow/flexflow_c.h (706 LoC) — the handle-based
+ * flexflow_* ABI.  See native/flexflow_c.cc for semantics and build line.
+ *
+ * Conventions: every object is an opaque ff_handle*; constructors return
+ * NULL on failure and flexflow_last_error() holds the message; int-returning
+ * calls use 0 = ok, -1 = error.
+ */
+#ifndef FLEXFLOW_C_H
+#define FLEXFLOW_C_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct ff_handle ff_handle;
+
+/* lifecycle (reference: Legion Runtime::start, cpp_driver.cc:26-46) */
+int flexflow_init(void);
+void flexflow_finalize(void);
+const char* flexflow_last_error(void);
+
+/* config (reference: flexflow_config_create / parse_args) */
+ff_handle* flexflow_config_create(int argc, char** argv);
+int flexflow_config_set_batch_size(ff_handle* cfg, int bs);
+
+/* model + tensors.  dtype: 0=float32 1=int32 2=int64 */
+ff_handle* flexflow_model_create(ff_handle* cfg);
+void flexflow_handle_destroy(ff_handle* h);
+ff_handle* flexflow_model_create_tensor(ff_handle* model, int ndim,
+                                        const int64_t* dims, int dtype,
+                                        const char* name);
+
+/* layers.  activation: 0=none 1=relu 2=sigmoid 3=tanh 4=gelu */
+ff_handle* flexflow_model_dense(ff_handle* model, ff_handle* input,
+                                int out_dim, int activation);
+ff_handle* flexflow_model_conv2d(ff_handle* model, ff_handle* input,
+                                 int out_channels, int kh, int kw, int sh,
+                                 int sw, int ph, int pw, int activation);
+ff_handle* flexflow_model_pool2d(ff_handle* model, ff_handle* input, int kh,
+                                 int kw, int sh, int sw, int ph, int pw,
+                                 int pool_type /*0=max 1=avg*/);
+ff_handle* flexflow_model_flat(ff_handle* model, ff_handle* input);
+ff_handle* flexflow_model_relu(ff_handle* model, ff_handle* input);
+ff_handle* flexflow_model_softmax(ff_handle* model, ff_handle* input);
+ff_handle* flexflow_model_add(ff_handle* model, ff_handle* a, ff_handle* b);
+ff_handle* flexflow_model_concat(ff_handle* model, ff_handle** ins, int n,
+                                 int axis);
+ff_handle* flexflow_model_embedding(ff_handle* model, ff_handle* input,
+                                    int num_entries, int out_dim);
+ff_handle* flexflow_model_dropout(ff_handle* model, ff_handle* input,
+                                  double rate);
+ff_handle* flexflow_model_multihead_attention(ff_handle* model, ff_handle* q,
+                                              ff_handle* k, ff_handle* v,
+                                              int embed_dim, int num_heads);
+
+/* compile.  loss: 0=sparse-cce 1=cce 2=mse-avg; optimizer: 0=SGD 1=Adam */
+int flexflow_model_compile(ff_handle* model, int loss, int optimizer,
+                           double lr);
+
+/* train / eval: single float32 input, int32 labels (xdims[0] samples) */
+int flexflow_model_fit_f32(ff_handle* model, const float* x,
+                           const int64_t* xdims, int x_ndim, const int32_t* y,
+                           int epochs, double* out_accuracy,
+                           double* out_throughput);
+int64_t flexflow_model_eval_f32(ff_handle* model, const float* x,
+                                const int64_t* xdims, int x_ndim, float* out,
+                                int64_t out_len);
+int64_t flexflow_model_num_parameters(ff_handle* model);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* FLEXFLOW_C_H */

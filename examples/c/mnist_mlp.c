@@ -29,11 +29,15 @@ int main(int argc, char** argv) {
   }
   flexflow_config_set_batch_size(cfg, 64);
   ff_handle* model = flexflow_model_create(cfg);
+  if (!model) {
+    fprintf(stderr, "model create failed: %s\n", flexflow_last_error());
+    return 1;
+  }
   int64_t dims[2] = {64, D};
   ff_handle* t = flexflow_model_create_tensor(model, 2, dims, 0, "features");
-  t = flexflow_model_dense(model, t, 128, 1 /*relu*/);
-  t = flexflow_model_dense(model, t, CLASSES, 0);
-  t = flexflow_model_softmax(model, t);
+  if (t) t = flexflow_model_dense(model, t, 128, 1 /*relu*/);
+  if (t) t = flexflow_model_dense(model, t, CLASSES, 0);
+  if (t) t = flexflow_model_softmax(model, t);
   if (!t) {
     fprintf(stderr, "build failed: %s\n", flexflow_last_error());
     return 1;

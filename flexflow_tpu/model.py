@@ -893,7 +893,7 @@ class FFModel:
                 assert bucket is not None, f"unknown weight {lname}/{wname}"
                 cur = bucket[lname][wname]
                 bucket[lname][wname] = jax.device_put(
-                    np.asarray(arr, dtype=np.asarray(cur).dtype), cur.sharding
+                    np.asarray(arr, dtype=cur.dtype), cur.sharding
                 )
 
     @property

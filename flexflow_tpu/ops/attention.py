@@ -182,10 +182,12 @@ class MultiHeadAttention(OpDef):
 
 # Above this many bytes of materialized (b, h, sq, sk) score matrix the
 # O(S^2) sdpa path becomes memory-prohibitive and flash pays; below it,
-# XLA's fused attention measured ~2x faster than the Pallas kernel on v5e
-# (BERT-Base s=512: 43 vs 85 ms/step; s=2048: 419 vs 907) — so dispatch is
-# by memory need, not by default.
-_FLASH_SCORE_BYTES_THRESHOLD = float(2 * (1 << 30))
+# XLA's fused attention measured consistently faster than the Pallas
+# kernel on v5e (BERT-Base s=512: 43 vs 85 ms/step; fwd-only s=4096:
+# 17 vs 77 ms) — so dispatch is by memory need, not by default.  ~4 GiB
+# of f32 scores (plus the bf16 copy XLA keeps) approaches half of v5e's
+# 16 GB HBM once weights/activations are accounted.
+_FLASH_SCORE_BYTES_THRESHOLD = float(4 * (1 << 30))
 
 
 def _flash_ok(sq: int, sk: int, d: int, bh_local: int = 1) -> bool:

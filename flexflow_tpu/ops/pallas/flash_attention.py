@@ -14,12 +14,11 @@ Replaces the reference's cuDNN attention core
   training memory stays O(seq) too (round-1 verdict: the old backward
   recomputed the full matrix via jnp).
 
-Head-dim handling: the MXU lane width is 128; head dims that are not a
-multiple of 128 (BERT: 64) are zero-padded to the next multiple inside
-the wrapper.  Zero lanes contribute nothing to Q·K^T or P·V and the
-softmax scale uses the true head dim, so results are exact, and the
-padded matmuls run at full lane utilization (a d=64 dot would idle half
-the lanes anyway).
+Head-dim handling: power-of-two head dims >= 8 (BERT: 64) pass through
+unpadded — Mosaic accepts a block whose last dim equals the array dim,
+and padding d=64 to 128 would double the P·V work.  Other head dims are
+zero-padded to the 128-lane grid (exact: zero lanes contribute nothing
+and the softmax scale uses the true head dim).
 
 Dropout runs *inside* the kernels with a counter-based hash keyed on
 (seed, batch*head, q position, k position) — forward and backward
@@ -36,6 +35,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
@@ -73,35 +73,52 @@ def _positions(q_start, k_start, block_q, block_k):
 
 # ------------------------------------------------------------- forward
 def _fwd_kernel(
-    seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-    *, block_k: int, sq: int, sk: int, causal: bool, sm_scale: float,
+    seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, n_kb: int, sq: int, sk: int, causal: bool, sm_scale: float,
     dropout_rate: float,
 ):
+    """Grid (bh, n_q, n_kb): K/V blocks arrive via BlockSpec indexing so
+    Mosaic double-buffers the HBM->VMEM streams across the (sequential)
+    kb dimension; the online-softmax state lives in VMEM scratch and the
+    output is finalized on the last kb step.  This replaces the old
+    one-big-K/V-block + fori_loop form, which serialized all K/V traffic
+    before compute."""
     block_q, d = q_ref.shape
-    q_idx = pl.program_id(1)
+    block_k = k_ref.shape[0]
     bh = pl.program_id(0)
-    q = q_ref[:].astype(jnp.float32) * sm_scale
+    q_idx = pl.program_id(1)
+    kb = pl.program_id(2)
 
-    n_kb = sk // block_k
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros(acc_ref.shape, jnp.float32)
+        m_ref[:] = jnp.full(m_ref.shape, NEG_INF, jnp.float32)
+        l_ref[:] = jnp.zeros(l_ref.shape, jnp.float32)
+
+    # causal: blocks entirely above the diagonal contribute nothing
+    run = True
     if causal:
-        last_k = (q_idx + 1) * block_q + (sk - sq)
-        n_kb_eff = jnp.minimum(n_kb, (last_k + block_k - 1) // block_k)
-    else:
-        n_kb_eff = n_kb
+        first_q_pos = q_idx * block_q + (sk - sq)
+        run = kb * block_k <= first_q_pos + block_q - 1
 
-    def body(kb, carry):
-        acc, m_prev, l_prev = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :]
-        v = v_ref[pl.ds(kb * block_k, block_k), :]
-        s = jnp.dot(q, k.T.astype(jnp.float32), preferred_element_type=jnp.float32)
+    @pl.when(run)
+    def _step():
+        # matmul inputs stay in the native (bf16) dtype — f32 MXU dots are
+        # several times slower; accumulation is f32 via
+        # preferred_element_type, and the scale applies to the f32 scores
+        s = jnp.dot(
+            q_ref[:], k_ref[:].T, preferred_element_type=jnp.float32
+        ) * sm_scale
         q_pos, k_pos = _positions(q_idx * block_q, kb * block_k, block_q, block_k)
         if causal:
             s = jnp.where(q_pos + (sk - sq) >= k_pos, s, NEG_INF)
-        m_cur = jnp.max(s, axis=1)
+        m_prev = m_ref[:, :1]  # (block_q, 1)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         if dropout_rate > 0.0:
             u = _uniform01(seed_ref[0, 0].astype(jnp.uint32),
                            jnp.uint32(bh), q_pos, k_pos)
@@ -109,21 +126,21 @@ def _fwd_kernel(
             p_eff = jnp.where(u >= dropout_rate, p / keep, 0.0)
         else:
             p_eff = p
-        acc = acc * alpha[:, None] + jnp.dot(
-            p_eff.astype(v.dtype), v, preferred_element_type=jnp.float32
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+            p_eff.astype(v_ref.dtype), v_ref[:], preferred_element_type=jnp.float32
         )
-        return (acc, m_new, l_new)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, n_kb_eff, body, (acc0, m0, l0))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # lse block spans all n_q rows (a (1, block_q) block violates the TPU
-    # sublane rule: penultimate block dim must divide 8 or equal the array
-    # dim); each grid step writes only its own row
-    lse_ref[pl.ds(q_idx, 1), :] = (m + jnp.log(l_safe))[None, :]
+    @pl.when(kb == n_kb - 1)
+    def _fin():
+        l_safe = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[:] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        # lse block spans all n_q rows (a (1, block_q) block violates the
+        # TPU sublane rule); each program writes only its own row
+        lse_ref[pl.ds(q_idx, 1), :] = (
+            m_ref[:, :1] + jnp.log(l_safe)
+        ).reshape(1, block_q)
 
 
 def _flash_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k):
@@ -131,34 +148,44 @@ def _flash_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k):
     sk = k.shape[2]
     sm_scale = 1.0 / math.sqrt(d)
     n_q = sq // block_q
+    n_kb = sk // block_k
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
     seed_arr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
 
     kernel = functools.partial(
-        _fwd_kernel, block_k=block_k, sq=sq, sk=sk, causal=causal,
+        _fwd_kernel, n_kb=n_kb, sq=sq, sk=sk, causal=causal,
         sm_scale=sm_scale, dropout_rate=dropout_rate,
     )
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, n_q),
+        grid=(b * h, n_q, n_kb),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda bh, qi: (0, 0)),
-            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, 1), lambda bh, qi, kb: (0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            # full n_q rows per block: constant index map keeps the block
-            # live in VMEM across the qi loop; kernel writes row qi only
-            pl.BlockSpec((None, n_q, block_q), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((None, n_q, block_q), lambda bh, qi, kb: (bh, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, n_q, block_q), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        # qi must stay sequential: the lse output block is shared across
+        # qi programs (constant index map), so parallel qi on a megacore
+        # part would clobber rows across cores
+        compiler_params=None if INTERPRET else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")
+        ),
         interpret=INTERPRET,
     )(seed_arr, qf, kf, vf)
     return out.reshape(b, h, sq, d), lse
@@ -166,99 +193,118 @@ def _flash_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k):
 
 # ------------------------------------------------------------ backward
 def _dq_kernel(
-    seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, block_k: int, sq: int, sk: int, causal: bool, sm_scale: float,
+    seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
+    *, n_kb: int, sq: int, sk: int, causal: bool, sm_scale: float,
     dropout_rate: float,
 ):
+    """Grid (bh, n_q, n_kb): K/V stream through BlockSpec-indexed blocks
+    (pipelined); dq accumulates in VMEM scratch, written out on the last
+    kb step."""
     block_q, d = q_ref.shape
-    q_idx = pl.program_id(1)
+    block_k = k_ref.shape[0]
     bh = pl.program_id(0)
-    q = q_ref[:].astype(jnp.float32) * sm_scale
-    do = do_ref[:].astype(jnp.float32)
-    # lse/delta blocks span all n_q rows (TPU sublane rule); take this
-    # program's row
-    lse = lse_ref[pl.ds(q_idx, 1), :].reshape(block_q)
-    delta = delta_ref[pl.ds(q_idx, 1), :].reshape(block_q)
+    q_idx = pl.program_id(1)
+    kb = pl.program_id(2)
 
-    n_kb = sk // block_k
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    run = True
     if causal:
-        last_k = (q_idx + 1) * block_q + (sk - sq)
-        n_kb_eff = jnp.minimum(n_kb, (last_k + block_k - 1) // block_k)
-    else:
-        n_kb_eff = n_kb
+        run = kb * block_k <= q_idx * block_q + (sk - sq) + block_q - 1
 
-    def body(kb, dq):
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+    @pl.when(run)
+    def _step():
+        lse = lse_ref[pl.ds(q_idx, 1), :].reshape(block_q, 1)
+        delta = delta_ref[pl.ds(q_idx, 1), :].reshape(block_q, 1)
+        # native-dtype matmul inputs, f32 accumulation (see _fwd_kernel)
+        s = jnp.dot(
+            q_ref[:], k_ref[:].T, preferred_element_type=jnp.float32
+        ) * sm_scale
         q_pos, k_pos = _positions(q_idx * block_q, kb * block_k, block_q, block_k)
         if causal:
             s = jnp.where(q_pos + (sk - sq) >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do_ref[:], v_ref[:].T, preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
             u = _uniform01(seed_ref[0, 0].astype(jnp.uint32),
                            jnp.uint32(bh), q_pos, k_pos)
             keep = jnp.float32(1.0 - dropout_rate)
             dp = jnp.where(u >= dropout_rate, dp / keep, 0.0)
-        ds = p * (dp - delta[:, None])
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        acc_ref[:] = acc_ref[:] + jnp.dot(
+            ds.astype(k_ref.dtype), k_ref[:], preferred_element_type=jnp.float32
+        )
 
-    dq = jax.lax.fori_loop(0, n_kb_eff, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[:] = (dq * sm_scale).astype(dq_ref.dtype)
+    @pl.when(kb == n_kb - 1)
+    def _fin():
+        dq_ref[:] = (acc_ref[:] * sm_scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(
     seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, block_q: int, sq: int, sk: int, causal: bool, sm_scale: float,
+    dk_acc, dv_acc,
+    *, n_qb: int, sq: int, sk: int, causal: bool, sm_scale: float,
     dropout_rate: float,
 ):
-    block_k, d = k_ref.shape
-    k_idx = pl.program_id(1)
+    """Grid (bh, n_k, n_qb): Q/dO stream through BlockSpec-indexed blocks;
+    dk/dv accumulate in VMEM scratch."""
+    block_q, d = q_ref.shape
+    block_k = k_ref.shape[0]
     bh = pl.program_id(0)
-    k = k_ref[:].astype(jnp.float32)
-    v = v_ref[:].astype(jnp.float32)
+    k_idx = pl.program_id(1)
+    qb = pl.program_id(2)
 
-    n_qb = sq // block_q
+    @pl.when(qb == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros(dk_acc.shape, jnp.float32)
+        dv_acc[:] = jnp.zeros(dv_acc.shape, jnp.float32)
+
+    run = True
     if causal:
-        # first q block whose last row can see this k block's first key:
-        # q_pos + (sk - sq) >= k_pos  =>  q_pos >= k_idx*block_k - (sk - sq)
-        first_q = jnp.maximum(0, (k_idx * block_k - (sk - sq)) // block_q)
-    else:
-        first_q = 0
+        # last row of this q block must be able to see this k block's
+        # first key: q_pos + (sk - sq) >= k_pos
+        run = (qb + 1) * block_q - 1 + (sk - sq) >= k_idx * block_k
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32) * sm_scale
-        do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(qb, 1), :].reshape(block_q)
-        delta = delta_ref[pl.ds(qb, 1), :].reshape(block_q)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+    @pl.when(run)
+    def _step():
+        lse = lse_ref[pl.ds(qb, 1), :].reshape(block_q, 1)
+        delta = delta_ref[pl.ds(qb, 1), :].reshape(block_q, 1)
+        # native-dtype matmul inputs, f32 accumulation (see _fwd_kernel)
+        s = jnp.dot(
+            q_ref[:], k_ref[:].T, preferred_element_type=jnp.float32
+        ) * sm_scale
         q_pos, k_pos = _positions(qb * block_q, k_idx * block_k, block_q, block_k)
         if causal:
             s = jnp.where(q_pos + (sk - sq) >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         if dropout_rate > 0.0:
             u = _uniform01(seed_ref[0, 0].astype(jnp.uint32),
                            jnp.uint32(bh), q_pos, k_pos)
             keep = jnp.float32(1.0 - dropout_rate)
             keep_mask = (u >= dropout_rate).astype(jnp.float32) / keep
             p_eff = p * keep_mask
-            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32) * keep_mask
+            dp = jnp.dot(
+                do_ref[:], v_ref[:].T, preferred_element_type=jnp.float32
+            ) * keep_mask
         else:
             p_eff = p
-            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        dv = dv + jnp.dot(p_eff.T, do, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        return (dk, dv)
+            dp = jnp.dot(do_ref[:], v_ref[:].T, preferred_element_type=jnp.float32)
+        dv_acc[:] = dv_acc[:] + jnp.dot(
+            p_eff.T.astype(do_ref.dtype), do_ref[:],
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dk_acc[:] = dk_acc[:] + jnp.dot(
+            ds.T.astype(q_ref.dtype), q_ref[:], preferred_element_type=jnp.float32
+        )
 
-    z = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(first_q, n_qb, body, (z, z))
-    # no extra sm_scale here: q was loaded pre-scaled, so ds^T @ q already
-    # carries it (dL/dk = ds^T @ (q * scale))
-    dk_ref[:] = dk.astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    @pl.when(qb == n_qb - 1)
+    def _fin():
+        # s carried sm_scale, so dL/dk needs it too
+        dk_ref[:] = (dk_acc[:] * sm_scale).astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _flash_bwd(q, k, v, out, lse, do, seed, causal, dropout_rate, block_q, block_k):
@@ -266,6 +312,7 @@ def _flash_bwd(q, k, v, out, lse, do, seed, causal, dropout_rate, block_q, block
     sk = k.shape[2]
     sm_scale = 1.0 / math.sqrt(d)
     n_q = sq // block_q
+    n_k = sk // block_k
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
@@ -280,43 +327,53 @@ def _flash_bwd(q, k, v, out, lse, do, seed, causal, dropout_rate, block_q, block
     common = dict(sq=sq, sk=sk, causal=causal, sm_scale=sm_scale,
                   dropout_rate=dropout_rate)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block_k=block_k, **common),
-        grid=(b * h, n_q),
+        functools.partial(_dq_kernel, n_kb=n_k, **common),
+        grid=(b * h, n_q, n_k),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda bh, qi: (0, 0)),
-            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, n_q, block_q), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, n_q, block_q), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, 1), lambda bh, qi, kb: (0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((None, n_q, block_q), lambda bh, qi, kb: (bh, 0, 0)),
+            pl.BlockSpec((None, n_q, block_q), lambda bh, qi, kb: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=None if INTERPRET else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=INTERPRET,
     )(seed_arr, qf, kf, vf, dof, lse, delta)
 
-    n_k = sk // block_k
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=block_q, **common),
-        grid=(b * h, n_k),
+        functools.partial(_dkv_kernel, n_qb=n_q, **common),
+        grid=(b * h, n_k, n_q),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda bh, ki: (0, 0)),
-            pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((None, n_q, block_q), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((None, n_q, block_q), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1), lambda bh, ki, qb: (0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, ki, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, ki, qb: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, ki, qb: (bh, ki, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, ki, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, n_q, block_q), lambda bh, ki, qb: (bh, 0, 0)),
+            pl.BlockSpec((None, n_q, block_q), lambda bh, ki, qb: (bh, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, ki, qb: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, ki, qb: (bh, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=None if INTERPRET else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=INTERPRET,
     )(seed_arr, qf, kf, vf, dof, lse, delta)
     return (
@@ -365,14 +422,20 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
 ):
-    """(B, H, S, D) attention; S must divide the block sizes.  Head dims
-    off the 128-lane grid are zero-padded (exact — scale uses true D)."""
+    """(B, H, S, D) attention; S must divide the block sizes.  Power-of-two
+    head dims >= 8 (BERT: 64) go through unpadded — Mosaic accepts a block
+    whose last dim equals the array dim, and padding d=64 to 128 would
+    DOUBLE the p@v work for zero gain.  Other head dims are zero-padded to
+    the 128-lane grid (exact: scale uses the true D)."""
     d = q.shape[-1]
-    sm_fix = math.sqrt(((d + 127) // 128 * 128) / d)
-    d_pad = (d + 127) // 128 * 128
+    if d % 128 == 0 or d in (64, 32, 16, 8):
+        d_pad = d
+    else:
+        d_pad = (d + 127) // 128 * 128
     if d_pad != d:
         # kernel scales by 1/sqrt(d_pad); pre-scale q so the effective
         # scale is 1/sqrt(d)
+        sm_fix = math.sqrt(d_pad / d)
         q = _pad_d(q * jnp.asarray(sm_fix, q.dtype), d_pad)
         k = _pad_d(k, d_pad)
         v = _pad_d(v, d_pad)

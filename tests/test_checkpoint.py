@@ -1,0 +1,90 @@
+"""Checkpoint/resume: params + BN stats + optimizer state + step count.
+
+Exceeds the reference (weights-only tensor attach,
+``parallel_tensor.h:164-169``; SURVEY §5 notes "No optimizer-state
+checkpointing"): a resumed run must continue the EXACT loss trajectory,
+including Adam moments and the per-step RNG stream.
+"""
+
+import numpy as np
+
+from flexflow_tpu import (
+    ActiMode,
+    AdamOptimizer,
+    FFConfig,
+    FFModel,
+    LossType,
+    MachineMesh,
+    MetricsType,
+)
+
+B, D, C = 32, 16, 10
+
+
+def _build(mesh=None):
+    cfg = FFConfig(batch_size=B, learning_rate=0.05)
+    model = FFModel(cfg)
+    t = model.create_tensor((B, D))
+    t = model.dense(t, 64, ActiMode.RELU)
+    # BN is NCHW — route through a 4D view so the checkpoint covers
+    # stateful running stats too
+    t = model.reshape(t, (B, 64, 1, 1))
+    t = model.batch_norm(t, relu=False)
+    t = model.reshape(t, (B, 64))
+    t = model.dense(t, C)
+    model.softmax(t)
+    model.compile(
+        optimizer=AdamOptimizer(alpha=1e-2),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+        mesh=mesh or MachineMesh((1, 1), ("data", "model")),
+        seed=0,
+    )
+    return model
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    return (
+        rng.normal(size=(B, D)).astype(np.float32),
+        rng.integers(0, C, size=(B, 1)).astype(np.int32),
+    )
+
+
+def test_resume_continues_exact_trajectory(tmp_path):
+    x, y = _data()
+    ckpt = str(tmp_path / "ck.npz")
+
+    # uninterrupted run: 6 steps
+    ref = _build()
+    ref_losses = [float(ref.executor.train_step([x], y)[0]) for _ in range(6)]
+
+    # interrupted run: 3 steps, checkpoint, fresh model, load, 3 more
+    m1 = _build()
+    for _ in range(3):
+        m1.executor.train_step([x], y)
+    m1.save_checkpoint(ckpt)
+
+    m2 = _build()  # fresh init — different weights until load
+    m2.load_checkpoint(ckpt)
+    assert m2.executor._step_count == 3  # rng stream resumes too
+    resumed = [float(m2.executor.train_step([x], y)[0]) for _ in range(3)]
+    np.testing.assert_allclose(resumed, ref_losses[3:], rtol=1e-6, atol=1e-7)
+
+
+def test_checkpoint_resharding(tmp_path):
+    """A checkpoint written single-device loads onto an 8-way DP mesh."""
+    x, y = _data()
+    ckpt = str(tmp_path / "ck.npz")
+    m1 = _build()
+    for _ in range(3):
+        m1.executor.train_step([x], y)
+    m1.save_checkpoint(ckpt)
+
+    m2 = _build(mesh=MachineMesh((8, 1), ("data", "model")))
+    m2.load_checkpoint(ckpt)
+    # forward outputs must match exactly after the cross-mesh load
+    np.testing.assert_allclose(
+        np.asarray(m1.eval_batch([x])), np.asarray(m2.eval_batch([x])),
+        rtol=1e-5, atol=1e-6,
+    )

@@ -129,6 +129,27 @@ def test_bundled_rules_load():
     assert "partition_two_branch_add" in names and "megatron_mlp_block" in names
 
 
+def test_substitutions_to_dot_tool():
+    """S8 tooling: the rule visualizer renders the bundled set."""
+    import importlib.util
+    import os
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "subst_dot", os.path.join(here, "tools", "substitutions_to_dot.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    doc = json.load(open(os.path.join(
+        here, "flexflow_tpu", "search", "substitutions.json"
+    )))
+    dot = mod.rules_to_dot(doc)
+    assert dot.startswith("digraph")
+    assert "partition_two_branch_add" in dot
+    # the DAG rule's two roots both feed the add (indices 0,1 -> 2)
+    assert "r1n0 -> r1n2;" in dot and "r1n1 -> r1n2;" in dot
+
+
 def test_compile_with_substitution_json(tmp_path):
     """--substitution-json default flows through compile()'s search."""
     model = _two_branch_model()

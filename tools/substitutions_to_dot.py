@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Render substitution rules as graphviz dot (S8 tooling parity).
+
+Reference: ``tools/substitutions_to_dot`` — visualizes the TASO-style rule
+file so rule authors can eyeball pattern wiring.  Here a rule is a DAG
+pattern + per-node target-sharding selector (see
+``flexflow_tpu/search/substitution.py``); each rule renders as a cluster
+with its ``deps`` edges and the selector annotated on each node.
+
+Usage:
+    python tools/substitutions_to_dot.py [rules.json] [out.dot]
+Defaults to the bundled rule set and stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def rules_to_dot(doc: dict) -> str:
+    lines = ["digraph substitutions {", "  rankdir=TB;", "  node [shape=box, fontsize=10];"]
+    for r, rule in enumerate(doc["rules"]):
+        name = rule["name"]
+        lines.append(f"  subgraph cluster_{r} {{")
+        lines.append(f'    label="{name}";')
+        for i, (p, sel) in enumerate(zip(rule["pattern"], rule["select"])):
+            sel_txt = sel if sel is not None else "(keep)"
+            lines.append(f'    r{r}n{i} [label="{p["op"]}\\n-> {sel_txt}"];')
+        for i, p in enumerate(rule["pattern"]):
+            deps = p.get("deps")
+            if deps is None and i > 0:
+                deps = [i - 1]  # legacy chain default
+            for d in deps or []:
+                lines.append(f"    r{r}n{d} -> r{r}n{i};")
+        lines.append("  }")
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv) -> int:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = argv[1] if len(argv) > 1 else os.path.join(
+        here, "flexflow_tpu", "search", "substitutions.json"
+    )
+    with open(path) as f:
+        doc = json.load(f)
+    out = rules_to_dot(doc)
+    if len(argv) > 2:
+        with open(argv[2], "w") as f:
+            f.write(out)
+    else:
+        sys.stdout.write(out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -77,6 +77,10 @@ class FFConfig:
     node_id: Optional[int] = None  # this process's index
     dcn_axis: str = "data"  # mesh axis that spans hosts
     compute_dtype: str = "float32"  # params/compute dtype; "bfloat16" for perf
+    # ZeRO-1: shard optimizer moments over the data axis (memory /dp at the
+    # cost of an all-gathered param delta per step).  Beyond the reference,
+    # whose optimizer state is replicated per device (optimizer_kernel.cu).
+    enable_zero1: bool = False
     rng_seed: int = 0
     memory_search_budget: int = -1  # lambda search iterations (graph.cc:2075)
     device_memory_gb: float = -1.0  # per-device HBM budget for λ mem search
@@ -168,6 +172,8 @@ class FFConfig:
                 self.mesh_shape = tuple(int(x) for x in take().split("x"))
             elif a == "--dtype":
                 self.compute_dtype = take()
+            elif a == "--zero1":
+                self.enable_zero1 = True
             elif a == "--seed":
                 self.rng_seed = int(take())
             elif a == "--device-memory-gb":

@@ -700,6 +700,7 @@ class FFModel:
             seed=seed if seed is not None else cfg.rng_seed,
             compute_dtype=cfg.compute_dtype,
             dcn_axis=cfg.dcn_axis,
+            zero1=cfg.enable_zero1,
         )
         self.executor.init_params()
 

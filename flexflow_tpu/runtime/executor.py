@@ -65,6 +65,7 @@ class Executor:
         use_remat: bool = False,
         compute_dtype: str = "float32",
         dcn_axis: str = "data",
+        zero1: bool = False,
     ) -> None:
         self.layers = layers
         self.graph_inputs = graph_inputs
@@ -78,6 +79,11 @@ class Executor:
         self.use_remat = use_remat
         self.compute_dtype = jnp.dtype(compute_dtype)
         self._mixed = self.compute_dtype != jnp.float32
+        # ZeRO-1: optimizer moments sharded over the data axis (memory /dp);
+        # GSPMD turns the update into slice-update + all-gather of the
+        # param delta — a capability the reference lacks entirely (its
+        # optimizer state is replicated per GPU, optimizer_kernel.cu)
+        self.zero1 = zero1 and strategy.mesh.axis_size("data") > 1
 
         self.mesh: Optional[Mesh] = None
         if strategy.mesh.size > 1:
@@ -272,6 +278,50 @@ class Executor:
         self.params = params
         self.state = state
         self.opt_state = self.optimizer.init_state(params)
+        if self.zero1:
+            self._zero1_specs = jax.tree.map(self._zero1_pspec, self.opt_state)
+            self.opt_state = jax.tree.map(
+                self._zero1_place, self.opt_state, self._zero1_specs
+            )
+
+    # --- ZeRO-1 helpers ----------------------------------------------------
+    def _zero1_pspec(self, x) -> Optional[PartitionSpec]:
+        """Merged sharding spec for one moment leaf: keep whatever sharding
+        it inherited from its param (e.g. a TP 'model' axis — discarding it
+        would INCREASE memory) and add 'data' to the first unsharded dim it
+        divides.  Computed once at init from concrete arrays; reused as a
+        constraint inside the jitted step (tracers carry no sharding)."""
+        dp = self.strategy.mesh.axis_size("data")
+        if not hasattr(x, "ndim") or x.ndim < 1:
+            return None
+        cur = getattr(x, "sharding", None)
+        spec: List = (
+            list(cur.spec) if isinstance(cur, NamedSharding) else []
+        )
+        spec += [None] * (x.ndim - len(spec))
+        used = {
+            a
+            for e in spec
+            if e
+            for a in ((e,) if isinstance(e, str) else tuple(e))
+        }
+        if "data" in used:
+            return None  # already data-sharded somewhere
+        for i in range(x.ndim):
+            if spec[i] is None and x.shape[i] % dp == 0:
+                spec[i] = "data"
+                return PartitionSpec(*spec)
+        return None
+
+    def _zero1_place(self, x, ps):
+        if ps is None or self.mesh is None:
+            return x
+        return jax.device_put(x, NamedSharding(self.mesh, ps))
+
+    def _zero1_constrain(self, x, ps):
+        if ps is None:
+            return x
+        return self._constrain(x, ps)
 
     # --- step building -----------------------------------------------------
     def _build_step(self):
@@ -290,6 +340,12 @@ class Executor:
                 objective, has_aux=True
             )(params)
             new_params, new_opt = self.optimizer.update(params, grads, opt_state)
+            if self.zero1:
+                # keep moments sharded in steady state; GSPMD then updates
+                # each device's shard and all-gathers only the param delta
+                new_opt = jax.tree.map(
+                    self._zero1_constrain, new_opt, self._zero1_specs
+                )
             m = metrics.compute(logits, labels) if metrics else {}
             return new_params, new_state, new_opt, loss, m
 

@@ -29,11 +29,11 @@ def _t(x):
     return torch.tensor(np.asarray(x, np.float64), requires_grad=True)
 
 
-def _sgd_step(params, loss):
+def _sgd_step(params, loss, lr=LR):
     grads = torch.autograd.grad(loss, params)
     with torch.no_grad():
         for p, g in zip(params, grads):
-            p -= LR * g
+            p -= lr * g
 
 
 def test_mlp_loss_curve_matches_torch():
@@ -72,6 +72,65 @@ def test_mlp_loss_curve_matches_torch():
         _sgd_step([k1, b1, k2, b2], tl)
 
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=1e-5)
+    assert theirs[-1] < theirs[0], "torch oracle did not learn"
+
+
+def test_cnn_loss_curve_matches_torch():
+    """conv + BN(+relu) + maxpool + dense trained against the torch oracle
+    (reference align suite covers conv2d/pool2d/bn the same way)."""
+    B, CH, HW, C = 16, 3, 16, 10
+    lr = 0.01  # 0.05 diverges for this CNN (identically in both frameworks)
+    from flexflow_tpu.fftype import PoolType
+
+    cfg = FFConfig(batch_size=B)
+    model = FFModel(cfg)
+    t = model.create_tensor((B, CH, HW, HW), name="img")
+    t = model.conv2d(t, 8, 3, 3, 1, 1, 1, 1, name="conv1")
+    t = model.batch_norm(t, relu=True, name="bn1")
+    t = model.pool2d(t, 2, 2, 2, 2, 0, 0, PoolType.MAX, name="pool1")
+    t = model.flat(t, name="flat")
+    t = model.dense(t, C, name="head")
+    model.softmax(t, name="probs")
+    model.compile(
+        optimizer=SGDOptimizer(lr=lr),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        mesh=MachineMesh((1, 1), ("data", "model")),
+        seed=0,
+    )
+    p = model.executor.params
+    # conv kernel HWIO -> torch OIHW
+    ck = _t(np.transpose(np.asarray(p["conv1"]["kernel"], np.float64), (3, 2, 0, 1)))
+    cb = _t(p["conv1"]["bias"])
+    g, b = _t(p["bn1"]["scale"]), _t(p["bn1"]["bias"])
+    hk, hb = _t(p["head"]["kernel"]), _t(p["head"]["bias"])
+    params = [ck, cb, g, b, hk, hb]
+
+    def torch_fwd(x):
+        y = F.conv2d(x, ck, cb, padding=1)
+        # training-mode BN: batch statistics (biased var), then fused relu
+        mean = y.mean(dim=(0, 2, 3))
+        var = y.var(dim=(0, 2, 3), unbiased=False)
+        y = (y - mean.view(1, -1, 1, 1)) / torch.sqrt(var.view(1, -1, 1, 1) + 1e-5)
+        y = torch.relu(y * g.view(1, -1, 1, 1) + b.view(1, -1, 1, 1))
+        y = F.max_pool2d(y, 2, 2)
+        return y.reshape(B, -1) @ hk + hb
+
+    rng = np.random.default_rng(2)
+    xs = [rng.normal(size=(B, CH, HW, HW)).astype(np.float32) for _ in range(STEPS)]
+    ys = [rng.integers(0, C, size=(B, 1)).astype(np.int32) for _ in range(STEPS)]
+
+    ours, theirs = [], []
+    for x, y in zip(xs, ys):
+        loss, _ = model.executor.train_step([x], y)
+        ours.append(float(loss))
+        xt = torch.tensor(np.asarray(x, np.float64))
+        yt = torch.tensor(y.reshape(-1).astype(np.int64))
+        tl = F.cross_entropy(torch_fwd(xt), yt)
+        theirs.append(float(tl.detach()))
+        _sgd_step(params, tl, lr)
+
+    np.testing.assert_allclose(ours, theirs, rtol=5e-4, atol=5e-5)
     assert theirs[-1] < theirs[0], "torch oracle did not learn"
 
 

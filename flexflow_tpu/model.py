@@ -752,7 +752,11 @@ class FFModel:
         shape) survived."""
         assert self.executor is not None, "call compile() first"
         snapshot = self.get_weights() if preserve_weights else None
-        old_opt = jax.tree.map(np.asarray, self.executor.opt_state) if preserve_weights else None
+        old_opt = (
+            jax.tree.map(self._to_numpy, self.executor.opt_state)
+            if preserve_weights
+            else None
+        )
         self.compile(**self._compile_call)
         if snapshot is None:
             return
@@ -897,6 +901,17 @@ class FFModel:
                     np.asarray(arr, dtype=cur.dtype), cur.sharding
                 )
 
+    @staticmethod
+    def _to_numpy(x) -> np.ndarray:
+        """Host copy that also works for process-sharded arrays (ZeRO-1
+        moments on a multi-host mesh are not fully addressable; gather
+        before converting)."""
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(x)
+
     # ----------------------------------------------- checkpoint / resume
     def save_checkpoint(self, path: str) -> None:
         """Full training checkpoint: params + stateful weights (BN stats)
@@ -914,7 +929,7 @@ class FFModel:
         def put(prefix, tree):
             for lname, ws in tree.items():
                 for wname, arr in ws.items():
-                    flat[f"{prefix}/{lname}/{wname}"] = np.asarray(arr)
+                    flat[f"{prefix}/{lname}/{wname}"] = self._to_numpy(arr)
 
         put("params", ex.params)
         put("state", ex.state)

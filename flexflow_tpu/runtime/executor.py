@@ -103,6 +103,7 @@ class Executor:
 
         self._step_jit = None
         self._fwd_jit = None
+        self._input_pspec_cache: Dict[int, PartitionSpec] = {}
         self.params: Dict[str, Dict[str, jax.Array]] = {}
         self.state: Dict[str, Dict[str, jax.Array]] = {}
         self.opt_state: Any = None
@@ -120,7 +121,16 @@ class Executor:
         graph inputs seq-sharded so layer-0 attention sees a sharded seq
         dim); otherwise they follow the default batch sharding.  Labels are
         co-sharded with the final op (reference label-tensor creation,
-        ``model.cc:3086-3124``)."""
+        ``model.cc:3086-3124``).  Cached per tensor: the consumer scan is
+        O(layers) and this runs on every train_step call."""
+        cached = self._input_pspec_cache.get(t.guid)
+        if cached is not None:
+            return cached
+        ps = self._input_pspec_uncached(t)
+        self._input_pspec_cache[t.guid] = ps
+        return ps
+
+    def _input_pspec_uncached(self, t: Tensor) -> PartitionSpec:
         declared = self._declared_input_sharding(t)
         if declared is not None:
             return declared.partition_spec()
@@ -328,7 +338,18 @@ class Executor:
         metrics = self.metrics
         loss_fn = self.loss_fn
 
-        def step(params, state, opt_state, inputs, labels, rng):
+        # per-step rng derived INSIDE the program from the optimizer step
+        # counter when one exists — the eager PRNGKey+fold_in pair used to
+        # cost two host->device dispatches per step (measurable over a
+        # tunneled link).  Custom optimizers without a "step" entry fall
+        # back to a host-passed counter so the rng stream still advances.
+        opt_has_step = isinstance(self.opt_state, dict) and "step" in self.opt_state
+        self._opt_has_step = opt_has_step
+
+        def step(params, state, opt_state, inputs, labels, host_step):
+            cnt = opt_state["step"] if opt_has_step else host_step
+            rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), cnt)
+
             def objective(p):
                 logits, new_state, aux = self._forward(p, state, inputs, True, rng)
                 loss = loss_fn(logits, labels)
@@ -368,11 +389,11 @@ class Executor:
             for x, t in zip(inputs, self.graph_inputs)
         ]
         labels = self._place(labels, self._label_pspec(), self.graph_inputs[0].shape[0])
-        rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._step_count)
-        self._step_count += 1
         self.params, self.state, self.opt_state, loss, m = self._step_jit(
-            self.params, self.state, self.opt_state, inputs, labels, rng
+            self.params, self.state, self.opt_state, inputs, labels,
+            self._step_count,
         )
+        self._step_count += 1
         return loss, m
 
     def forward(self, inputs: Sequence[Any]) -> jax.Array:

@@ -139,10 +139,9 @@ def test_all_to_all_engages():
     ex = ep_model.executor
     x, y = make_data()
     step = ex._build_step()
-    rng = jax.random.PRNGKey(0)
     xp = ex._place(x, ex._input_pspec(ex.graph_inputs[0]))
     yp = ex._place(y, ex._label_pspec())
-    compiled = step.lower(ex.params, ex.state, ex.opt_state, [xp], yp, rng).compile()
+    compiled = step.lower(ex.params, ex.state, ex.opt_state, [xp], yp, 0).compile()
     hlo = compiled.as_text()  # post-SPMD-partitioning: collectives visible
     assert "all-to-all" in hlo, "EP all-to-all dispatch did not engage"
 

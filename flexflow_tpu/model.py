@@ -349,9 +349,22 @@ class FFModel:
             dict(axes=tuple(axes), keepdims=keepdims),
         )[0]
 
-    def batch_matmul(self, a: Tensor, b: Tensor, name: Optional[str] = None) -> Tensor:
+    def batch_matmul(
+        self,
+        a: Tensor,
+        b: Tensor,
+        a_seq_length_dim: Optional[int] = None,
+        b_seq_length_dim: Optional[int] = None,
+        name: Optional[str] = None,
+    ) -> Tensor:
+        """``FFModel::batch_matmul`` (``model.h:481-485``): the seq-length
+        dims enable iteration masking for incremental decoding — positions
+        >= the ``seq_length`` passed to :meth:`eval_batch` are zeroed."""
         return self._add_layer(
-            OperatorType.BATCHMATMUL, self._name("batch_matmul", name), [a, b], {}
+            OperatorType.BATCHMATMUL,
+            self._name("batch_matmul", name),
+            [a, b],
+            dict(a_seq_length_dim=a_seq_length_dim, b_seq_length_dim=b_seq_length_dim),
         )[0]
 
     def gather(self, data: Tensor, index: Tensor, dim: int = 0, name: Optional[str] = None) -> Tensor:
@@ -858,10 +871,15 @@ class FFModel:
                 )
         return pm
 
-    def eval_batch(self, x: Sequence[np.ndarray]) -> jax.Array:
+    def eval_batch(
+        self, x: Sequence[np.ndarray], seq_length: Optional[int] = None
+    ) -> jax.Array:
+        """Inference forward.  ``seq_length`` is the per-call iteration
+        config (reference ``forward(seq_length)``, ``model.cc:2415-2420``):
+        ops that declared seq-length dims mask positions beyond it."""
         assert self.executor is not None
         xs = list(x) if isinstance(x, (list, tuple)) else [x]
-        return self.executor.forward(xs)
+        return self.executor.forward(xs, seq_length=seq_length)
 
     # ------------------------------------------------- weight access (R3 API)
     def get_weights(self) -> Dict[str, Dict[str, np.ndarray]]:

@@ -65,6 +65,7 @@ class OpContext:
         mesh: Optional[Any] = None,
         input_shardings: Optional[Sequence[Any]] = None,
         op_sharding: Optional[Any] = None,
+        seq_length: Optional[int] = None,
     ) -> None:
         self.training = training
         self._rng = rng
@@ -72,6 +73,10 @@ class OpContext:
         self.mesh = mesh
         self.input_shardings = input_shardings
         self.op_sharding = op_sharding
+        # per-call iteration config (reference FFIterationConfig.seq_length,
+        # config.h:162-167): static — a new value retraces, like the
+        # reference re-tracing per sequence length
+        self.seq_length = seq_length
 
     def weight_axis(self, wname: str, dim: int) -> Optional[str]:
         """Mesh axis sharding dim ``dim`` of weight ``wname`` under the

@@ -104,8 +104,11 @@ class BatchMatmul(OpDef):
     """``src/ops/batch_matmul.cc``: C[b] = A[b] @ B[b].
 
     ``a_seq_length_dim``/``b_seq_length_dim`` masking
-    (``include/flexflow/model.h:481-485``) is honored via ``seq_length``
-    in the context's iteration config when set (NMT incremental decoding).
+    (``include/flexflow/model.h:481-485``, ``batch_matmul.cc`` iter_config
+    handling): when the per-call iteration ``seq_length`` is set (NMT
+    incremental decoding, ``FFIterationConfig::seq_length``
+    ``config.h:162-167``), positions at or beyond it along the declared
+    dim are zeroed out of the product.
     """
 
     op_type = OperatorType.BATCHMATMUL
@@ -116,8 +119,21 @@ class BatchMatmul(OpDef):
         assert a.shape[-1] == b.shape[-2]
         return [(a.shape[:-1] + (b.shape[-1],), a.dtype)]
 
+    @staticmethod
+    def _mask_seq(x, dim: int, seq_length: int):
+        idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, dim % x.ndim)
+        return jnp.where(idx < seq_length, x, jnp.zeros((), x.dtype))
+
     def forward(self, layer, params, inputs, ctx: OpContext):
         a, b = inputs
+        sl = ctx.seq_length
+        if sl is not None:
+            ad = layer.attrs.get("a_seq_length_dim")
+            bd = layer.attrs.get("b_seq_length_dim")
+            if ad is not None:
+                a = self._mask_seq(a, ad, sl)
+            if bd is not None:
+                b = self._mask_seq(b, bd, sl)
         return [jnp.matmul(a, b)]
 
     def flops(self, layer: Layer) -> float:

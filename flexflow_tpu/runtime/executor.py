@@ -165,6 +165,7 @@ class Executor:
         inputs: Sequence[jax.Array],
         training: bool,
         rng: Optional[jax.Array],
+        seq_length: Optional[int] = None,
     ):
         """Trace the PCG in layer order (layers are appended
         topologically by the builder API, mirroring
@@ -193,6 +194,7 @@ class Executor:
                 mesh=self.mesh,
                 input_shardings=[shardings.get(t.guid) for t in layer.inputs],
                 op_sharding=self.strategy.op_sharding(layer),
+                seq_length=seq_length,
             )
             if self.use_remat and layer.op_type in _REMAT_OPS:
                 outs = jax.checkpoint(
@@ -374,11 +376,15 @@ class Executor:
         return jax.jit(step, donate_argnums=donate)
 
     def _build_fwd(self):
-        def fwd(params, state, inputs):
-            logits, _, _ = self._forward(params, state, inputs, False, None)
+        def fwd(params, state, inputs, seq_length):
+            logits, _, _ = self._forward(
+                params, state, inputs, False, None, seq_length
+            )
             return logits
 
-        return jax.jit(fwd)
+        # static seq_length: each distinct value is its own trace, matching
+        # the reference's per-seq_length forward (model.cc:2415-2420)
+        return jax.jit(fwd, static_argnums=(3,))
 
     # --- public API --------------------------------------------------------
     def train_step(self, inputs: Sequence[Any], labels: Any) -> Tuple[float, Dict[str, float]]:
@@ -396,14 +402,16 @@ class Executor:
         self._step_count += 1
         return loss, m
 
-    def forward(self, inputs: Sequence[Any]) -> jax.Array:
+    def forward(
+        self, inputs: Sequence[Any], seq_length: Optional[int] = None
+    ) -> jax.Array:
         if self._fwd_jit is None:
             self._fwd_jit = self._build_fwd()
         inputs = [
             self._place(x, self._input_pspec(t), t.shape[0])
             for x, t in zip(inputs, self.graph_inputs)
         ]
-        return self._fwd_jit(self.params, self.state, inputs)
+        return self._fwd_jit(self.params, self.state, inputs, seq_length)
 
     def _label_pspec(self) -> PartitionSpec:
         if self.strategy.mesh.axis_size("data") > 1:

@@ -282,6 +282,36 @@ def test_batch_matmul():
                                rtol=RTOL, atol=ATOL)
 
 
+def test_batch_matmul_seq_length_masking():
+    """``a_seq_length_dim`` iteration masking (``model.h:481-485``,
+    NMT incremental decoding): positions >= seq_length along the declared
+    dim are zeroed out of the product."""
+    from flexflow_tpu.ops.base import get_op_def
+    from flexflow_tpu.ops.base import OpContext
+
+    rng = np.random.default_rng(11)
+    a = rng.normal(size=(3, 4, 5)).astype(np.float32)
+    b = rng.normal(size=(3, 5, 6)).astype(np.float32)
+    layer = make_layer(
+        OperatorType.BATCHMATMUL, dict(a_seq_length_dim=1, b_seq_length_dim=None), [a, b]
+    )
+    opdef = get_op_def(OperatorType.BATCHMATMUL)
+    ctx = OpContext(training=False, seq_length=2)
+    (y,) = opdef.forward(layer, {}, [jnp.asarray(a), jnp.asarray(b)], ctx)
+    a_masked = a.copy()
+    a_masked[:, 2:, :] = 0.0
+    np.testing.assert_allclose(
+        y, torch.bmm(torch.tensor(a_masked), torch.tensor(b)).numpy(),
+        rtol=RTOL, atol=ATOL,
+    )
+    # no seq_length -> unmasked
+    (y2,) = opdef.forward(layer, {}, [jnp.asarray(a), jnp.asarray(b)],
+                          OpContext(training=False))
+    np.testing.assert_allclose(
+        y2, torch.bmm(torch.tensor(a), torch.tensor(b)).numpy(), rtol=RTOL, atol=ATOL
+    )
+
+
 # ----------------------------------------------------- softmax/unary/binary
 def test_softmax():
     x = np.random.default_rng(12).normal(size=(4, 7)).astype(np.float32)

@@ -201,3 +201,42 @@ def test_transformer_seq_parallel_e2e(impl, monkeypatch):
             err_msg=f"step {step} ({impl})",
         )
     assert impl in calls, f"SP path never engaged: {calls}"
+
+
+def test_search_discovers_sequence_parallelism():
+    """Unity search must find seq sharding on its own at long-context
+    sizes where the cost model favors it (SURVEY §2.4: SP expressed in the
+    same per-op sharding vocabulary the search explores — a capability the
+    reference's search does not have)."""
+    from flexflow_tpu import FFConfig, FFModel, MachineMesh
+    from flexflow_tpu.fftype import OperatorType
+    from flexflow_tpu.models.transformer import transformer_encoder
+    from flexflow_tpu.search import unity_search
+    from flexflow_tpu.search.cost import estimate_strategy_cost
+    from flexflow_tpu.parallel.strategy import data_parallel_strategy
+
+    model = FFModel(FFConfig(batch_size=2))
+    transformer_encoder(
+        model, batch=2, seq=8192, hidden=512, heads=8, ff_dim=2048,
+        num_layers=1, vocab=64, num_classes=8, raw_input=True, use_flash=False,
+    )
+    mesh = MachineMesh((2, 1, 4), ("data", "model", "seq"))
+    st = unity_search(model.layers, mesh, budget=8, explore_meshes=False)
+
+    attn = next(
+        l for l in model.layers
+        if l.op_type is OperatorType.MULTIHEAD_ATTENTION
+    )
+    assert "seq" in st.op_sharding(attn).output[0].used_axes(), (
+        st.op_sharding(attn).output[0].spec
+    )
+    n_seq = sum(
+        1 for l in model.layers
+        if st.op_sharding(l) and "seq" in st.op_sharding(l).output[0].used_axes()
+    )
+    assert n_seq >= 5, f"only {n_seq} layers seq-sharded"
+    # and the searched strategy must beat plain DP by the model's accounting
+    dp_cost = estimate_strategy_cost(
+        model.layers, data_parallel_strategy(model.layers, mesh)
+    )
+    assert estimate_strategy_cost(model.layers, st) < dp_cost

@@ -94,15 +94,17 @@ class GroupBy(OpDef):
         data, assign = inputs[:2]
         n = layer.attrs["n_experts"]
         cap = self._cap(layer)
-        dispatch, _, _ = make_dispatch(assign, n, cap)
-        grouped = jnp.einsum("tec,td->ecd", dispatch, data.astype(jnp.float32))
-        grouped = grouped.astype(data.dtype)
+        # scatter dispatch: O(t·k·d) data movement, no e×cap×d one-hot
+        # einsum (round-2 verdict item 7) — each in-capacity slot receives
+        # exactly one token row, so the scatter-add never actually adds
+        slot, within = dispatch_indices(assign, n, cap)
+        grouped = scatter_group(data, slot, within, n, cap)
         return [grouped[e] for e in range(n)]
 
     def flops(self, layer: Layer) -> float:
         data = layer.inputs[0]
-        n = layer.attrs["n_experts"]
-        return 2.0 * data.shape[0] * n * self._cap(layer) * data.shape[1]
+        k = layer.inputs[1].shape[-1]
+        return 2.0 * data.shape[0] * k * data.shape[1]
 
 
 class Aggregate(OpDef):
@@ -127,11 +129,10 @@ class Aggregate(OpDef):
         gate_preds, gate_assign = inputs[0], inputs[1]
         experts = jnp.stack(inputs[4 : 4 + n], axis=0)  # (n, cap, d)
         cap = experts.shape[1]
-        dispatch, _, within = make_dispatch(gate_assign, n, cap)
-        gates = (gate_preds * within.astype(gate_preds.dtype)).astype(jnp.float32)
-        eoh = jax.nn.one_hot(gate_assign, n, dtype=jnp.float32)  # (t,k,e)
-        w_te = jnp.einsum("tk,tke->te", gates, eoh)  # (tokens, n)
-        out = jnp.einsum("tec,te,ecd->td", dispatch, w_te, experts.astype(jnp.float32))
+        # gather combine: O(t·k·d), mirrors GroupBy's scatter dispatch —
+        # no (t, e, cap) one-hot and no e×cap×d einsum term
+        slot, within = dispatch_indices(gate_assign, n, cap)
+        out = gather_combine(experts, slot, within, gate_preds)
         return [out.astype(experts.dtype)]
 
     @staticmethod

@@ -630,16 +630,16 @@ class FFModel:
         # same costs the search optimized
         from flexflow_tpu.search.cost import TPUMachineModel
 
-        machine = None
         if cfg.machine_model_file:
             machine = TPUMachineModel.from_file(cfg.machine_model_file)
+        else:
+            # price for the chip actually present (detect() falls back to
+            # v5p-class defaults off-TPU)
+            machine = TPUMachineModel.detect()
         # multi-host: the dcn axis spans processes — price its collectives
         # at DCN bandwidth
-        if jax.process_count() > 1:
-            if machine is None:
-                machine = TPUMachineModel(dcn_axes=(cfg.dcn_axis,))
-            elif not machine.dcn_axes:
-                machine.dcn_axes = (cfg.dcn_axis,)
+        if jax.process_count() > 1 and not machine.dcn_axes:
+            machine.dcn_axes = (cfg.dcn_axis,)
         profiler = None
         if cfg.use_measured_cost:
             from flexflow_tpu.search.simulator import OpProfiler
@@ -770,7 +770,13 @@ class FFModel:
             if preserve_weights
             else None
         )
+        # the host-side step counter seeds the per-step dropout rng stream;
+        # custom optimizers may lack a 'step' entry in opt_state, so carry
+        # it explicitly or the stream replays already-used keys
+        old_step = self.executor._step_count
         self.compile(**self._compile_call)
+        if preserve_weights:
+            self.executor._step_count = old_step
         if snapshot is None:
             return
         ex = self.executor

@@ -441,6 +441,17 @@ class Executor:
                 and arr.ndim > 0
                 and arr.shape[0] != global_batch
             ):
+                # only the exact per-process row count is the local case; a
+                # short final batch must error here, not be silently glued
+                # into a wrongly-sized global array
+                local = global_batch // jax.process_count()
+                if arr.shape[0] != local:
+                    raise ValueError(
+                        f"per-process batch has {arr.shape[0]} rows; expected "
+                        f"the global batch ({global_batch}) or the "
+                        f"process-local share ({local}). Pad or drop the "
+                        f"remainder batch."
+                    )
                 return jax.make_array_from_process_local_data(ns, arr)
             return jax.make_array_from_callback(
                 arr.shape, ns, lambda idx: arr[idx]

@@ -1,4 +1,4 @@
-from flexflow_tpu.parallel.machine import MachineMesh
+from flexflow_tpu.parallel.machine import MachineMesh, PhysicalTopology
 from flexflow_tpu.parallel.spec import ParallelDim, TensorSharding
 
-__all__ = ["MachineMesh", "ParallelDim", "TensorSharding"]
+__all__ = ["MachineMesh", "ParallelDim", "PhysicalTopology", "TensorSharding"]

@@ -60,7 +60,8 @@ class SearchHelper:
         self.layers = layers
         self.graph_inputs = graph_inputs
         self.mesh = mesh
-        self.machine = machine or TPUMachineModel()
+        # bind torus-ring bandwidth multipliers for THIS mesh's axes
+        self.machine = (machine or TPUMachineModel()).for_mesh(mesh)
         self.beam = beam
         self.lambda_mem = lambda_mem
         # measured-cost tier (reference: search driven by on-device kernel

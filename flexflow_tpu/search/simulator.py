@@ -182,7 +182,7 @@ class MeasuredCostModel:
     ) -> None:
         self.profiler = profiler
         self.mesh = mesh
-        self.machine = machine or TPUMachineModel()
+        self.machine = (machine or TPUMachineModel()).for_mesh(mesh)
 
     def node_time(self, layer: Layer, sharding: Optional[OpSharding]) -> float:
         t = self.profiler.measure(layer, sharding, self.mesh)
@@ -199,15 +199,57 @@ class MeasuredCostModel:
 
 # ----------------------------------------------------- event-driven sim
 class SimTask:
-    __slots__ = ("name", "duration", "stream", "deps", "start", "end")
+    __slots__ = ("name", "duration", "stream", "deps", "start", "end", "device")
 
-    def __init__(self, name: str, duration: float, stream: str, deps: List["SimTask"]):
+    def __init__(
+        self,
+        name: str,
+        duration: float,
+        stream: str,
+        deps: List["SimTask"],
+        device: int = 0,
+    ):
         self.name = name
         self.duration = duration
         self.stream = stream
         self.deps = deps
+        self.device = device
         self.start = 0.0
         self.end = 0.0
+
+
+def _device_work_scale(
+    sharding, out_shape: Tuple[int, ...], mesh: MachineMesh, coord: Tuple[int, ...]
+) -> float:
+    """Per-device work multiplier relative to the even-split assumption.
+
+    GSPMD shards a dim of extent ``e`` over degree ``g`` as ``ceil(e/g)``
+    blocks with a ragged tail — so when ``g`` does not divide ``e`` some
+    devices own more rows than ``e/g`` and some own fewer (possibly zero:
+    EP hotspots, e.g. 6 experts over a 4-way expert axis land 2/2/2/0).
+    Returns (owned work fraction) × (total degree): 1.0 for an even split,
+    > 1 on overloaded devices, 0 on idle ones.
+    """
+    if sharding is None:
+        return 1.0
+    scale = 1.0
+    for d in range(min(len(out_shape), len(sharding.spec))):
+        axes = sharding.axes_of(d)
+        if not axes:
+            continue
+        deg = 1
+        for a in axes:
+            deg *= mesh.axis_size(a)
+        if deg <= 1:
+            continue
+        e = out_shape[d]
+        idx = 0
+        for a in axes:
+            idx = idx * mesh.axis_size(a) + coord[mesh.axis_names.index(a)]
+        block = -(-e // deg)
+        owned = min(block, max(0, e - idx * block))
+        scale *= owned * deg / e
+    return scale
 
 
 def simulate_strategy(
@@ -216,25 +258,64 @@ def simulate_strategy(
     machine: Optional[TPUMachineModel] = None,
     node_time_fn: Optional[Callable[[Layer, Optional[OpSharding]], float]] = None,
     return_tasks: bool = False,
+    mem_budget_bytes: Optional[float] = None,
 ):
     """Event-driven makespan of one training step (reference
-    ``simulate_runtime``, ``src/runtime/simulator.cc:822-1250``).
+    ``simulate_runtime``, ``src/runtime/simulator.cc:822-1250``, which
+    models per-device task queues and memory).
 
-    Two streams per device — ``compute`` (MXU/VPU) and ``comm`` (ICI DMA)
-    — with dependency-respecting overlap; this models XLA's async
-    collectives overlapping compute, which the flat sum in
-    ``estimate_strategy_cost`` cannot.  Deterministic given the cost table.
+    Per-DEVICE simulation: every mesh coordinate gets two streams —
+    ``compute`` (MXU/VPU) and ``comm`` (ICI/DCN DMA) — with
+    dependency-respecting overlap.  Op compute lands on each device scaled
+    by the device's actual owned shard (ceil-block ragged GSPMD splits), so
+    EP hotspots and padding waste show up as per-device imbalance the flat
+    degree-divided estimate cannot see.  Collectives occupy the comm
+    stream of every participating device and synchronize on the slowest
+    producer.  Makespan = latest stream end over all devices.
+
+    ``mem_budget_bytes``: when set, a strategy whose per-device peak HBM
+    (``strategy_memory_per_device``) exceeds the budget is rejected with an
+    ``inf`` makespan — the reference simulator's memory accounting
+    (``CostMetrics.memory``, ``simulator.h:54-88``) folded into the sim.
+    Deterministic given the cost table.
     """
-    m = machine or TPUMachineModel()
+    import itertools
+
     mesh = strategy.mesh
+    m = (machine or TPUMachineModel()).for_mesh(mesh)
     from flexflow_tpu.search.cost import default_op_sharding, node_cost
 
     from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
     from flexflow_tpu.parallel.spec import TensorSharding
 
+    if mem_budget_bytes is not None:
+        from flexflow_tpu.search.memory import strategy_memory_per_device
+
+        if strategy_memory_per_device(layers, strategy) > mem_budget_bytes:
+            return (float("inf"), []) if return_tasks else float("inf")
+
+    # devices along axes no output sharding uses are exact replicas of
+    # coordinate 0 (same compute scale, same comm occupancy) — collapse
+    # them so task count scales with the SHARDED subspace, not the pod
+    used_axes = set()
+    for os_ in strategy.ops.values():
+        for ts in os_.output:
+            for d in range(len(ts.spec)):
+                used_axes.update(ts.axes_of(d))
+    coords = list(
+        itertools.product(
+            *(
+                range(s) if n in used_axes else (0,)
+                for n, s in zip(mesh.axis_names, mesh.shape)
+            )
+        )
+    )
+    n_dev = len(coords)
     tasks: List[SimTask] = []
-    produced: Dict[int, SimTask] = {}  # tensor guid -> producing task
+    # tensor guid -> per-device producing tasks
+    produced: Dict[int, List[Optional[SimTask]]] = {}
     out_sh: Dict[int, TensorSharding] = {}  # tensor guid -> actual layout
+    stream_free: Dict[Tuple[str, int], float] = {}
 
     def producer_sharding(t) -> Optional[TensorSharding]:
         if t.guid in out_sh:
@@ -246,27 +327,58 @@ def simulate_strategy(
             return ps.output[t.owner_idx]
         return None
 
+    def schedule(task: SimTask) -> SimTask:
+        key = (task.stream, task.device)
+        ready = max((d.end for d in task.deps), default=0.0)
+        task.start = max(ready, stream_free.get(key, 0.0))
+        task.end = task.start + task.duration
+        stream_free[key] = task.end
+        tasks.append(task)
+        return task
+
+    def collective(name: str, dur: float, dep_tasks) -> List[SimTask]:
+        """A collective occupies every device's comm stream and starts no
+        earlier than the slowest participating producer (the straggler
+        semantics per-device queues exist to capture)."""
+        barrier = max((p.end for p in dep_tasks if p is not None), default=0.0)
+        out = []
+        for dev in range(n_dev):
+            # deps carries the same-device producer so the exported
+            # taskgraph keeps its dependency edges; timing uses the
+            # all-device barrier (collectives sync on the slowest shard)
+            local_dep = dep_tasks[dev] if dev < len(dep_tasks) else None
+            t = SimTask(
+                name, dur, "comm",
+                [local_dep] if local_dep is not None else [],
+                device=dev,
+            )
+            t.start = max(barrier, stream_free.get(("comm", dev), 0.0))
+            t.end = t.start + t.duration
+            stream_free[("comm", dev)] = t.end
+            tasks.append(t)
+            out.append(t)
+        return out
+
     for layer in layers:
         if layer.op_type.is_parallel_op:
             t = layer.inputs[0]
-            src_task = produced.get(t.guid)
+            src_tasks = produced.get(t.guid, [None] * n_dev)
             src_sh = producer_sharding(t) or TensorSharding.replicated(t.ndim)
             dst_sh = resolve_parallel_sharding(layer, src_sh, mesh)
             dur = reshard_cost(t.shape, _dtype_nbytes(t.dtype), src_sh, dst_sh, mesh, m)
-            task = SimTask(layer.name, dur, "comm", [src_task] if src_task else [])
-            tasks.append(task)
+            ct = collective(layer.name, dur, src_tasks)
             for o in layer.outputs:
-                produced[o.guid] = task
+                produced[o.guid] = ct
                 out_sh[o.guid] = dst_sh
             continue
         s = strategy.op_sharding(layer)
-        deps: List[SimTask] = []
-        comm_deps: List[SimTask] = []
+        # per-device dependency lists
+        deps: List[List[SimTask]] = [[] for _ in range(n_dev)]
         for i, t in enumerate(layer.inputs):
             p = produced.get(t.guid)
             if p is None:
                 continue
-            # edge reshard -> comm task between producer and consumer.
+            # edge reshard -> comm collective between producer and consumer.
             # Same semantics as estimate_strategy_cost: an explicit input
             # requirement is honored; otherwise partial sums and channel
             # shards the consumer didn't ask for must still be resolved.
@@ -282,30 +394,35 @@ def simulate_strategy(
                     t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m
                 )
                 if dur > 0:
-                    ct = SimTask(f"reshard:{t.name}->{layer.name}", dur, "comm", [p])
-                    tasks.append(ct)
-                    comm_deps.append(ct)
+                    ct = collective(f"reshard:{t.name}->{layer.name}", dur, p)
+                    for dev in range(n_dev):
+                        deps[dev].append(ct[dev])
                     continue
-            deps.append(p)
+            for dev in range(n_dev):
+                if p[dev] is not None:
+                    deps[dev].append(p[dev])
         if node_time_fn is not None:
             dur = node_time_fn(layer, s)
         else:
             dur = node_cost(layer, s or default_op_sharding(layer), mesh, m)
-        task = SimTask(layer.name, dur, "compute", deps + comm_deps)
-        tasks.append(task)
+        out0 = s.output[0] if s and s.output else None
+        oshape = layer.outputs[0].shape if layer.outputs else ()
+        dev_tasks: List[Optional[SimTask]] = []
+        for dev, coord in enumerate(coords):
+            scale = _device_work_scale(out0, oshape, mesh, coord)
+            dev_tasks.append(
+                schedule(
+                    SimTask(layer.name, dur * scale, "compute", deps[dev], device=dev)
+                )
+            )
         for o in layer.outputs:
-            produced[o.guid] = task
+            produced[o.guid] = dev_tasks
 
-    # list-schedule over the two streams
-    stream_free = {"compute": 0.0, "comm": 0.0}
-    for task in tasks:  # already topological
-        ready = max((d.end for d in task.deps), default=0.0)
-        task.start = max(ready, stream_free[task.stream])
-        task.end = task.start + task.duration
-        stream_free[task.stream] = task.end
     makespan = max((t.end for t in tasks), default=0.0)
     if return_tasks:
-        return makespan, tasks
+        # the critical device's timeline (taskgraph export reads this)
+        worst = max(tasks, key=lambda t: t.end).device if tasks else 0
+        return makespan, [t for t in tasks if t.device == worst]
     return makespan
 
 

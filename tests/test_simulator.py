@@ -140,3 +140,70 @@ def test_profile_strategy_end_to_end(tmp_path):
     # replay from cache: same result without device work
     t2, _ = profile_strategy(model.layers, st, cache_file=cache)
     assert t2 == pytest.approx(t, rel=1e-6)
+
+
+# ------------------------------------------- per-device queues (round 3)
+def test_ep_hotspot_imbalance_visible():
+    """6 rows over a 4-way expert axis land 2/2/2/0 (ceil blocks): the
+    hotspot devices own 4/3 of the even split, and the per-device sim's
+    makespan is driven by them — the flat degree-divided estimate treats
+    both strategies identically (reference per-device queues:
+    ``simulator.cc:822-1250``)."""
+    mesh = MachineMesh((4, 1), ("expert", "data"))
+
+    def sim_for(extent):
+        cfg = FFConfig(batch_size=8)
+        model = FFModel(cfg)
+        x = model.create_tensor((extent, 16))
+        model.dense(x, 16)
+        st = Strategy(mesh)
+        layer = model.layers[0]
+        st.ops[int(layer.layer_guid)] = OpSharding(
+            output=[TensorSharding(spec=("expert", None))],
+        )
+        # node time 1.0 == time of an even e/4 shard
+        return simulate_strategy([layer], st, node_time_fn=fixed_time(1.0))
+
+    balanced = sim_for(8)  # 2/2/2/2 rows
+    ragged = sim_for(6)    # ceil-2 blocks: 2/2/2/0 — hotspot
+    assert balanced == pytest.approx(1.0)
+    # hotspot device does 2 rows where the even split would be 1.5
+    assert ragged == pytest.approx(4.0 / 3.0)
+    assert ragged > balanced
+
+
+def test_simulator_rejects_oom_strategy():
+    """Memory integration (round-2 verdict item 6): a strategy whose
+    per-device peak exceeds the budget gets an infinite makespan."""
+    model = build_mlp(batch=64, d=512, hidden=4096)
+    st = Strategy(MESH)
+    mk_ok = simulate_strategy(model.layers, st, mem_budget_bytes=1e12)
+    mk_oom = simulate_strategy(model.layers, st, mem_budget_bytes=1024.0)
+    assert mk_ok < float("inf")
+    assert mk_oom == float("inf")
+
+
+def test_collective_straggler_sync():
+    """A reshard collective cannot start before its slowest producer: with
+    one hotspot device, downstream comm on ALL devices waits for it."""
+    mesh = MachineMesh((4, 1), ("data", "model"))
+    cfg = FFConfig(batch_size=6)
+    model = FFModel(cfg)
+    x = model.create_tensor((6, 16))
+    h = model.dense(x, 16)  # ragged 2/2/2/0 over data
+    # force an all-gather after: replicated input requirement
+    h2 = model.dense(h, 16)
+    st = Strategy(mesh)
+    l0, l1 = model.layers[0], model.layers[1]
+    st.ops[int(l0.layer_guid)] = OpSharding(output=[TensorSharding(spec=("data", None))])
+    st.ops[int(l1.layer_guid)] = OpSharding(
+        output=[TensorSharding(spec=(None, None))],
+        inputs=[TensorSharding(spec=(None, None))],
+    )
+    mk, tasks = simulate_strategy(
+        model.layers, st, node_time_fn=fixed_time(1.0), return_tasks=True
+    )
+    reshard = [t for t in tasks if t.name.startswith("reshard:")]
+    assert reshard, "expected an all-gather comm task"
+    # producer hotspot ends at 1.0; the collective may not start earlier
+    assert all(t.start >= 1.0 - 1e-12 for t in reshard)

@@ -39,7 +39,36 @@ except Exception:  # pragma: no cover
 # IR: one JSON object per fx node
 # --------------------------------------------------------------------------
 
-def _node_ir(node, modules) -> Optional[Dict[str, Any]]:
+_TORCH_DTYPES = {
+    "torch.float32": "float32", "torch.float": "float32",
+    "torch.float64": "float64", "torch.double": "float64",
+    "torch.float16": "float16", "torch.half": "float16",
+    "torch.bfloat16": "bfloat16",
+    "torch.int32": "int32", "torch.int": "int32",
+    "torch.int64": "int64", "torch.long": "int64",
+    "torch.bool": "bool",
+}
+
+
+def _encode(a):
+    """Serialize one fx arg: Node -> {"$": name} reference (resolved against
+    traced values at apply time — this is how data-dependent shapes like
+    ``x.view(x.size(0), -1)`` survive the .ff round-trip), slice -> its
+    triple, scalars pass through."""
+    if isinstance(a, fx.Node):
+        return {"$": a.name}
+    if isinstance(a, slice):
+        return {"slice": [a.start, a.stop, a.step]}
+    if isinstance(a, (list, tuple)):
+        return [_encode(x) for x in a]
+    if a is Ellipsis:
+        return {"ellipsis": True}
+    if _HAS_TORCH and isinstance(a, torch.dtype):
+        return {"dtype": _TORCH_DTYPES.get(str(a), "float32")}
+    return a
+
+
+def _node_ir(node, modules, root=None) -> Optional[Dict[str, Any]]:
     """Translate one fx node into a serializable IR record
     {name, op, args: [input names], attrs: {...}} — or None to skip."""
     ir = {"name": node.name, "args": [], "attrs": {}}
@@ -54,6 +83,24 @@ def _node_ir(node, modules) -> Optional[Dict[str, Any]]:
     if node.op == "placeholder":
         ir["op"] = "input"
         return ir
+
+    if node.op == "get_attr":
+        # free tensor (nn.Parameter / buffer) — reference GetAttr nodes,
+        # ``python/flexflow/torch/model.py:1628``; becomes a Weight source
+        # layer (FFModel.parameter) whose value transfer_weights() fills
+        t = root
+        for part in node.target.split("."):
+            t = getattr(t, part)
+        ir["op"] = "parameter"
+        ir["attrs"] = {
+            "path": node.target,
+            "shape": list(t.shape),
+            "dtype": _TORCH_DTYPES.get(str(t.dtype), "float32"),
+            # buffers (rotary tables, masks) are constants, not optimizer
+            # targets — only true nn.Parameters train
+            "trainable": node.target in dict(root.named_parameters()),
+        }
+        return ir
     if node.op == "output":
         ir["op"] = "output"
         ir["args"] = arg_names(
@@ -64,6 +111,7 @@ def _node_ir(node, modules) -> Optional[Dict[str, Any]]:
     if node.op == "call_module":
         m = modules[node.target]
         ir["args"] = arg_names(node.args)
+        ir["module"] = node.target  # shared modules appear at many call sites
         t = type(m).__name__
         if t == "Linear":
             ir["op"] = "linear"
@@ -155,6 +203,34 @@ def _node_ir(node, modules) -> Optional[Dict[str, Any]]:
             rate = node.kwargs.get("p", float(scalar) if scalar is not None else 0.5)
             ir["op"] = "dropout"
             ir["attrs"] = {"rate": rate}
+        elif name == "getitem":
+            ir["op"] = "getitem"
+            ir["attrs"] = {"index": _encode(node.args[1])}
+        elif name == "mean":
+            dim = node.kwargs.get("dim", node.args[1] if len(node.args) > 1 else None)
+            keep = node.kwargs.get("keepdim", node.args[2] if len(node.args) > 2 else False)
+            ir["op"] = "mean"
+            ir["attrs"] = {"dim": _encode(dim), "keepdim": bool(keep)}
+        elif name == "sum":
+            dim = node.kwargs.get("dim", node.args[1] if len(node.args) > 1 else None)
+            keep = node.kwargs.get("keepdim", node.args[2] if len(node.args) > 2 else False)
+            ir["op"] = "sum"
+            ir["attrs"] = {"dim": _encode(dim), "keepdim": bool(keep)}
+        elif name == "pow":
+            ir["op"] = "pow"
+            ir["attrs"] = {"exponent": node.args[1]}
+        elif name in ("rsqrt", "sqrt", "exp", "sin", "cos"):
+            ir["op"] = name
+        elif name == "unsqueeze":
+            ir["op"] = "unsqueeze"
+            ir["attrs"] = {"dim": node.args[1]}
+        elif name == "permute":
+            ir["op"] = "transpose"
+            perm = node.args[1] if isinstance(node.args[1], (list, tuple)) else node.args[1:]
+            ir["attrs"] = {"perm": list(perm)}
+        elif name == "transpose":
+            ir["op"] = "swapaxes"
+            ir["attrs"] = {"a": node.args[1], "b": node.args[2]}
         else:
             raise NotImplementedError(f"torch function {name}")
         return ir
@@ -165,7 +241,11 @@ def _node_ir(node, modules) -> Optional[Dict[str, Any]]:
         m = node.target
         if m in ("view", "reshape"):
             ir["op"] = "reshape"
-            ir["attrs"] = {"shape": [a for a in node.args[1:] if not isinstance(a, fx.Node)]}
+            shape_args = node.args[1:]
+            if len(shape_args) == 1 and isinstance(shape_args[0], (list, tuple)):
+                shape_args = shape_args[0]
+            ir["attrs"] = {"shape": [_encode(a) for a in shape_args]}
+            ir["args"] = arg_names(node.args)  # include size() refs
         elif m == "permute":
             ir["op"] = "transpose"
             ir["attrs"] = {"perm": [a for a in node.args[1:]]}
@@ -184,13 +264,68 @@ def _node_ir(node, modules) -> Optional[Dict[str, Any]]:
             ir["op"] = "identity"
         elif m == "softmax":
             ir["op"] = "softmax"
-            ir["attrs"] = {"dim": node.kwargs.get("dim", -1)}
+            dim = node.kwargs.get(
+                "dim",
+                next((a for a in node.args[1:] if isinstance(a, int)), -1),
+            )
+            ir["attrs"] = {"dim": dim}
+        elif m == "mean":
+            dim = node.kwargs.get("dim", node.args[1] if len(node.args) > 1 else None)
+            keep = node.kwargs.get("keepdim", node.args[2] if len(node.args) > 2 else False)
+            ir["op"] = "mean"
+            ir["attrs"] = {"dim": _encode(dim), "keepdim": bool(keep)}
+        elif m == "sum":
+            dim = node.kwargs.get("dim", node.args[1] if len(node.args) > 1 else None)
+            keep = node.kwargs.get("keepdim", node.args[2] if len(node.args) > 2 else False)
+            ir["op"] = "sum"
+            ir["attrs"] = {"dim": _encode(dim), "keepdim": bool(keep)}
+        elif m == "pow":
+            ir["op"] = "pow"
+            ir["attrs"] = {"exponent": node.args[1]}
+        elif m in ("rsqrt", "sqrt", "exp"):
+            ir["op"] = m
+        elif m == "unsqueeze":
+            ir["op"] = "unsqueeze"
+            ir["attrs"] = {"dim": node.args[1]}
+        elif m == "squeeze":
+            ir["op"] = "squeeze"
+            ir["attrs"] = {"dim": node.args[1] if len(node.args) > 1 else None}
+        elif m in ("expand", "expand_as"):
+            # jnp/XLA ops broadcast implicitly, so an explicit expand is a
+            # no-op at graph level (the reference's ExpandNode repeats data,
+            # model.py:1702 — unnecessary under XLA broadcast semantics)
+            ir["op"] = "identity"
+        elif m == "to":
+            # .to(dtype) casts; .to(device) is a no-op on one logical device
+            cand = list(node.args[1:]) + list(node.kwargs.values())
+            dt = next(
+                (d["dtype"] for d in map(_encode, cand)
+                 if isinstance(d, dict) and "dtype" in d),
+                None,
+            )
+            if dt is None:
+                ir["op"] = "identity"
+            else:
+                ir["op"] = "cast"
+                ir["attrs"] = {"dtype": dt}
+        elif m in ("float", "double", "half", "long", "int", "bool"):
+            ir["op"] = "cast"
+            ir["attrs"] = {"dtype": {
+                "float": "float32", "double": "float64", "half": "float16",
+                "long": "int64", "int": "int32", "bool": "bool"}[m]}
+        elif m == "type_as":
+            ir["op"] = "type_as"
+            ir["args"] = arg_names(node.args)  # (x, other)
+        elif m == "size":
+            ir["op"] = "size"
+            ir["attrs"] = {"dim": node.args[1] if len(node.args) > 1 else None}
+        elif m == "masked_fill":
+            ir["op"] = "masked_fill"
+            ir["attrs"] = {"value": float(node.args[2])}
         else:
             raise NotImplementedError(f"torch method {m}")
         return ir
 
-    if node.op == "get_attr":
-        raise NotImplementedError("get_attr nodes (free tensors) not supported")
     raise NotImplementedError(node.op)
 
 
@@ -202,7 +337,7 @@ def torch_to_ff(module, filename: str) -> List[Dict[str, Any]]:
     modules = dict(traced.named_modules())
     irs = []
     for node in traced.graph.nodes:
-        ir = _node_ir(node, modules)
+        ir = _node_ir(node, modules, root=module)
         if ir is not None:
             irs.append(ir)
     if filename:
@@ -232,6 +367,24 @@ class PyTorchModel:
         # fx node name -> our layer name mapping filled by apply()
         self.layer_names: Dict[str, str] = {}
 
+    @staticmethod
+    def _decode(a, values):
+        """Resolve IR attr encodings: {"$": node} -> traced value (ints from
+        size(), etc.), {"slice": ...} -> slice, {"dtype": ...} -> DataType,
+        {"ellipsis": ...} -> Ellipsis; recurses into lists."""
+        if isinstance(a, dict):
+            if "$" in a:
+                return values[a["$"]]
+            if "slice" in a:
+                return slice(*a["slice"])
+            if "dtype" in a:
+                return DataType(a["dtype"])
+            if "ellipsis" in a:
+                return Ellipsis
+        if isinstance(a, list):
+            return [PyTorchModel._decode(x, values) for x in a]
+        return a
+
     def apply(self, model: FFModel, inputs: Sequence[Tensor]) -> List[Tensor]:
         values: Dict[str, Union[Tensor, List[Tensor]]] = {}
         it = iter(inputs)
@@ -239,7 +392,7 @@ class PyTorchModel:
         for ir in self.ir:
             op = ir["op"]
             name = ir["name"]
-            a = ir.get("attrs", {})
+            a = {k: self._decode(v, values) for k, v in ir.get("attrs", {}).items()}
             ins = [values[n] for n in ir.get("args", [])]
             if op == "input":
                 values[name] = next(it)
@@ -322,41 +475,175 @@ class PyTorchModel:
             ai, bi = a["a"] % x.ndim, a["b"] % x.ndim
             perm[ai], perm[bi] = perm[bi], perm[ai]
             return model.transpose(x, perm, name=name)
+        if op == "parameter":
+            return model.parameter(
+                a["shape"], DataType(a["dtype"]),
+                trainable=a.get("trainable", True), name=name,
+            )
+        if op == "getitem":
+            idx = a["index"]
+            if isinstance(x, Tensor):
+                return self._lower_tensor_getitem(model, x, idx, name)
+            if isinstance(x, (tuple, list)):
+                return x[idx]
+            raise NotImplementedError(f"getitem on {type(x)}")
+        if op in ("mean", "sum"):
+            dim = a.get("dim")
+            if dim is None:
+                axes = list(range(x.ndim))
+            elif isinstance(dim, int):
+                axes = [dim % x.ndim]
+            else:
+                axes = [d % x.ndim for d in dim]
+            fn = model.reduce_mean if op == "mean" else model.reduce_sum
+            return fn(x, axes=axes, keepdims=a.get("keepdim", False), name=name)
+        if op == "pow":
+            return model.pow(x, float(a["exponent"]), name=name)
+        if op == "sqrt":
+            return model.pow(x, 0.5, name=name)
+        if op in ("rsqrt", "exp", "sin", "cos"):
+            return getattr(model, op)(x, name=name)
+        if op == "unsqueeze":
+            d = a["dim"] % (x.ndim + 1)
+            shape = list(x.shape[:d]) + [1] + list(x.shape[d:])
+            return model.reshape(x, shape, name=name)
+        if op == "squeeze":
+            d = a.get("dim")
+            if d is None:
+                shape = [s for s in x.shape if s != 1]
+            else:
+                d = d % x.ndim
+                assert x.shape[d] == 1, f"squeeze dim {d} has extent {x.shape[d]}"
+                shape = list(x.shape[:d]) + list(x.shape[d + 1:])
+            return model.reshape(x, shape, name=name)
+        if op == "cast":
+            return model.cast(x, DataType(a["dtype"]) if not isinstance(
+                a["dtype"], DataType) else a["dtype"], name=name)
+        if op == "type_as":
+            return model.cast(x, ins[1].dtype, name=name)
+        if op == "size":
+            d = a.get("dim")
+            return x.shape if d is None else int(x.shape[d % x.ndim])
+        if op == "masked_fill":
+            mask = model.cast(ins[1], x.dtype, name=f"{name}_maskf")
+            keep = model.scalar_add(
+                model.scalar_multiply(mask, -1.0, name=f"{name}_neg"),
+                1.0, name=f"{name}_keep")
+            kept = model.multiply(x, keep, name=f"{name}_kept")
+            fill = model.scalar_multiply(mask, a["value"], name=f"{name}_fill")
+            return model.add(kept, fill, name=name)
         raise NotImplementedError(op)
+
+    def _lower_tensor_getitem(self, model: FFModel, x: Tensor, idx, name: str):
+        """Tensor indexing/slicing via the Split op (reference GetItem,
+        ``python/flexflow/torch/model.py:1359``): contiguous step-1 slices
+        per dim; int indices narrow then drop the dim."""
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        # expand Ellipsis
+        if any(i is Ellipsis for i in idx):
+            pos = [i for i, v in enumerate(idx) if v is Ellipsis][0]
+            fill = x.ndim - (len(idx) - 1)
+            idx = idx[:pos] + (slice(None),) * fill + idx[pos + 1:]
+        out = x
+        drop_dims = []
+        for d, sel in enumerate(idx):
+            if isinstance(sel, slice):
+                if sel == slice(None, None, None):
+                    continue
+                assert sel.step in (None, 1), "strided slicing unsupported"
+                start = sel.start or 0
+                stop = sel.stop if sel.stop is not None else out.shape[d]
+                if start < 0:
+                    start += out.shape[d]
+                if stop < 0:
+                    stop += out.shape[d]
+                out = self._narrow(model, out, d, start, stop, f"{name}_d{d}")
+            elif isinstance(sel, int):
+                s = sel % out.shape[d]
+                out = self._narrow(model, out, d, s, s + 1, f"{name}_d{d}")
+                drop_dims.append(d)
+            else:
+                raise NotImplementedError(f"getitem selector {sel!r}")
+        if drop_dims:
+            shape = [s for d, s in enumerate(out.shape) if d not in drop_dims]
+            out = model.reshape(out, shape, name=f"{name}_drop")
+        return out
+
+    @staticmethod
+    def _narrow(model: FFModel, x: Tensor, dim: int, start: int, stop: int, name: str):
+        extent = x.shape[dim]
+        start, stop = max(0, start), min(extent, stop)
+        if (start, stop) == (0, extent):
+            return x
+        sizes = []
+        if start > 0:
+            sizes.append(start)
+        mid = len(sizes)
+        sizes.append(stop - start)
+        if stop < extent:
+            sizes.append(extent - stop)
+        return model.split(x, sizes, axis=dim, name=name)[mid]
 
     # --- weight import (beyond reference parity) --------------------------
     def transfer_weights(self, model: FFModel) -> None:
         """Copy torch parameters into the compiled FFModel (layout
-        conversions: Linear (O,I)->(I,O); Conv2d (O,I,kH,kW)->HWIO)."""
+        conversions: Linear (O,I)->(I,O); Conv2d (O,I,kH,kW)->HWIO).
+        Free tensors (get_attr -> parameter layers) copy by module path;
+        shared modules (tied embeddings) fill every call site."""
+        import functools
+
         assert self.module is not None, "weight transfer needs a live module"
         assert model.executor is not None, "compile() the FFModel first"
         weights = model.get_weights()
+        for ir in self.ir:
+            if ir["op"] != "parameter" or ir["name"] not in self.layer_names:
+                continue
+            val = functools.reduce(
+                getattr, ir["attrs"]["path"].split("."), self.module
+            )
+            lname = self.layer_names[ir["name"]]
+            weights.setdefault(lname, {})["value"] = val.detach().numpy()
+        # node name -> owning module target: a shared module (e.g. a tied
+        # embedding) appears at several call sites and every one has its
+        # own layer needing the weights
+        sites: Dict[str, List[str]] = {}
+        for ir in self.ir:
+            if "module" in ir and ir["name"] in self.layer_names:
+                sites.setdefault(ir["module"], []).append(ir["name"])
         for tname, tmod in self.module.named_modules():
-            fxname = tname.replace(".", "_")
-            if fxname not in self.layer_names:
-                continue
-            lname = self.layer_names[fxname]
-            ws = weights.get(lname, {})
-            tt = type(tmod).__name__
-            sd = {k: v.detach().numpy() for k, v in tmod.state_dict().items()}
-            if tt == "Linear":
-                ws["kernel"] = sd["weight"].T
-                if "bias" in sd:
-                    ws["bias"] = sd["bias"]
-            elif tt == "Conv2d":
-                ws["kernel"] = sd["weight"].transpose(2, 3, 1, 0)
-                if "bias" in sd:
-                    ws["bias"] = sd["bias"]
-            elif tt == "BatchNorm2d":
-                ws.update(scale=sd["weight"], bias=sd["bias"],
-                          running_mean=sd["running_mean"],
-                          running_var=sd["running_var"])
-            elif tt == "LayerNorm":
-                if "weight" in sd:
-                    ws.update(scale=sd["weight"], bias=sd["bias"])
-            elif tt == "Embedding":
-                ws["kernel"] = sd["weight"]
-            else:
-                continue
-            weights[lname] = ws
+            node_names = sites.get(tname)
+            if node_names is None:
+                fxname = tname.replace(".", "_")
+                node_names = [fxname] if fxname in self.layer_names else []
+            for node_name in node_names:
+                self._transfer_module(
+                    weights, tmod, self.layer_names[node_name]
+                )
         model.set_weights(weights)
+
+    @staticmethod
+    def _transfer_module(weights, tmod, lname) -> None:
+        ws = weights.get(lname, {})
+        tt = type(tmod).__name__
+        sd = {k: v.detach().numpy() for k, v in tmod.state_dict().items()}
+        if tt == "Linear":
+            ws["kernel"] = sd["weight"].T
+            if "bias" in sd:
+                ws["bias"] = sd["bias"]
+        elif tt == "Conv2d":
+            ws["kernel"] = sd["weight"].transpose(2, 3, 1, 0)
+            if "bias" in sd:
+                ws["bias"] = sd["bias"]
+        elif tt == "BatchNorm2d":
+            ws.update(scale=sd["weight"], bias=sd["bias"],
+                      running_mean=sd["running_mean"],
+                      running_var=sd["running_var"])
+        elif tt == "LayerNorm":
+            if "weight" in sd:
+                ws.update(scale=sd["weight"], bias=sd["bias"])
+        elif tt == "Embedding":
+            ws["kernel"] = sd["weight"]
+        else:
+            return
+        weights[lname] = ws

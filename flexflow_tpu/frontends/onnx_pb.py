@@ -1,0 +1,315 @@
+"""Minimal ONNX protobuf reader/writer ("onnx-lite").
+
+The baked environment has no ``onnx`` package, which left the ONNX
+importer (reference ``python/flexflow/onnx/model.py``) executable only in
+theory.  ONNX files are plain protobuf; this module implements the
+protobuf *wire format* (varints + length-delimited fields — the public
+encoding, documented in the protobuf spec) for exactly the message subset
+the importer touches, so ``ONNXModel`` runs with or without the real
+``onnx`` package:
+
+  ModelProto{ir_version, opset_import[], graph}
+  GraphProto{node[], name, initializer[], input[], output[]}
+  NodeProto{input[], output[], name, op_type, attribute[]}
+  AttributeProto{name, f, i, s, ints[], type}
+  TensorProto{dims[], data_type, float_data[], int32_data[], int64_data[],
+              name, raw_data}
+  ValueInfoProto{name}
+  OperatorSetIdProto{domain, version}
+
+Field numbers are the stable public ONNX schema (onnx/onnx.proto).  The
+writer side exists so tests can hand-construct fixture models without any
+third-party dependency.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# --------------------------------------------------------------- wire io
+
+
+def _write_varint(out: bytearray, v: int) -> None:
+    v &= (1 << 64) - 1  # negatives encode as 64-bit two's complement
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _to_signed(v: int) -> int:
+    """Two's-complement interpretation of a 64-bit varint."""
+    return v - (1 << 64) if v >= 1 << 63 else v
+
+
+def _write_tag(out: bytearray, field: int, wire: int) -> None:
+    _write_varint(out, (field << 3) | wire)
+
+
+def _write_len_delim(out: bytearray, field: int, payload: bytes) -> None:
+    _write_tag(out, field, 2)
+    _write_varint(out, len(payload))
+    out.extend(payload)
+
+
+# ------------------------------------------------------------ descriptors
+# field -> (name, kind[, submessage]) ; kind: int, str, bytes, msg, packed_f,
+# packed_i.  repeated-ness is handled by the declared default (list vs None).
+_DESC: Dict[str, Dict[int, Tuple]] = {
+    "ModelProto": {
+        1: ("ir_version", "int"),
+        7: ("graph", "msg", "GraphProto"),
+        8: ("opset_import", "rmsg", "OperatorSetIdProto"),
+    },
+    "OperatorSetIdProto": {1: ("domain", "str"), 2: ("version", "int")},
+    "GraphProto": {
+        1: ("node", "rmsg", "NodeProto"),
+        2: ("name", "str"),
+        5: ("initializer", "rmsg", "TensorProto"),
+        11: ("input", "rmsg", "ValueInfoProto"),
+        12: ("output", "rmsg", "ValueInfoProto"),
+    },
+    "NodeProto": {
+        1: ("input", "rstr"),
+        2: ("output", "rstr"),
+        3: ("name", "str"),
+        4: ("op_type", "str"),
+        5: ("attribute", "rmsg", "AttributeProto"),
+    },
+    "AttributeProto": {
+        1: ("name", "str"),
+        2: ("f", "float"),
+        3: ("i", "int"),
+        4: ("s", "bytes"),
+        8: ("ints", "rint"),
+        20: ("type", "int"),
+    },
+    "TensorProto": {
+        1: ("dims", "rint"),
+        2: ("data_type", "int"),
+        4: ("float_data", "rfloat"),
+        5: ("int32_data", "rint"),
+        7: ("int64_data", "rint"),
+        8: ("name", "str"),
+        9: ("raw_data", "bytes"),
+    },
+    "ValueInfoProto": {1: ("name", "str")},
+}
+
+_REPEATED = {"rmsg", "rstr", "rint", "rfloat"}
+
+
+class Msg:
+    """Generic decoded message; attributes mirror the onnx API surface."""
+
+    def __init__(self, mtype: str):
+        self._type = mtype
+        for _, spec in _DESC[mtype].items():
+            name, kind = spec[0], spec[1]
+            setattr(self, name, [] if kind in _REPEATED else
+                    b"" if kind == "bytes" else
+                    "" if kind == "str" else 0)
+
+    def __repr__(self):
+        return f"<{self._type} {self.__dict__}>"
+
+
+def _parse(buf: bytes, mtype: str) -> Msg:
+    msg = Msg(mtype)
+    desc = _DESC[mtype]
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+            payload: Any = val
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            payload = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:  # 32-bit
+            payload = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:  # 64-bit
+            payload = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        spec = desc.get(field)
+        if spec is None:
+            continue  # unknown field: skip (forward compat)
+        name, kind = spec[0], spec[1]
+        if kind == "int":
+            setattr(msg, name, _to_signed(int(payload)))
+        elif kind == "float":
+            setattr(msg, name, float(payload))
+        elif kind == "str":
+            setattr(msg, name, payload.decode() if isinstance(payload, bytes) else str(payload))
+        elif kind == "bytes":
+            setattr(msg, name, payload)
+        elif kind == "msg":
+            setattr(msg, name, _parse(payload, spec[2]))
+        elif kind == "rmsg":
+            getattr(msg, name).append(_parse(payload, spec[2]))
+        elif kind == "rstr":
+            getattr(msg, name).append(payload.decode())
+        elif kind == "rint":
+            if isinstance(payload, bytes):  # packed
+                p = 0
+                lst = getattr(msg, name)
+                while p < len(payload):
+                    v, p = _read_varint(payload, p)
+                    lst.append(_to_signed(v))
+            else:
+                getattr(msg, name).append(_to_signed(int(payload)))
+        elif kind == "rfloat":
+            if isinstance(payload, bytes):  # packed
+                getattr(msg, name).extend(
+                    struct.unpack(f"<{len(payload) // 4}f", payload)
+                )
+            else:
+                getattr(msg, name).append(float(payload))
+    return msg
+
+
+def load(source) -> Msg:
+    """onnx.load equivalent: path or bytes -> ModelProto."""
+    if isinstance(source, bytes):
+        data = source
+    else:
+        with open(source, "rb") as f:
+            data = f.read()
+    return _parse(data, "ModelProto")
+
+
+# ----------------------------------------------------------- numpy bridge
+# TensorProto.DataType (public enum): 1=f32 6=i32 7=i64 9=bool 10=f16 11=f64
+_DT_TO_NP = {1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_,
+             10: np.float16, 11: np.float64}
+_NP_TO_DT = {np.dtype(np.float32): 1, np.dtype(np.int32): 6,
+             np.dtype(np.int64): 7, np.dtype(np.bool_): 9,
+             np.dtype(np.float16): 10, np.dtype(np.float64): 11}
+
+
+def to_array(t: Msg) -> np.ndarray:
+    """onnx.numpy_helper.to_array equivalent."""
+    dt = _DT_TO_NP[t.data_type]
+    shape = tuple(t.dims)
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dtype=dt).reshape(shape).copy()
+    if t.data_type == 1 and t.float_data:
+        return np.asarray(t.float_data, dt).reshape(shape)
+    if t.data_type == 6 and t.int32_data:
+        return np.asarray(t.int32_data, dt).reshape(shape)
+    if t.data_type == 7 and t.int64_data:
+        return np.asarray(t.int64_data, dt).reshape(shape)
+    return np.zeros(shape, dt)
+
+
+# --------------------------------------------------------------- writers
+def _ser_tensor(name: str, arr: np.ndarray) -> bytes:
+    out = bytearray()
+    for d in arr.shape:
+        _write_tag(out, 1, 0)
+        _write_varint(out, d)
+    _write_tag(out, 2, 0)
+    _write_varint(out, _NP_TO_DT[arr.dtype])
+    _write_len_delim(out, 8, name.encode())
+    _write_len_delim(out, 9, np.ascontiguousarray(arr).tobytes())
+    return bytes(out)
+
+
+def _ser_attr(name: str, val) -> bytes:
+    out = bytearray()
+    _write_len_delim(out, 1, name.encode())
+    if isinstance(val, bool):
+        val = int(val)
+    if isinstance(val, float):
+        _write_tag(out, 2, 5)
+        out.extend(struct.pack("<f", val))
+        _write_tag(out, 20, 0)
+        _write_varint(out, 1)  # FLOAT
+    elif isinstance(val, int):
+        _write_tag(out, 3, 0)
+        _write_varint(out, val)
+        _write_tag(out, 20, 0)
+        _write_varint(out, 2)  # INT
+    elif isinstance(val, str):
+        _write_len_delim(out, 4, val.encode())
+        _write_tag(out, 20, 0)
+        _write_varint(out, 3)  # STRING
+    elif isinstance(val, (list, tuple)):
+        packed = bytearray()
+        for v in val:
+            _write_varint(packed, int(v))
+        _write_len_delim(out, 8, bytes(packed))
+        _write_tag(out, 20, 0)
+        _write_varint(out, 7)  # INTS
+    else:
+        raise TypeError(f"attribute {name}: {type(val)}")
+    return bytes(out)
+
+
+def make_node(op_type: str, inputs: List[str], outputs: List[str],
+              name: str = "", **attrs) -> bytes:
+    out = bytearray()
+    for i in inputs:
+        _write_len_delim(out, 1, i.encode())
+    for o in outputs:
+        _write_len_delim(out, 2, o.encode())
+    if name:
+        _write_len_delim(out, 3, name.encode())
+    _write_len_delim(out, 4, op_type.encode())
+    for k, v in attrs.items():
+        _write_len_delim(out, 5, _ser_attr(k, v))
+    return bytes(out)
+
+
+def make_model(nodes: List[bytes], inputs: List[str], outputs: List[str],
+               initializers: Optional[Dict[str, np.ndarray]] = None,
+               opset: int = 13, graph_name: str = "g") -> bytes:
+    g = bytearray()
+    for n in nodes:
+        _write_len_delim(g, 1, n)
+    _write_len_delim(g, 2, graph_name.encode())
+    for iname, arr in (initializers or {}).items():
+        _write_len_delim(g, 5, _ser_tensor(iname, arr))
+    for i in inputs:
+        vi = bytearray()
+        _write_len_delim(vi, 1, i.encode())
+        _write_len_delim(g, 11, bytes(vi))
+    for o in outputs:
+        vo = bytearray()
+        _write_len_delim(vo, 1, o.encode())
+        _write_len_delim(g, 12, bytes(vo))
+
+    m = bytearray()
+    _write_tag(m, 1, 0)
+    _write_varint(m, 8)  # ir_version
+    _write_len_delim(m, 7, bytes(g))
+    ops = bytearray()
+    _write_len_delim(ops, 1, b"")  # default domain
+    _write_tag(ops, 2, 0)
+    _write_varint(ops, opset)
+    _write_len_delim(m, 8, bytes(ops))
+    return bytes(m)

@@ -69,7 +69,17 @@ class Reshape(OpDef):
         return [(shape, t.dtype)]
 
     def forward(self, layer, params, inputs, ctx: OpContext):
-        return [inputs[0].reshape(tuple(layer.attrs["shape"]))]
+        x = inputs[0]
+        shape = tuple(layer.attrs["shape"])
+        if x.shape[0] != layer.inputs[0].shape[0] and shape and (
+            shape[0] == layer.inputs[0].shape[0]
+        ):
+            # the declared shape baked the BUILD-time batch; a smaller
+            # runtime batch (fit minibatches, short final eval batch)
+            # keeps dim 0 and reshapes the rest — the reference gets this
+            # for free from per-sample region partitioning
+            shape = (x.shape[0],) + shape[1:]
+        return [x.reshape(shape)]
 
 
 class Transpose(OpDef):

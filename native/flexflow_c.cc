@@ -57,6 +57,15 @@ struct ff_handle {
   PyObject* obj;
 };
 
+// GetAttrString with error capture: a partially-failed flexflow_tpu import
+// must surface through flexflow_last_error, not segfault the C caller.
+static PyObject* getattr_checked(PyObject* o, const char* name) {
+  if (o == nullptr) return nullptr;
+  PyObject* v = PyObject_GetAttrString(o, name);
+  if (v == nullptr) capture_py_error();
+  return v;
+}
+
 static ff_handle* wrap(PyObject* obj) {
   if (obj == nullptr) {
     capture_py_error();
@@ -92,10 +101,16 @@ static PyObject* np_array_copy(const void* data, const int64_t* dims,
   if (!np) return nullptr;
   int64_t count = 1;
   for (int i = 0; i < ndim; ++i) count *= dims[i];
-  int64_t itemsize = std::strcmp(dtype, "float32") == 0 ? 4
-                     : std::strcmp(dtype, "int32") == 0 ? 4
-                     : std::strcmp(dtype, "int64") == 0 ? 8
-                                                        : 4;
+  int64_t itemsize;
+  if (std::strcmp(dtype, "float32") == 0 || std::strcmp(dtype, "int32") == 0) {
+    itemsize = 4;
+  } else if (std::strcmp(dtype, "int64") == 0 ||
+             std::strcmp(dtype, "float64") == 0) {
+    itemsize = 8;
+  } else {
+    g_last_error = std::string("unsupported dtype: ") + dtype;
+    return nullptr;
+  }
   PyObject* mv = PyMemoryView_FromMemory(
       const_cast<char*>(static_cast<const char*>(data)), count * itemsize,
       PyBUF_READ);
@@ -191,27 +206,35 @@ ff_handle* flexflow_model_create_tensor(ff_handle* model, int ndim,
   for (int i = 0; i < ndim; ++i)
     PyTuple_SET_ITEM(shape, i, PyLong_FromLongLong(dims[i]));
   PyObject* mod = ff_module();
-  PyObject* dt_cls = PyObject_GetAttrString(mod, "DataType");
+  PyObject* dt_cls = getattr_checked(mod, "DataType");
+  if (!dt_cls) {
+    Py_DECREF(shape);
+    return nullptr;
+  }
   const char* dt_name = dtype == 1 ? "INT32" : dtype == 2 ? "INT64" : "FLOAT";
-  PyObject* dt = PyObject_GetAttrString(dt_cls, dt_name);
+  PyObject* dt = getattr_checked(dt_cls, dt_name);
   Py_DECREF(dt_cls);
+  if (!dt) {
+    Py_DECREF(shape);
+    return nullptr;
+  }
   PyObject* t = PyObject_CallMethod(model->obj, "create_tensor", "OOs", shape,
                                     dt, name);
-  Py_XDECREF(dt);
+  Py_DECREF(dt);
   Py_DECREF(shape);
   return wrap(t);
 }
 
 // activation: 0=none 1=relu 2=sigmoid 3=tanh 4=gelu (reference ActiMode)
 static PyObject* acti_mode(int activation) {
-  PyObject* mod = ff_module();
-  PyObject* cls = PyObject_GetAttrString(mod, "ActiMode");
+  PyObject* cls = getattr_checked(ff_module(), "ActiMode");
+  if (!cls) return nullptr;
   const char* name = activation == 1   ? "RELU"
                      : activation == 2 ? "SIGMOID"
                      : activation == 3 ? "TANH"
                      : activation == 4 ? "GELU"
                                        : "NONE";
-  PyObject* v = PyObject_GetAttrString(cls, name);
+  PyObject* v = getattr_checked(cls, name);
   Py_DECREF(cls);
   return v;
 }
@@ -219,6 +242,7 @@ static PyObject* acti_mode(int activation) {
 ff_handle* flexflow_model_dense(ff_handle* model, ff_handle* input,
                                 int out_dim, int activation) {
   PyObject* act = acti_mode(activation);
+  if (!act) return nullptr;
   PyObject* t = PyObject_CallMethod(model->obj, "dense", "OiO", input->obj,
                                     out_dim, act);
   Py_XDECREF(act);
@@ -229,6 +253,7 @@ ff_handle* flexflow_model_conv2d(ff_handle* model, ff_handle* input,
                                  int out_channels, int kh, int kw, int sh,
                                  int sw, int ph, int pw, int activation) {
   PyObject* act = acti_mode(activation);
+  if (!act) return nullptr;
   PyObject* t = PyObject_CallMethod(model->obj, "conv2d", "OiiiiiiiO",
                                     input->obj, out_channels, kh, kw, sh, sw,
                                     ph, pw, act);
@@ -240,10 +265,11 @@ ff_handle* flexflow_model_conv2d(ff_handle* model, ff_handle* input,
 ff_handle* flexflow_model_pool2d(ff_handle* model, ff_handle* input, int kh,
                                  int kw, int sh, int sw, int ph, int pw,
                                  int pool_type) {
-  PyObject* mod = ff_module();
-  PyObject* cls = PyObject_GetAttrString(mod, "PoolType");
-  PyObject* pt = PyObject_GetAttrString(cls, pool_type == 1 ? "AVG" : "MAX");
+  PyObject* cls = getattr_checked(ff_module(), "PoolType");
+  if (!cls) return nullptr;
+  PyObject* pt = getattr_checked(cls, pool_type == 1 ? "AVG" : "MAX");
   Py_DECREF(cls);
+  if (!pt) return nullptr;
   PyObject* t = PyObject_CallMethod(model->obj, "pool2d", "OiiiiiiO",
                                     input->obj, kh, kw, sh, sw, ph, pw, pt);
   Py_XDECREF(pt);
@@ -314,22 +340,39 @@ int flexflow_model_compile(ff_handle* model, int loss, int optimizer,
   PyObject* lrv = PyFloat_FromDouble(lr);
   PyObject_SetAttrString(opt, optimizer == 1 ? "alpha" : "lr", lrv);
   Py_DECREF(lrv);
-  PyObject* loss_cls = PyObject_GetAttrString(mod, "LossType");
+  PyObject* loss_cls = getattr_checked(mod, "LossType");
+  if (!loss_cls) {
+    Py_DECREF(opt);
+    return -1;
+  }
   const char* lname = loss == 1   ? "CATEGORICAL_CROSSENTROPY"
                       : loss == 2 ? "MEAN_SQUARED_ERROR_AVG_REDUCE"
                                   : "SPARSE_CATEGORICAL_CROSSENTROPY";
-  PyObject* lt = PyObject_GetAttrString(loss_cls, lname);
+  PyObject* lt = getattr_checked(loss_cls, lname);
   Py_DECREF(loss_cls);
-  PyObject* m_cls = PyObject_GetAttrString(mod, "MetricsType");
-  PyObject* acc = PyObject_GetAttrString(m_cls, "ACCURACY");
-  Py_DECREF(m_cls);
+  PyObject* m_cls = getattr_checked(mod, "MetricsType");
+  PyObject* acc = m_cls ? getattr_checked(m_cls, "ACCURACY") : nullptr;
+  Py_XDECREF(m_cls);
+  if (!lt || !acc) {
+    Py_XDECREF(lt);
+    Py_XDECREF(acc);
+    Py_DECREF(opt);
+    return -1;
+  }
   PyObject* metrics = PyList_New(1);
   PyList_SET_ITEM(metrics, 0, acc);
   PyObject* kwargs = PyDict_New();
   PyDict_SetItemString(kwargs, "optimizer", opt);
   PyDict_SetItemString(kwargs, "loss_type", lt);
   PyDict_SetItemString(kwargs, "metrics", metrics);
-  PyObject* meth = PyObject_GetAttrString(model->obj, "compile");
+  PyObject* meth = getattr_checked(model->obj, "compile");
+  if (!meth) {
+    Py_DECREF(kwargs);
+    Py_DECREF(metrics);
+    Py_DECREF(lt);
+    Py_DECREF(opt);
+    return -1;
+  }
   PyObject* empty = PyTuple_New(0);
   PyObject* r = PyObject_Call(meth, empty, kwargs);
   Py_DECREF(empty);
@@ -430,6 +473,314 @@ int64_t flexflow_model_eval_f32(ff_handle* model, const float* x,
   std::memcpy(out, buf, n * sizeof(float));
   Py_DECREF(bytes);
   return n;
+}
+
+// ------------------------------------------------ round-3 parity layers
+ff_handle* flexflow_model_batch_norm(ff_handle* model, ff_handle* input,
+                                     int relu) {
+  PyObject* t = PyObject_CallMethod(model->obj, "batch_norm", "OO", input->obj,
+                                    relu ? Py_True : Py_False);
+  return wrap(t);
+}
+
+ff_handle* flexflow_model_layer_norm(ff_handle* model, ff_handle* input) {
+  return wrap(PyObject_CallMethod(model->obj, "layer_norm", "O", input->obj));
+}
+
+ff_handle* flexflow_model_reshape(ff_handle* model, ff_handle* input, int ndim,
+                                  const int64_t* dims) {
+  PyObject* shape = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyList_SET_ITEM(shape, i, PyLong_FromLongLong(dims[i]));
+  PyObject* t =
+      PyObject_CallMethod(model->obj, "reshape", "OO", input->obj, shape);
+  Py_DECREF(shape);
+  return wrap(t);
+}
+
+ff_handle* flexflow_model_transpose(ff_handle* model, ff_handle* input,
+                                    int ndim, const int* perm) {
+  PyObject* p = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) PyList_SET_ITEM(p, i, PyLong_FromLong(perm[i]));
+  PyObject* t =
+      PyObject_CallMethod(model->obj, "transpose", "OO", input->obj, p);
+  Py_DECREF(p);
+  return wrap(t);
+}
+
+int flexflow_model_split(ff_handle* model, ff_handle* input, int n_outputs,
+                         const int64_t* sizes, int axis, ff_handle** outs) {
+  PyObject* sz = PyList_New(n_outputs);
+  for (int i = 0; i < n_outputs; ++i)
+    PyList_SET_ITEM(sz, i, PyLong_FromLongLong(sizes[i]));
+  PyObject* r =
+      PyObject_CallMethod(model->obj, "split", "OOi", input->obj, sz, axis);
+  Py_DECREF(sz);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  for (int i = 0; i < n_outputs; ++i) {
+    PyObject* item = PySequence_GetItem(r, i);  // new ref
+    if (!item) {
+      capture_py_error();
+      // unwind the handles already created so the caller sees all-or-nothing
+      for (int j = 0; j < i; ++j) {
+        flexflow_handle_destroy(outs[j]);
+        outs[j] = nullptr;
+      }
+      Py_DECREF(r);
+      return -1;
+    }
+    outs[i] = new ff_handle{item};
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+ff_handle* flexflow_model_subtract(ff_handle* model, ff_handle* a,
+                                   ff_handle* b) {
+  return wrap(
+      PyObject_CallMethod(model->obj, "subtract", "OO", a->obj, b->obj));
+}
+
+ff_handle* flexflow_model_multiply(ff_handle* model, ff_handle* a,
+                                   ff_handle* b) {
+  return wrap(
+      PyObject_CallMethod(model->obj, "multiply", "OO", a->obj, b->obj));
+}
+
+ff_handle* flexflow_model_batch_matmul(ff_handle* model, ff_handle* a,
+                                       ff_handle* b) {
+  return wrap(
+      PyObject_CallMethod(model->obj, "batch_matmul", "OO", a->obj, b->obj));
+}
+
+ff_handle* flexflow_model_moe(ff_handle* model, ff_handle* input,
+                              int num_experts, int top_k, int hidden,
+                              double alpha, double lambda_bal) {
+  return wrap(PyObject_CallMethod(model->obj, "moe", "Oiiidd", input->obj,
+                                  num_experts, top_k, hidden, alpha,
+                                  lambda_bal));
+}
+
+// --------------------------------------------- multi-input fit / eval
+static const char* dtype_name(int code) {
+  return code == 1 ? "int32" : code == 2 ? "int64" : "float32";
+}
+
+// list of numpy arrays from parallel (ptr, dims, ndim, dtype) descriptors
+static PyObject* np_array_list(int n, const void** xs,
+                               const int64_t* const* xdims, const int* x_ndims,
+                               const int* x_dtypes) {
+  PyObject* lst = PyList_New(n);
+  if (!lst) {
+    capture_py_error();
+    return nullptr;
+  }
+  for (int i = 0; i < n; ++i) {
+    PyObject* a =
+        np_array_copy(xs[i], xdims[i], x_ndims[i], dtype_name(x_dtypes[i]));
+    if (!a) {
+      Py_DECREF(lst);
+      return nullptr;
+    }
+    PyList_SET_ITEM(lst, i, a);  // steals
+  }
+  return lst;
+}
+
+int flexflow_model_fit(ff_handle* model, int n_inputs, const void** xs,
+                       const int64_t* const* xdims, const int* x_ndims,
+                       const int* x_dtypes, const void* y, int y_dtype,
+                       int epochs, double* out_accuracy,
+                       double* out_throughput) {
+  PyObject* xl = np_array_list(n_inputs, xs, xdims, x_ndims, x_dtypes);
+  if (!xl) return -1;
+  int64_t ydims[2] = {xdims[0][0], 1};
+  PyObject* ya = np_array_copy(y, ydims, 2, dtype_name(y_dtype));
+  if (!ya) {
+    Py_DECREF(xl);
+    return -1;
+  }
+  PyObject* kwargs = PyDict_New();
+  PyObject* ep = PyLong_FromLong(epochs);
+  PyDict_SetItemString(kwargs, "epochs", ep);
+  Py_DECREF(ep);
+  PyDict_SetItemString(kwargs, "verbose", Py_False);
+  PyObject* meth = getattr_checked(model->obj, "fit");
+  if (!meth) {
+    Py_DECREF(kwargs);
+    Py_DECREF(xl);
+    Py_DECREF(ya);
+    return -1;
+  }
+  PyObject* args = PyTuple_Pack(2, xl, ya);
+  PyObject* pm = PyObject_Call(meth, args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(meth);
+  Py_DECREF(kwargs);
+  Py_DECREF(xl);
+  Py_DECREF(ya);
+  if (!pm) {
+    capture_py_error();
+    return -1;
+  }
+  if (out_accuracy) {
+    PyObject* acc = getattr_checked(pm, "accuracy");
+    *out_accuracy = acc ? PyFloat_AsDouble(acc) : -1.0;
+    Py_XDECREF(acc);
+  }
+  if (out_throughput) {
+    PyObject* th = PyObject_CallMethod(pm, "throughput", nullptr);
+    *out_throughput = th ? PyFloat_AsDouble(th) : -1.0;
+    Py_XDECREF(th);
+  }
+  Py_DECREF(pm);
+  return 0;
+}
+
+int64_t flexflow_model_eval(ff_handle* model, int n_inputs, const void** xs,
+                            const int64_t* const* xdims, const int* x_ndims,
+                            const int* x_dtypes, float* out, int64_t out_len) {
+  PyObject* xl = np_array_list(n_inputs, xs, xdims, x_ndims, x_dtypes);
+  if (!xl) return -1;
+  PyObject* r = PyObject_CallMethod(model->obj, "eval_batch", "O", xl);
+  Py_DECREF(xl);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* np = np_module();
+  PyObject* arr =
+      np ? PyObject_CallMethod(np, "asarray", "Os", r, "float32") : nullptr;
+  Py_DECREF(r);
+  if (!arr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* flat = PyObject_CallMethod(arr, "ravel", nullptr);
+  Py_DECREF(arr);
+  PyObject* bytes =
+      flat ? PyObject_CallMethod(flat, "tobytes", nullptr) : nullptr;
+  Py_XDECREF(flat);
+  if (!bytes) {
+    capture_py_error();
+    return -1;
+  }
+  char* buf;
+  Py_ssize_t blen;
+  PyBytes_AsStringAndSize(bytes, &buf, &blen);
+  int64_t n = blen / (int64_t)sizeof(float);
+  if (n > out_len) n = out_len;
+  std::memcpy(out, buf, n * sizeof(float));
+  Py_DECREF(bytes);
+  return n;
+}
+
+// ------------------------------------------------------- weight access
+// Reference: flexflow_tensor get/set family (flexflow_c.cc); names are
+// newline-separated "layer/weight" pairs.
+int64_t flexflow_model_weight_names(ff_handle* model, char* buf,
+                                    int64_t buf_len) {
+  PyObject* w = PyObject_CallMethod(model->obj, "get_weights", nullptr);
+  if (!w) {
+    capture_py_error();
+    return -1;
+  }
+  std::string out;
+  PyObject *lk, *lv;
+  Py_ssize_t lpos = 0;
+  while (PyDict_Next(w, &lpos, &lk, &lv)) {
+    const char* lname = PyUnicode_AsUTF8(lk);
+    PyObject *wk, *wv;
+    Py_ssize_t wpos = 0;
+    while (PyDict_Next(lv, &wpos, &wk, &wv)) {
+      const char* wname = PyUnicode_AsUTF8(wk);
+      if (lname && wname) {
+        out += lname;
+        out += "/";
+        out += wname;
+        out += "\n";
+      }
+    }
+  }
+  Py_DECREF(w);
+  int64_t need = (int64_t)out.size() + 1;
+  if (buf && buf_len >= need) std::memcpy(buf, out.c_str(), need);
+  return need;
+}
+
+static PyObject* get_weight_array(ff_handle* model, const char* layer_name,
+                                  const char* weight_name) {
+  PyObject* w = PyObject_CallMethod(model->obj, "get_weights", nullptr);
+  if (!w) {
+    capture_py_error();
+    return nullptr;
+  }
+  PyObject* lw = PyDict_GetItemString(w, layer_name);  // borrowed
+  PyObject* arr = lw ? PyDict_GetItemString(lw, weight_name) : nullptr;
+  if (!arr) {
+    g_last_error = std::string("no weight ") + layer_name + "/" + weight_name;
+    Py_DECREF(w);
+    return nullptr;
+  }
+  Py_INCREF(arr);
+  Py_DECREF(w);
+  return arr;
+}
+
+int64_t flexflow_model_get_weight(ff_handle* model, const char* layer_name,
+                                  const char* weight_name, float* out,
+                                  int64_t out_len) {
+  PyObject* arr = get_weight_array(model, layer_name, weight_name);
+  if (!arr) return -1;
+  PyObject* np = np_module();
+  PyObject* f32 =
+      np ? PyObject_CallMethod(np, "asarray", "Os", arr, "float32") : nullptr;
+  Py_DECREF(arr);
+  if (!f32) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* flat = PyObject_CallMethod(f32, "ravel", nullptr);
+  Py_DECREF(f32);
+  PyObject* bytes =
+      flat ? PyObject_CallMethod(flat, "tobytes", nullptr) : nullptr;
+  Py_XDECREF(flat);
+  if (!bytes) {
+    capture_py_error();
+    return -1;
+  }
+  char* buf;
+  Py_ssize_t blen;
+  PyBytes_AsStringAndSize(bytes, &buf, &blen);
+  int64_t n = blen / (int64_t)sizeof(float);
+  if (out && n <= out_len) std::memcpy(out, buf, n * sizeof(float));
+  Py_DECREF(bytes);
+  return n;  // element count (query with out=NULL to size the buffer)
+}
+
+int flexflow_model_set_weight(ff_handle* model, const char* layer_name,
+                              const char* weight_name, const float* data,
+                              const int64_t* dims, int ndim) {
+  PyObject* arr = np_array_copy(data, dims, ndim, "float32");
+  if (!arr) return -1;
+  PyObject* inner = PyDict_New();
+  PyDict_SetItemString(inner, weight_name, arr);
+  Py_DECREF(arr);
+  PyObject* outer = PyDict_New();
+  PyDict_SetItemString(outer, layer_name, inner);
+  Py_DECREF(inner);
+  PyObject* r =
+      PyObject_CallMethod(model->obj, "set_weights", "O", outer);
+  Py_DECREF(outer);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
 }
 
 int64_t flexflow_model_num_parameters(ff_handle* model) {

@@ -56,6 +56,26 @@ ff_handle* flexflow_model_dropout(ff_handle* model, ff_handle* input,
 ff_handle* flexflow_model_multihead_attention(ff_handle* model, ff_handle* q,
                                               ff_handle* k, ff_handle* v,
                                               int embed_dim, int num_heads);
+ff_handle* flexflow_model_batch_norm(ff_handle* model, ff_handle* input,
+                                     int relu);
+ff_handle* flexflow_model_layer_norm(ff_handle* model, ff_handle* input);
+ff_handle* flexflow_model_reshape(ff_handle* model, ff_handle* input, int ndim,
+                                  const int64_t* dims);
+ff_handle* flexflow_model_transpose(ff_handle* model, ff_handle* input,
+                                    int ndim, const int* perm);
+/* writes n_outputs handles into outs; sizes has n_outputs entries */
+int flexflow_model_split(ff_handle* model, ff_handle* input, int n_outputs,
+                         const int64_t* sizes, int axis, ff_handle** outs);
+ff_handle* flexflow_model_subtract(ff_handle* model, ff_handle* a,
+                                   ff_handle* b);
+ff_handle* flexflow_model_multiply(ff_handle* model, ff_handle* a,
+                                   ff_handle* b);
+ff_handle* flexflow_model_batch_matmul(ff_handle* model, ff_handle* a,
+                                       ff_handle* b);
+/* composite MoE block (reference FFModel::moe, src/ops/moe.cc:20-44) */
+ff_handle* flexflow_model_moe(ff_handle* model, ff_handle* input,
+                              int num_experts, int top_k, int hidden,
+                              double alpha, double lambda_bal);
 
 /* compile.  loss: 0=sparse-cce 1=cce 2=mse-avg; optimizer: 0=SGD 1=Adam */
 int flexflow_model_compile(ff_handle* model, int loss, int optimizer,
@@ -69,6 +89,30 @@ int flexflow_model_fit_f32(ff_handle* model, const float* x,
 int64_t flexflow_model_eval_f32(ff_handle* model, const float* x,
                                 const int64_t* xdims, int x_ndim, float* out,
                                 int64_t out_len);
+
+/* multi-input train/eval: xs[i] typed by x_dtypes[i] (0=f32 1=i32 2=i64),
+ * shaped xdims[i][0..x_ndims[i]); labels y typed by y_dtype.  Reference
+ * multi-input DLRM path (flexflow_c.cc dataloader family). */
+int flexflow_model_fit(ff_handle* model, int n_inputs, const void** xs,
+                       const int64_t* const* xdims, const int* x_ndims,
+                       const int* x_dtypes, const void* y, int y_dtype,
+                       int epochs, double* out_accuracy,
+                       double* out_throughput);
+int64_t flexflow_model_eval(ff_handle* model, int n_inputs, const void** xs,
+                            const int64_t* const* xdims, const int* x_ndims,
+                            const int* x_dtypes, float* out, int64_t out_len);
+
+/* weight access (reference flexflow_tensor_get/set_tensor_float).
+ * Layer/weight names: newline-separated "layer/weight" listing. */
+int64_t flexflow_model_weight_names(ff_handle* model, char* buf,
+                                    int64_t buf_len);
+int64_t flexflow_model_get_weight(ff_handle* model, const char* layer_name,
+                                  const char* weight_name, float* out,
+                                  int64_t out_len);
+int flexflow_model_set_weight(ff_handle* model, const char* layer_name,
+                              const char* weight_name, const float* data,
+                              const int64_t* dims, int ndim);
+
 int64_t flexflow_model_num_parameters(ff_handle* model);
 
 #ifdef __cplusplus

@@ -50,3 +50,32 @@ def test_c_driver_trains_mlp(libflexflow_c, tmp_path_factory):
     acc = float(r.stdout.split("final accuracy:")[1].split()[0])
     assert acc > 0.7, r.stdout
     assert "parameters:" in r.stdout and "eval wrote" in r.stdout
+
+
+def test_c_driver_trains_two_input_dlrm(libflexflow_c, tmp_path_factory):
+    """Round-2 verdict item 4: a two-input (f32 dense + int32 sparse) model
+    built, trained, evaluated, and weight-round-tripped entirely from C."""
+    tmp = tmp_path_factory.mktemp("capi_dlrm")
+    exe = str(tmp / "dlrm_c")
+    build_dir = os.path.dirname(libflexflow_c)
+    subprocess.run(
+        [
+            "cc", "-O2", os.path.join(REPO, "examples", "c", "dlrm.c"),
+            "-I" + os.path.join(REPO, "native"),
+            "-L" + build_dir, "-lflexflow_c",
+            "-Wl,-rpath," + build_dir,
+            "-o", exe,
+        ],
+        check=True, capture_output=True,
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [exe], env=env, capture_output=True, text=True, timeout=420
+    )
+    assert r.returncode == 0, f"rc={r.returncode}\nstdout:{r.stdout}\nstderr:{r.stderr}"
+    acc = float(r.stdout.split("final accuracy:")[1].split()[0])
+    assert acc > 0.7, r.stdout
+    assert "weight roundtrip ok" in r.stdout
+    assert "eval wrote 1024 floats" in r.stdout

@@ -1,0 +1,50 @@
+"""Keras-frontend MNIST MLP with an accuracy gate (reference
+``examples/python/keras/func_mnist_mlp.py`` + the ModelAccuracy assert
+pattern from ``examples/python/keras/accuracy.py``).
+
+Exits nonzero if final training accuracy misses the gate — the CI
+behavior of the reference's accuracy-asserting example runs."""
+
+import argparse
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras as K
+from flexflow_tpu.frontends.keras.accuracy import ModelAccuracy
+from flexflow_tpu.frontends.keras.datasets import mnist
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-e", "--epochs", type=int, default=3)
+    ap.add_argument("-b", "--batch-size", type=int, default=64)
+    ap.add_argument("-n", "--samples", type=int, default=4096)
+    args, _ = ap.parse_known_args()
+
+    (x_train, y_train), _ = mnist.load_data(
+        n_train=args.samples, n_test=256
+    )
+    x = (x_train.reshape(len(x_train), 784).astype(np.float32)) / 255.0
+    y = y_train.astype(np.int32).reshape(-1, 1)
+
+    model = K.Sequential([
+        K.Dense(128, activation="relu"),
+        K.Dense(64, activation="relu"),
+        K.Dense(10, activation="softmax"),
+    ])
+    model.compile(optimizer=K.SGD(learning_rate=0.1),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    pm = model.fit(x, y, batch_size=args.batch_size, epochs=args.epochs)
+    acc = 100.0 * pm.accuracy
+    gate = ModelAccuracy.MNIST_MLP.value
+    print(f"final accuracy: {acc:.2f}% (gate {gate}%)")
+    if acc < gate:
+        print("ACCURACY GATE FAILED", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

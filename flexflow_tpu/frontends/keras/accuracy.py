@@ -1,0 +1,15 @@
+"""Accuracy gates for example CI (reference
+``examples/python/keras/accuracy.py`` ModelAccuracy thresholds, consumed
+by ~40 accuracy-asserting example runs in ``tests/multi_gpu_tests.sh``)."""
+
+from enum import Enum
+
+
+class ModelAccuracy(Enum):
+    """Minimum final training accuracy (percent) per example config."""
+
+    MNIST_MLP = 90
+    MNIST_CNN = 90
+    REUTERS_MLP = 90
+    CIFAR10_CNN = 90
+    CIFAR10_ALEXNET = 90

@@ -1,0 +1,18 @@
+"""Keras-compatible dataset loaders (reference
+``python/flexflow/keras/datasets/{mnist,cifar10,reuters}.py``).
+
+This environment has zero network egress, so each loader resolves in
+order:
+  1. a local cached copy (``$FFTPU_DATASETS`` or ``~/.keras/datasets``) in
+     the standard keras archive format;
+  2. a clearly-labeled deterministic SYNTHETIC stand-in with the same
+     shapes/dtypes and a learnable class structure, so examples and
+     accuracy-gated CI run anywhere.
+
+``load_data(synthetic=False)`` forces a FileNotFoundError instead of the
+synthetic fallback when real data is required.
+"""
+
+from flexflow_tpu.frontends.keras.datasets import cifar10, mnist, reuters
+
+__all__ = ["cifar10", "mnist", "reuters"]

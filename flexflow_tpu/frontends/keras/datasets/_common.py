@@ -1,0 +1,23 @@
+"""Shared cache-resolution logic for the dataset loaders."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def cache_path(filename: str) -> Optional[str]:
+    """First existing copy of ``filename`` in the dataset search path:
+    ``$FFTPU_DATASETS`` then ``~/.keras/datasets`` (the reference's
+    ``get_file`` cache dir, ``keras/utils/data_utils.py``)."""
+    candidates = []
+    env = os.environ.get("FFTPU_DATASETS")
+    if env:
+        candidates.append(os.path.join(env, filename))
+    candidates.append(
+        os.path.join(os.path.expanduser("~"), ".keras", "datasets", filename)
+    )
+    for c in candidates:
+        if os.path.exists(c):
+            return c
+    return None

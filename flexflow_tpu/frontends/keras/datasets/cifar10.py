@@ -1,0 +1,64 @@
+"""CIFAR-10 loader (reference ``python/flexflow/keras/datasets/cifar10.py``
++ ``cifar.py`` batch unpickling): ``load_data() -> (x_train, y_train),
+(x_test, y_test)`` with x uint8 (n, 3, 32, 32) and y uint8 (n, 1).
+
+Resolution: cached ``cifar-10-batches-py`` directory (the standard pickle
+batches the reference unpacks) else a deterministic synthetic stand-in
+with class-conditional color/texture structure.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from flexflow_tpu.frontends.keras.datasets._common import cache_path
+
+
+def _load_batch(fpath: str):
+    with open(fpath, "rb") as f:
+        d = pickle.load(f, encoding="bytes")
+    data = d[b"data"].reshape(-1, 3, 32, 32)
+    labels = np.asarray(d[b"labels"], np.uint8)
+    return data, labels
+
+
+def _synthetic(n_train: int, n_test: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    templates = np.zeros((10, 3, 32, 32), np.float32)
+    for c in range(10):
+        coarse = rng.normal(size=(3, 8, 8)).astype(np.float32)
+        templates[c] = np.kron(coarse, np.ones((4, 4), np.float32))
+
+    def make(n):
+        y = rng.integers(0, 10, size=(n, 1)).astype(np.uint8)
+        x = templates[y[:, 0]] * 60.0 + 128.0 + rng.normal(
+            scale=25.0, size=(n, 3, 32, 32)
+        ).astype(np.float32)
+        return np.clip(x, 0, 255).astype(np.uint8), y
+
+    x_train, y_train = make(n_train)
+    x_test, y_test = make(n_test)
+    return (x_train, y_train), (x_test, y_test)
+
+
+def load_data(synthetic: bool = True, n_train: int = 50000,
+              n_test: int = 10000):
+    root = cache_path("cifar-10-batches-py")
+    if root is not None and os.path.isdir(root):
+        xs, ys = [], []
+        for i in range(1, 6):
+            x, y = _load_batch(os.path.join(root, f"data_batch_{i}"))
+            xs.append(x)
+            ys.append(y)
+        x_train = np.concatenate(xs)
+        y_train = np.concatenate(ys).reshape(-1, 1)
+        x_test, y_test = _load_batch(os.path.join(root, "test_batch"))
+        return (x_train, y_train), (x_test, y_test.reshape(-1, 1))
+    if not synthetic:
+        raise FileNotFoundError(
+            "cifar-10-batches-py not cached and downloads are unavailable"
+        )
+    return _synthetic(n_train, n_test)

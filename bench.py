@@ -108,14 +108,21 @@ def run_bench(backend: str) -> None:
     loss, _ = model.executor.train_step([x], y)
     float(loss)
 
+    # median of N independent timing windows: the tunneled link shows
+    # ±10% run-to-run variance, and the round-2 committed claim vs the
+    # driver artifact disagreed because a single window cherry-picks
     steps = 20 if on_tpu else 3
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss, _ = model.executor.train_step([x], y)
-    float(loss)  # forces materialization of the whole chain
-    dt = time.perf_counter() - t0
-
-    samples_per_sec = steps * batch / dt
+    repeats = 5 if on_tpu else 2
+    window_sps = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, _ = model.executor.train_step([x], y)
+        float(loss)  # forces materialization of the whole chain
+        window_sps.append(steps * batch / (time.perf_counter() - t0))
+    window_sps.sort()
+    samples_per_sec = window_sps[len(window_sps) // 2]
+    dt = steps * batch / samples_per_sec
     # fwd FLOPs from the op inventory; train step ~ 3x fwd (fwd + bwd 2x)
     fwd_flops = sum(
         get_op_def(l.op_type).flops(l)
@@ -141,6 +148,9 @@ def run_bench(backend: str) -> None:
                 "step_time_ms": round(1000.0 * dt / steps, 2),
                 "mfu": round(mfu, 4) if mfu is not None else None,
                 "peak_flops": peak,
+                "sps_min": round(window_sps[0], 2),
+                "sps_max": round(window_sps[-1], 2),
+                "timing_windows": repeats,
             }
         )
     )

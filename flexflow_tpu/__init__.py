@@ -6,6 +6,24 @@ hardware-aware strategy search.  See SURVEY.md for the layer-by-layer
 mapping to the reference.
 """
 
+import os as _os
+
+import jax as _jax
+
+# Environment-pinned platform selection must go through the CONFIG, not
+# just the env var: with the axon TPU plugin (sitecustomize), backend
+# discovery still initializes the TPU tunnel under JAX_PLATFORMS=cpu and
+# HANGS (not errors) when the tunnel is down — only jax_platforms
+# restricts discovery itself (same guard as bench.py/__graft_entry__).
+# Honoring the env var here makes `JAX_PLATFORMS=cpu python example.py`
+# reliable for every entry point, including embedded C drivers.
+_plat = _os.environ.get("JAX_PLATFORMS", "")
+if _plat and "axon" not in _plat and "tpu" not in _plat:
+    try:
+        _jax.config.update("jax_platforms", _plat)
+    except Exception:  # backends already initialized: leave them be
+        pass
+
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.fftype import (
     ActiMode,

@@ -81,9 +81,23 @@ class MultiHeadAttention(OpDef):
         b, sq, _ = q_in.shape
         sk = k_in.shape[1]
 
-        q = (q_in @ params["wq"]).reshape(b, sq, h, kd).transpose(0, 2, 1, 3)
-        k = (k_in @ params["wk"]).reshape(b, sk, h, kd).transpose(0, 2, 1, 3)
-        v = (v_in @ params["wv"]).reshape(b, sk, h, vd).transpose(0, 2, 1, 3)
+        if q_in is k_in and k_in is v_in and kd == vd:
+            # self-attention: one fused (E, 3·H·D) projection matmul keeps
+            # the MXU busy with a single wide GEMM instead of three narrow
+            # ones (round-2 verdict item 2); the weight concat is a few MB
+            # and XLA CSEs it across the backward pass
+            wqkv = jnp.concatenate(
+                [params["wq"], params["wk"], params["wv"]], axis=1
+            )
+            qkv = q_in @ wqkv
+            qp, kp, vp = jnp.split(qkv, [h * kd, 2 * h * kd], axis=-1)
+            q = qp.reshape(b, sq, h, kd).transpose(0, 2, 1, 3)
+            k = kp.reshape(b, sk, h, kd).transpose(0, 2, 1, 3)
+            v = vp.reshape(b, sk, h, vd).transpose(0, 2, 1, 3)
+        else:
+            q = (q_in @ params["wq"]).reshape(b, sq, h, kd).transpose(0, 2, 1, 3)
+            k = (k_in @ params["wk"]).reshape(b, sk, h, kd).transpose(0, 2, 1, 3)
+            v = (v_in @ params["wv"]).reshape(b, sk, h, vd).transpose(0, 2, 1, 3)
 
         dropout = a.get("dropout", 0.0) if ctx.training else 0.0
 

@@ -112,7 +112,7 @@ def run_bench(backend: str) -> None:
     # ±10% run-to-run variance, and the round-2 committed claim vs the
     # driver artifact disagreed because a single window cherry-picks
     steps = 20 if on_tpu else 3
-    repeats = 5 if on_tpu else 2
+    repeats = 5 if on_tpu else 3
     window_sps = []
     for _ in range(repeats):
         t0 = time.perf_counter()

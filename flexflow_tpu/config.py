@@ -81,6 +81,11 @@ class FFConfig:
     # cost of an all-gathered param delta per step).  Beyond the reference,
     # whose optimizer state is replicated per device (optimizer_kernel.cu).
     enable_zero1: bool = False
+    # rematerialization policy for the backward pass: "none" (XLA default
+    # saves every residual), "attention" (checkpoint attention cores — the
+    # S^2-shaped residuals), or "all" (checkpoint every op).  The TPU form
+    # of trading FLOPs for HBM (jax.checkpoint).
+    remat_policy: str = "none"
     rng_seed: int = 0
     memory_search_budget: int = -1  # lambda search iterations (graph.cc:2075)
     device_memory_gb: float = -1.0  # per-device HBM budget for λ mem search
@@ -142,6 +147,8 @@ class FFConfig:
                 self.search_alpha = float(take())
             elif a == "--only-data-parallel":
                 self.only_data_parallel = True
+            elif a == "--remat":
+                self.remat_policy = take()
             elif a == "--enable-parameter-parallel":
                 self.enable_parameter_parallel = True
             elif a == "--disable-parameter-parallel":

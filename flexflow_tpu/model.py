@@ -731,6 +731,7 @@ class FFModel:
             metrics=Metrics(loss_type, metrics),
             seed=seed if seed is not None else cfg.rng_seed,
             compute_dtype=cfg.compute_dtype,
+            remat_policy=cfg.remat_policy,
             dcn_axis=cfg.dcn_axis,
             zero1=cfg.enable_zero1,
         )

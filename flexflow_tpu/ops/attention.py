@@ -81,7 +81,14 @@ class MultiHeadAttention(OpDef):
         b, sq, _ = q_in.shape
         sk = k_in.shape[1]
 
-        if q_in is k_in and k_in is v_in and kd == vd:
+        # fused path only when the projection weights are unsharded along
+        # the concat axis: under TP the shard boundaries of the fused
+        # (E, 3HD) weight would misalign with the split offsets and GSPMD
+        # would reshard every step
+        if (
+            q_in is k_in and k_in is v_in and kd == vd
+            and ctx.weight_axis("wq", 1) is None
+        ):
             # self-attention: one fused (E, 3·H·D) projection matmul keeps
             # the MXU busy with a single wide GEMM instead of three narrow
             # ones (round-2 verdict item 2); the weight concat is a few MB

@@ -154,6 +154,21 @@ class OpDef:
             total += math.prod(w.shape) * _dtype_bytes(w.dtype)
         return float(total)
 
+    def shard_degree(self, layer: Layer, sharding, mesh) -> int:
+        """How many ways this op's COMPUTE divides under ``sharding`` —
+        the cost model's degree divisor (reference: per-MachineView local
+        shapes in ``measure_operator_cost``).  Default: the output's shard
+        degree incl. partial axes.  Ops whose compute splits along WEIGHT
+        shards with a replicated output (the fused-Experts EP layout)
+        override this, or the search could never see EP's win."""
+        out0 = sharding.output[0] if sharding and sharding.output else None
+        if out0 is None:
+            return 1
+        degree = out0.total_degree(mesh)
+        for a in out0.partial_axes:
+            degree *= mesh.axis_size(a)
+        return max(1, degree)
+
     # --- parallelism metadata --------------------------------------------
     def partitionable_dims(self, layer: Layer) -> Dict[int, str]:
         """Output dims the search may shard, tagged with a semantic kind:

@@ -339,6 +339,28 @@ class Experts(OpDef):
         # scatter/gather dispatch is O(t*k*d); MXU work is the expert FFN
         return 2.0 * t * k * d * 2 + 4.0 * n * cap * d * h
 
+    def shard_degree(self, layer: Layer, sharding, mesh) -> int:
+        """EP divides the expert-FFN work by the 'expert'-axis degree of
+        the batched weights even though the OUTPUT stays token-sharded or
+        replicated (the all-to-all redistributes tokens, not outputs) —
+        without this the search prices the EP candidate like replication
+        and never discovers expert parallelism (reference: each expert is
+        its own op on its own devices, so its DP sees the split natively)."""
+        base = super().shard_degree(layer, sharding, mesh)
+        ws = sharding.weights.get("w1") if sharding else None
+        if ws is not None:
+            out0 = sharding.output[0] if sharding.output else None
+            seen = set(out0.used_axes()) if out0 is not None else set()
+            wdeg = 1
+            for a in ws.axes_of(0):
+                # an axis already splitting the output (token dim sharded
+                # over 'expert' too) is counted once — compute cannot split
+                # more ways than there are devices
+                if a not in seen:
+                    wdeg *= mesh.axis_size(a)
+            base *= max(1, wdeg)
+        return base
+
 
 register_op(GroupBy())
 register_op(Aggregate())

@@ -62,7 +62,7 @@ class Executor:
         loss_type: LossType,
         metrics: Metrics,
         seed: int = 0,
-        use_remat: bool = False,
+        remat_policy: str = "none",
         compute_dtype: str = "float32",
         dcn_axis: str = "data",
         zero1: bool = False,
@@ -76,7 +76,10 @@ class Executor:
         self.loss_fn = get_loss_fn(loss_type)
         self.metrics = metrics
         self.seed = seed
-        self.use_remat = use_remat
+        assert remat_policy in ("none", "attention", "all"), (
+            f"unknown remat policy {remat_policy!r}"
+        )
+        self.remat_policy = remat_policy
         self.compute_dtype = jnp.dtype(compute_dtype)
         self._mixed = self.compute_dtype != jnp.float32
         # ZeRO-1: optimizer moments sharded over the data axis (memory /dp);
@@ -196,7 +199,9 @@ class Executor:
                 op_sharding=self.strategy.op_sharding(layer),
                 seq_length=seq_length,
             )
-            if self.use_remat and layer.op_type in _REMAT_OPS:
+            if self.remat_policy == "all" or (
+                self.remat_policy == "attention" and layer.op_type in _REMAT_OPS
+            ):
                 outs = jax.checkpoint(
                     lambda p, i, _l=layer, _c=ctx: get_op_def(_l.op_type).forward(_l, p, i, _c)
                 )(lp, ins)

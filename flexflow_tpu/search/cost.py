@@ -300,16 +300,13 @@ def node_cost(
     (``try_one_lambda``, ``src/runtime/graph.cc:1884``).
     """
     m = machine or TPUMachineModel()
+    opdef = get_op_def(layer.op_type)
     out0 = sharding.output[0] if sharding.output else None
-    degree = 1
-    if out0 is not None:
-        degree = out0.total_degree(mesh)
-        for a in out0.partial_axes:
-            degree *= mesh.axis_size(a)
+    # per-op compute split (output shards, partial axes, and weight-side
+    # splits like fused-Experts EP)
+    degree = opdef.shard_degree(layer, sharding, mesh)
     # measured tier (simulator.MeasuredCostModel) overrides the roofline
     t = compute_time if compute_time is not None else op_compute_time(layer, degree, m)
-
-    opdef = get_op_def(layer.op_type)
     # gradient sync: weight grads are partial over every mesh axis that
     # shards the op's *data* (batch/seq) but not the weight itself
     data_axes = set()

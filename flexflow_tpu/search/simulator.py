@@ -188,12 +188,9 @@ class MeasuredCostModel:
         t = self.profiler.measure(layer, sharding, self.mesh)
         if t > 0:
             return t
-        out0 = sharding.output[0] if sharding and sharding.output else None
-        degree = 1
-        if out0 is not None:
-            degree = out0.total_degree(self.mesh)
-            for a in out0.partial_axes:
-                degree *= self.mesh.axis_size(a)
+        degree = get_op_def(layer.op_type).shard_degree(
+            layer, sharding, self.mesh
+        )
         return op_compute_time(layer, degree, self.machine)
 
 

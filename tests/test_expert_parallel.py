@@ -160,3 +160,26 @@ def test_ep_search_candidate_exists():
         for c in cands
         if c.weights.get("w1") is not None
     ), "no expert-parallel candidate enumerated"
+
+
+def test_search_discovers_expert_parallelism():
+    """Unity search must price the EP candidate by its weight-side compute
+    split (Experts.shard_degree) and pick it on an expert-axis mesh — the
+    reference discovers EP by placing each expert's ops on distinct
+    devices (SURVEY §2.4 EP checklist)."""
+    from flexflow_tpu.search import SearchHelper
+    from flexflow_tpu.parallel.strategy import Strategy
+
+    model = build(fused=True)
+    mesh = MachineMesh((1, 1, 4), ("data", "model", "expert"))
+    helper = SearchHelper(model.layers, model.graph_inputs, mesh)
+    _, assign = helper.solve()
+    st = Strategy(mesh)
+    st.ops = assign
+    ex_layer = next(l for l in model.layers if l.op_type.value == "experts")
+    s = st.op_sharding(ex_layer)
+    assert s is not None, "search left the Experts op unassigned"
+    w1 = s.weights.get("w1")
+    assert w1 is not None and "expert" in w1.axes_of(0), (
+        f"search did not shard experts over the expert axis: {s.weights}"
+    )

@@ -155,6 +155,35 @@ def _node_ir(node, modules, root=None) -> Optional[Dict[str, Any]]:
             ir["op"] = "identity"
         elif t in ("ReLU", "GELU", "Sigmoid", "Tanh", "ELU"):
             ir["op"] = t.lower()
+        elif t == "MultiheadAttention":
+            # fx output is a (attn_output, attn_weights) tuple; consumers
+            # getitem index 0 (reference AttentionNode handling)
+            if not getattr(m, "_qkv_same_embed_dim", True):
+                raise NotImplementedError(
+                    f"{node.target}: separate-projection MultiheadAttention "
+                    "(kdim/vdim set) is not supported by the importer"
+                )
+            if len(node.args) > 3:
+                raise NotImplementedError(
+                    f"{node.target}: positional mask arguments are not supported"
+                )
+            bad = {"attn_mask", "key_padding_mask"} & {
+                k for k, v in node.kwargs.items() if v is not None
+            }
+            if bad:
+                raise NotImplementedError(
+                    f"{node.target}: {sorted(bad)} not supported — masked "
+                    "attention must be imported as decomposed ops"
+                )
+            ir["op"] = "torch_mha"
+            ir["attrs"] = {
+                "embed_dim": m.embed_dim,
+                "num_heads": m.num_heads,
+                "dropout": m.dropout,
+                "batch_first": bool(getattr(m, "batch_first", False)),
+                "bias": m.in_proj_bias is not None,
+                "causal": bool(node.kwargs.get("is_causal", False)),
+            }
         else:
             raise NotImplementedError(f"torch module {t} ({node.target})")
         return ir
@@ -351,6 +380,19 @@ def torch_to_ff(module, filename: str) -> List[Dict[str, Any]]:
 # IR -> FFModel
 # --------------------------------------------------------------------------
 
+class _Unsupported:
+    """Placeholder for a traced value the importer cannot materialize;
+    any use raises with the import-site context instead of an obscure
+    downstream failure."""
+
+    def __init__(self, why: str):
+        self.__dict__["_why"] = why
+
+    def __getattr__(self, item):
+        raise NotImplementedError(self.__dict__["_why"])
+
+
+
 class PyTorchModel:
     """Reference ``flexflow.torch.model.PyTorchModel``: construct from a
     live module (fx-traced on the fly) or a ``.ff`` file; ``apply``
@@ -475,6 +517,35 @@ class PyTorchModel:
             ai, bi = a["a"] % x.ndim, a["b"] % x.ndim
             perm[ai], perm[bi] = perm[bi], perm[ai]
             return model.transpose(x, perm, name=name)
+        if op == "torch_mha":
+            q0, k0, v0 = (ins + [ins[0]] * 3)[:3]
+            q, k, v = q0, k0, v0
+            if not a["batch_first"]:
+                # torch default layout is (S, B, E); our op is batch-major.
+                # identity of q/k/v must be preserved through the layout
+                # fix so self-attention keeps the fused-QKV projection
+                q = model.transpose(q0, [1, 0, 2], name=f"{name}_qbf")
+                k = q if k0 is q0 else model.transpose(k0, [1, 0, 2], name=f"{name}_kbf")
+                v = q if v0 is q0 else (
+                    k if v0 is k0
+                    else model.transpose(v0, [1, 0, 2], name=f"{name}_vbf")
+                )
+            t = model.multihead_attention(
+                q, k, v, a["embed_dim"], a["num_heads"],
+                dropout=a.get("dropout", 0.0), bias=a.get("bias", True),
+                causal=a.get("causal", False), name=name,
+            )
+            # weight transfer must target the attention layer, not any
+            # layout transpose appended after it
+            self.layer_names[name] = model.layers[-1].name
+            if not a["batch_first"]:
+                t = model.transpose(t, [1, 0, 2], name=f"{name}_obf")
+            # torch returns (output, attn_weights); averaged weights are
+            # not materialized here, so consuming them fails loudly
+            return [t, _Unsupported(
+                f"{name}: attention-weights output of nn.MultiheadAttention "
+                "is not materialized by the importer"
+            )]
         if op == "parameter":
             return model.parameter(
                 a["shape"], DataType(a["dtype"]),
@@ -644,6 +715,17 @@ class PyTorchModel:
                 ws.update(scale=sd["weight"], bias=sd["bias"])
         elif tt == "Embedding":
             ws["kernel"] = sd["weight"]
+        elif tt == "MultiheadAttention":
+            w = sd["in_proj_weight"]  # (3E, E) packed q/k/v rows
+            e = w.shape[1]
+            ws["wq"], ws["wk"], ws["wv"] = (
+                w[:e].T, w[e:2 * e].T, w[2 * e:].T,
+            )
+            ws["wo"] = sd["out_proj.weight"].T
+            if "in_proj_bias" in sd:
+                bi = sd["in_proj_bias"]
+                ws["bq"], ws["bk"], ws["bv"] = bi[:e], bi[e:2 * e], bi[2 * e:]
+                ws["bo"] = sd["out_proj.bias"]
         else:
             return
         weights[lname] = ws

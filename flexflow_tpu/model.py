@@ -264,9 +264,13 @@ class FFModel:
         dropout: float = 0.0,
         causal: bool = False,
         use_flash: bool = True,
+        bias: bool = False,
         kernel_initializer: Optional[Initializer] = None,
         name: Optional[str] = None,
     ) -> Tensor:
+        """Reference ``FFModel::multihead_attention``
+        (``include/flexflow/model.h:336-554``): ``bias`` adds projection
+        biases (bq/bk/bv/bo) like the reference's bias flag."""
         return self._add_layer(
             OperatorType.MULTIHEAD_ATTENTION,
             self._name("attention", name),
@@ -279,6 +283,7 @@ class FFModel:
                 dropout=dropout,
                 causal=causal,
                 use_flash=use_flash,
+                bias=bias,
                 kernel_initializer=kernel_initializer,
             ),
         )[0]

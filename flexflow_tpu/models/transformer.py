@@ -88,6 +88,68 @@ def transformer_encoder(
     return t
 
 
+def decoder_layer(
+    model: FFModel,
+    t: Tensor,
+    hidden: int,
+    heads: int,
+    ff_dim: int,
+    dropout: float = 0.0,
+    use_flash: bool = True,
+    name: str = "dec",
+) -> Tensor:
+    """Pre-LN causal decoder block (GPT-2 style: ln -> attn -> res,
+    ln -> FFN -> res).  Same op vocabulary as the reference's encoder
+    (``transformer.cc:33-55``) with causal masking — the causal core
+    dispatches to the flash kernel / ring attention like any other
+    attention, so the long-context path covers decoders too."""
+    h = model.layer_norm(t, axes=[-1], name=f"{name}_ln0")
+    attn = model.multihead_attention(
+        h, h, h, hidden, heads, dropout=dropout, causal=True,
+        use_flash=use_flash, name=f"{name}_attn",
+    )
+    t = model.add(attn, t, name=f"{name}_res0")
+    h = model.layer_norm(t, axes=[-1], name=f"{name}_ln1")
+    ff = model.dense(h, ff_dim, ActiMode.GELU, name=f"{name}_ff0")
+    ff = model.dense(ff, hidden, name=f"{name}_ff1")
+    if dropout > 0.0:
+        ff = model.dropout(ff, dropout, name=f"{name}_drop")
+    return model.add(ff, t, name=f"{name}_res1")
+
+
+def gpt_decoder(
+    model: FFModel,
+    batch: int,
+    seq: int,
+    hidden: int = 768,
+    heads: int = 12,
+    ff_dim: int = 3072,
+    num_layers: int = 12,
+    vocab: int = 50257,
+    dropout: float = 0.0,
+    use_flash: bool = True,
+) -> Tensor:
+    """Causal LM (GPT-2 style): token embedding + learned positional
+    parameter, pre-LN causal blocks, final LN, tied-shape LM head.
+    Returns next-token softmax reshaped to (batch*seq, vocab) for the
+    sparse-CCE loss."""
+    ids = model.create_tensor((batch, seq), DataType.INT32, name="token_ids")
+    t = model.embedding(ids, vocab, hidden, name="tok_embed")
+    pos = model.parameter((seq, hidden), name="pos_embed")
+    t = model.add(t, pos, name="embed_add")  # (B,S,H) + (S,H) broadcast
+    for i in range(num_layers):
+        t = decoder_layer(
+            model, t, hidden, heads, ff_dim, dropout, use_flash, name=f"dec{i}"
+        )
+    t = model.layer_norm(t, axes=[-1], name="final_ln")
+    t = model.dense(t, vocab, use_bias=False, name="lm_head")
+    t = model.reshape(t, (batch * seq, vocab), name="lm_flatten")
+    return model.softmax(t, name="lm_softmax")
+
+
 # BERT configs (for BASELINE.md config 3)
 BERT_BASE = dict(hidden=768, heads=12, ff_dim=3072, num_layers=12)
 BERT_LARGE = dict(hidden=1024, heads=16, ff_dim=4096, num_layers=24)
+# GPT-2 configs (causal-LM family for the decoder path)
+GPT2_SMALL = dict(hidden=768, heads=12, ff_dim=3072, num_layers=12)
+GPT2_MEDIUM = dict(hidden=1024, heads=16, ff_dim=4096, num_layers=24)

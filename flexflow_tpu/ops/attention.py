@@ -65,12 +65,23 @@ class MultiHeadAttention(OpDef):
         init = a.get("kernel_initializer") or default_kernel_initializer()
         dt = q.dtype
         # Layouts put the head(*head_dim) axis last => TP shards the lane dim.
-        return [
+        ws = [
             WeightSpec("wq", (q.shape[-1], h * kd), dt, init, tp_dim=1),
             WeightSpec("wk", (k.shape[-1], h * kd), dt, init, tp_dim=1),
             WeightSpec("wv", (v.shape[-1], h * vd), dt, init, tp_dim=1),
             WeightSpec("wo", (h * vd, e), dt, init, tp_dim=0),
         ]
+        if a.get("bias"):
+            from flexflow_tpu.initializer import default_bias_initializer
+
+            zi = default_bias_initializer()
+            ws += [
+                WeightSpec("bq", (h * kd,), dt, zi, tp_dim=0),
+                WeightSpec("bk", (h * kd,), dt, zi, tp_dim=0),
+                WeightSpec("bv", (h * vd,), dt, zi, tp_dim=0),
+                WeightSpec("bo", (e,), dt, zi),
+            ]
+        return ws
 
     def forward(self, layer, params, inputs, ctx: OpContext):
         q_in, k_in, v_in = inputs[:3]
@@ -97,14 +108,23 @@ class MultiHeadAttention(OpDef):
                 [params["wq"], params["wk"], params["wv"]], axis=1
             )
             qkv = q_in @ wqkv
+            if a.get("bias"):
+                qkv = qkv + jnp.concatenate(
+                    [params["bq"], params["bk"], params["bv"]]
+                )
             qp, kp, vp = jnp.split(qkv, [h * kd, 2 * h * kd], axis=-1)
             q = qp.reshape(b, sq, h, kd).transpose(0, 2, 1, 3)
             k = kp.reshape(b, sk, h, kd).transpose(0, 2, 1, 3)
             v = vp.reshape(b, sk, h, vd).transpose(0, 2, 1, 3)
         else:
-            q = (q_in @ params["wq"]).reshape(b, sq, h, kd).transpose(0, 2, 1, 3)
-            k = (k_in @ params["wk"]).reshape(b, sk, h, kd).transpose(0, 2, 1, 3)
-            v = (v_in @ params["wv"]).reshape(b, sk, h, vd).transpose(0, 2, 1, 3)
+            qp = q_in @ params["wq"]
+            kp = k_in @ params["wk"]
+            vp = v_in @ params["wv"]
+            if a.get("bias"):
+                qp, kp, vp = qp + params["bq"], kp + params["bk"], vp + params["bv"]
+            q = qp.reshape(b, sq, h, kd).transpose(0, 2, 1, 3)
+            k = kp.reshape(b, sk, h, kd).transpose(0, 2, 1, 3)
+            v = vp.reshape(b, sk, h, vd).transpose(0, 2, 1, 3)
 
         dropout = a.get("dropout", 0.0) if ctx.training else 0.0
 
@@ -152,7 +172,10 @@ class MultiHeadAttention(OpDef):
             else:
                 out = ring_attention(q, k, v, **kw)
             out = out.transpose(0, 2, 1, 3).reshape(b, sq, h * vd)
-            return [out @ params["wo"]]
+            out = out @ params["wo"]
+            if a.get("bias"):
+                out = out + params["bo"]
+            return [out]
 
         use_flash = a.get("use_flash", True) and kd == vd
         # the memory threshold is per-DEVICE: divide the global (b, h)
@@ -182,7 +205,10 @@ class MultiHeadAttention(OpDef):
             out = sdpa(q, k, v, causal=a.get("causal", False),
                        dropout_rate=dropout, rng=rng)
         out = out.transpose(0, 2, 1, 3).reshape(b, sq, h * vd)
-        return [out @ params["wo"]]
+        out = out @ params["wo"]
+        if a.get("bias"):
+            out = out + params["bo"]
+        return [out]
 
     def flops(self, layer: Layer) -> float:
         q, k, v = layer.inputs[:3]

@@ -488,3 +488,32 @@ def test_accuracy_gated_mnist_example():
     )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "final accuracy:" in r.stdout
+
+
+class _TorchMHABlock(torch.nn.Module):
+    """nn.MultiheadAttention consumer (reference AttentionNode import,
+    ``python/flexflow/torch/model.py``): tuple output + getitem 0."""
+
+    def __init__(self, d=32, h=4):
+        super().__init__()
+        self.attn = torch.nn.MultiheadAttention(d, h, batch_first=True)
+        self.ln = torch.nn.LayerNorm(d)
+        self.fc = torch.nn.Linear(d, 10)
+
+    def forward(self, x):
+        y, _ = self.attn(x, x, x)
+        y = self.ln(x + y)
+        return self.fc(y.mean(dim=1))
+
+
+def test_torch_nn_multihead_attention_parity():
+    torch.manual_seed(1)
+    module = _TorchMHABlock().eval()
+    ff, pt, out = _apply_torch(module, (2, 8, 32))
+    assert out.shape == (2, 10)
+    pt.transfer_weights(ff)
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, 8, 32)).astype(np.float32)
+    ours = np.asarray(ff.eval_batch([x]))
+    theirs = module(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)

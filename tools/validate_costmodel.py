@@ -1,0 +1,98 @@
+"""Validate the machine model's collective-cost SHAPE SCALING against
+measured collectives on the virtual CPU mesh.
+
+The reference validates transfer estimates implicitly by running on GPUs;
+this tool measures real XLA collectives (all-gather / all-reduce /
+all-to-all over an 8-device host mesh) at growing sizes and compares
+their scaling against ``TPUMachineModel``'s analytic formulas.  Absolute
+times differ (host mesh != ICI), but the *bytes-scaling exponent* must
+match: the analytic model is linear in bytes past the latency floor.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+    PYTHONPATH=. python tools/validate_costmodel.py
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def measure_collectives(sizes_kb=(256, 1024, 4096), n_dev=8, iters=20):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = np.asarray(jax.devices()[:n_dev])
+    mesh = Mesh(devs, ("x",))
+
+    results = {}
+    for name, body in {
+        "all_gather": lambda x: jax.lax.all_gather(x, "x"),
+        "all_reduce": lambda x: jax.lax.psum(x, "x"),
+        "all_to_all": lambda x: jax.lax.all_to_all(
+            x.reshape(n_dev, -1), "x", split_axis=0, concat_axis=0
+        ),
+    }.items():
+        times = []
+        for kb in sizes_kb:
+            n = kb * 256  # f32 elements per device shard
+            if name == "all_to_all":
+                n = max(n, n_dev * n_dev)
+                n -= n % (n_dev * n_dev)
+
+            f = jax.jit(
+                jax.shard_map(
+                    lambda x: jnp.sum(body(x)).reshape(1),
+                    mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+                    check_vma=False,
+                )
+            )
+            x = jnp.ones((n_dev * n,), jnp.float32)
+            float(f(x)[0])  # compile + warmup
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r = f(x)
+            float(r[0])
+            times.append((time.perf_counter() - t0) / iters)
+        results[name] = dict(zip(sizes_kb, times))
+    return results
+
+
+def scaling_exponent(times_by_size):
+    sizes = sorted(times_by_size)
+    t0, t1 = times_by_size[sizes[0]], times_by_size[sizes[-1]]
+    import math
+
+    return math.log(t1 / t0) / math.log(sizes[-1] / sizes[0])
+
+
+def model_exponent(coll: str, sizes_kb=(256, 4096), n=8):
+    from flexflow_tpu.search.cost import TPUMachineModel
+    import math
+
+    m = TPUMachineModel()
+    fn = getattr(m, coll.replace("all_reduce", "all_reduce"))
+    t0 = getattr(m, coll)(sizes_kb[0] * 1024.0, n)
+    t1 = getattr(m, coll)(sizes_kb[-1] * 1024.0, n)
+    return math.log(t1 / t0) / math.log(sizes_kb[-1] / sizes_kb[0])
+
+
+def main():
+    import jax
+
+    measured = measure_collectives()
+    out = {}
+    for coll, times in measured.items():
+        out[coll] = {
+            "measured_exponent": round(scaling_exponent(times), 3),
+            "model_exponent": round(model_exponent(coll), 3),
+            "times_ms": {k: round(v * 1e3, 3) for k, v in times.items()},
+        }
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import functools
 import math
+from typing import Optional
 
 import numpy as np
 
@@ -429,13 +430,32 @@ def _core_bwd(causal, dropout_rate, block_q, block_k, res, do):
 _flash_core.defvjp(_core_fwd, _core_bwd)
 
 
+def default_blocks(sq: int, sk: int) -> tuple:
+    """Adaptive block sizes: grid-step overhead dominates small tiles at
+    long sequence (s=8192 with 128x128 tiles is ~50k grid steps), so take
+    the largest MXU-friendly tiles VMEM affords — q/k/v/o blocks plus the
+    f32 score tile stay ~2 MiB at (256, 512).  Sequence lengths must be
+    128-divisible (the dispatcher gates on this); reject others here
+    rather than let a full-sequence block blow VMEM."""
+    def pick(s, prefs):
+        for b in prefs:
+            if s % b == 0:
+                return b
+        raise ValueError(
+            f"sequence length {s} is not divisible by a flash block size "
+            f"(need a multiple of 128); use the sdpa path"
+        )
+
+    return pick(sq, (256, 128)), pick(sk, (512, 256, 128))
+
+
 def flash_attention(
     q, k, v,
     causal: bool = False,
     dropout_rate: float = 0.0,
     seed=0,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ):
     """(B, H, S, D) attention; S must divide the block sizes.  Power-of-two
     head dims >= 8 (BERT: 64) go through unpadded — Mosaic accepts a block
@@ -443,6 +463,10 @@ def flash_attention(
     DOUBLE the p@v work for zero gain.  Other head dims are zero-padded to
     the 128-lane grid (exact: scale uses the true D)."""
     d = q.shape[-1]
+    if block_q is None or block_k is None:
+        dq_, dk_ = default_blocks(q.shape[2], k.shape[2])
+        block_q = block_q or dq_
+        block_k = block_k or dk_
     if d % 128 == 0 or d in (64, 32, 16, 8):
         d_pad = d
     else:

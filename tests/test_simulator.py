@@ -214,14 +214,21 @@ def test_collective_cost_scaling_matches_measured():
     bytes the way real XLA collectives do.  Absolute times differ (host
     mesh != ICI) but the log-log scaling exponent of all-reduce over a
     16x size range must land near the model's (both ~linear past the
-    latency floor).  Bounds are loose — CI timing noise."""
+    latency floor).  Wall-clock sensitive, so opt-in
+    (FFTPU_TIMING_TESTS=1); tools/validate_costmodel.py is the manual
+    driver."""
     import sys, os
+    if os.environ.get("FFTPU_TIMING_TESTS") != "1":
+        pytest.skip("timing-sensitive; set FFTPU_TIMING_TESTS=1")
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
     from validate_costmodel import (
         measure_collectives, model_exponent, scaling_exponent,
     )
 
-    measured = measure_collectives(sizes_kb=(128, 2048), iters=8)
+    measured = measure_collectives(
+        sizes_kb=(128, 2048), iters=8,
+        collectives=("all_reduce", "all_to_all"),
+    )
     for coll in ("all_reduce", "all_to_all"):
         got = scaling_exponent(measured[coll])
         want = model_exponent(coll, sizes_kb=(128, 2048))

@@ -20,22 +20,30 @@ import time
 import numpy as np
 
 
-def measure_collectives(sizes_kb=(256, 1024, 4096), n_dev=8, iters=20):
+def measure_collectives(sizes_kb=(256, 1024, 4096), n_dev=8, iters=20,
+                        collectives=None):
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
 
+    assert len(jax.devices()) >= n_dev, (
+        f"need {n_dev} devices; run under JAX_PLATFORMS=cpu "
+        f"XLA_FLAGS=--xla_force_host_platform_device_count={n_dev}"
+    )
     devs = np.asarray(jax.devices()[:n_dev])
     mesh = Mesh(devs, ("x",))
 
-    results = {}
-    for name, body in {
+    bodies = {
         "all_gather": lambda x: jax.lax.all_gather(x, "x"),
         "all_reduce": lambda x: jax.lax.psum(x, "x"),
         "all_to_all": lambda x: jax.lax.all_to_all(
             x.reshape(n_dev, -1), "x", split_axis=0, concat_axis=0
         ),
-    }.items():
+    }
+    if collectives:
+        bodies = {k: v for k, v in bodies.items() if k in collectives}
+    results = {}
+    for name, body in bodies.items():
         times = []
         for kb in sizes_kb:
             n = kb * 256  # f32 elements per device shard
@@ -74,15 +82,12 @@ def model_exponent(coll: str, sizes_kb=(256, 4096), n=8):
     import math
 
     m = TPUMachineModel()
-    fn = getattr(m, coll.replace("all_reduce", "all_reduce"))
     t0 = getattr(m, coll)(sizes_kb[0] * 1024.0, n)
     t1 = getattr(m, coll)(sizes_kb[-1] * 1024.0, n)
     return math.log(t1 / t0) / math.log(sizes_kb[-1] / sizes_kb[0])
 
 
 def main():
-    import jax
-
     measured = measure_collectives()
     out = {}
     for coll, times in measured.items():

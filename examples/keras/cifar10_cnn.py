@@ -1,9 +1,5 @@
-"""Keras-frontend MNIST MLP with an accuracy gate (reference
-``examples/python/keras/func_mnist_mlp.py`` + the ModelAccuracy assert
-pattern from ``examples/python/keras/accuracy.py``).
-
-Exits nonzero if final training accuracy misses the gate — the CI
-behavior of the reference's accuracy-asserting example runs."""
+"""CIFAR-10 CNN with an accuracy gate (reference
+``examples/python/keras/func_cifar10_cnn.py`` + ModelAccuracy.CIFAR10_CNN)."""
 
 import argparse
 import sys
@@ -12,28 +8,32 @@ import numpy as np
 
 from flexflow_tpu.frontends import keras as K
 from flexflow_tpu.frontends.keras.accuracy import ModelAccuracy
-from flexflow_tpu.frontends.keras.datasets import mnist
+from flexflow_tpu.frontends.keras.datasets import cifar10
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("-e", "--epochs", type=int, default=3)
+    ap.add_argument("-e", "--epochs", type=int, default=4)
     ap.add_argument("-b", "--batch-size", type=int, default=64)
-    ap.add_argument("-n", "--samples", type=int, default=4096)
+    ap.add_argument("-n", "--samples", type=int, default=2048)
     args, _ = ap.parse_known_args()
 
-    (x_train, y_train), _ = mnist.load_data(
+    (x_train, y_train), _ = cifar10.load_data(
         n_train=args.samples, n_test=256
     )
-    x = (x_train.reshape(len(x_train), 784).astype(np.float32)) / 255.0
-    y = y_train.astype(np.int32).reshape(-1, 1)
+    x = x_train.astype(np.float32) / 255.0
+    y = y_train.astype(np.int32)
 
     model = K.Sequential([
+        K.Conv2D(16, 3, activation="relu"),
+        K.MaxPooling2D(2),
+        K.Conv2D(32, 3, activation="relu"),
+        K.MaxPooling2D(2),
+        K.Flatten(),
         K.Dense(128, activation="relu"),
-        K.Dense(64, activation="relu"),
         K.Dense(10, activation="softmax"),
     ])
-    model.compile(optimizer=K.SGD(learning_rate=0.1),
+    model.compile(optimizer=K.Adam(learning_rate=1e-3),
                   loss="sparse_categorical_crossentropy",
                   metrics=["accuracy"])
     model.fit(x, y, batch_size=args.batch_size, epochs=args.epochs)
@@ -42,7 +42,7 @@ def main() -> int:
     # drag in the untrained first epochs)
     ev = model.evaluate(x, y, batch_size=args.batch_size)
     acc = 100.0 * ev["accuracy"]
-    gate = ModelAccuracy.MNIST_MLP.value
+    gate = ModelAccuracy.CIFAR10_CNN.value
     print(f"final accuracy: {acc:.2f}% (gate {gate}%)")
     if acc < gate:
         print("ACCURACY GATE FAILED", file=sys.stderr)

@@ -31,7 +31,7 @@ except Exception:  # onnx not in the baked image -> onnx-lite wire reader
 from flexflow_tpu.frontends import onnx_pb
 
 
-def _attrs(node) -> Dict:
+def _attrs(node, to_arr=None) -> Dict:
     out = {}
     for a in node.attribute:
         if a.type == 1:  # FLOAT
@@ -42,6 +42,8 @@ def _attrs(node) -> Dict:
             out[a.name] = list(a.ints)
         elif a.type == 3:  # STRING
             out[a.name] = a.s.decode()
+        elif a.type == 4 and to_arr is not None:  # TENSOR
+            out[a.name] = to_arr(a.t)
     return out
 
 
@@ -68,10 +70,12 @@ class ONNXModel:
             (o.version for o in self.model.opset_import if o.domain in ("", "ai.onnx")),
             13,
         )
-        # initializer name -> numpy array (weights baked into the graph)
+        # initializer name -> numpy array (weights baked into the graph);
+        # Constant/Range nodes fold their outputs in here too (apply())
         self.inits = {
             i.name: to_arr(i) for i in self.graph.initializer
         }
+        self._to_arr = to_arr
         # our layer name -> weight arrays (filled by _lower; consumed by
         # transfer_weights)
         self.weight_imports: Dict[str, Dict[str, np.ndarray]] = {}
@@ -84,9 +88,46 @@ class ONNXModel:
 
     def _lower(self, model: FFModel, node, values: Dict[str, Tensor]) -> None:
         op = node.op_type
-        a = _attrs(node)
+        a = _attrs(node, self._to_arr)
         name = node.name or f"{op}_{len(values)}"
         ins = [values[i] for i in node.input if i in values]
+
+        def operand(idx: int):
+            """Input idx as a graph tensor: traced value, or an
+            initializer/folded constant materialized as a non-trainable
+            parameter layer (value filled by transfer_weights)."""
+            iname = node.input[idx]
+            if iname in values:
+                return values[iname]
+            arr = np.asarray(self.inits[iname])
+            key = f"const:{iname}"
+            if key not in values:
+                dtmap = {"float32": DataType.FLOAT, "int32": DataType.INT32,
+                         "int64": DataType.INT64, "float64": DataType.DOUBLE,
+                         "float16": DataType.HALF, "bool": DataType.BOOLEAN,
+                         "bfloat16": DataType.BFLOAT16}
+                if str(arr.dtype) not in dtmap:
+                    raise NotImplementedError(
+                        f"{name}: constant {iname} has dtype {arr.dtype}"
+                    )
+                t = model.parameter(arr.shape, dtmap[str(arr.dtype)],
+                                    trainable=False, name=f"{name}_{iname}")
+                self.weight_imports[model.layers[-1].name] = {"value": arr}
+                values[key] = t
+            return values[key]
+
+        # graph-time constant folding: Constant and Range produce values
+        # known at import time; they join the initializer table so shape
+        # inputs (Reshape/Unsqueeze) and weights read them uniformly
+        if op == "Constant":
+            self.inits[node.output[0]] = np.asarray(a["value"])
+            return
+        if op == "Range" and all(i in self.inits for i in node.input):
+            start, limit, delta = (
+                np.asarray(self.inits[i]).item() for i in node.input
+            )
+            self.inits[node.output[0]] = np.arange(start, limit, delta)
+            return
 
         if op == "Gemm" or op == "MatMul":
             # weight comes from an initializer; out_dim = its last dim.
@@ -109,7 +150,7 @@ class ONNXModel:
             out_dim = w.shape[0] if a.get("transB") else w.shape[-1]
             winits = [self.inits[i] for i in node.input if i in self.inits]
             bias = len(winits) > 1
-            values[node.output[0]] = model.dense(ins[0], int(out_dim),
+            values[node.output[0]] = model.dense(operand(0), int(out_dim),
                                                  use_bias=bias, name=name)
             imp = {"kernel": w.T if a.get("transB") else w}
             if bias:
@@ -123,7 +164,7 @@ class ONNXModel:
             pads = a.get("pads", [0, 0, 0, 0])
             bias = len(winits) > 1
             values[node.output[0]] = model.conv2d(
-                ins[0], int(w.shape[0]), int(kh), int(kw), int(sh), int(sw),
+                operand(0), int(w.shape[0]), int(kh), int(kw), int(sh), int(sw),
                 int(pads[0]), int(pads[1]), groups=int(a.get("group", 1)),
                 use_bias=bias, name=name,
             )
@@ -138,47 +179,49 @@ class ONNXModel:
             pads = a.get("pads", [0, 0, 0, 0])
             pt = PoolType.MAX if op == "MaxPool" else PoolType.AVG
             values[node.output[0]] = model.pool2d(
-                ins[0], int(kh), int(kw), int(sh), int(sw),
+                operand(0), int(kh), int(kw), int(sh), int(sw),
                 int(pads[0]), int(pads[1]), pt, name=name,
             )
         elif op == "GlobalAveragePool":
-            t = ins[0]
+            t = operand(0)
             values[node.output[0]] = model.pool2d(
                 t, t.shape[2], t.shape[3], 1, 1, 0, 0, PoolType.AVG, name=name
             )
         elif op == "Flatten":
-            values[node.output[0]] = model.flat(ins[0], name=name)
+            values[node.output[0]] = model.flat(operand(0), name=name)
         elif op == "Relu":
-            values[node.output[0]] = model.relu(ins[0], name=name)
+            values[node.output[0]] = model.relu(operand(0), name=name)
         elif op == "Sigmoid":
-            values[node.output[0]] = model.sigmoid(ins[0], name=name)
+            values[node.output[0]] = model.sigmoid(operand(0), name=name)
         elif op == "Tanh":
-            values[node.output[0]] = model.tanh(ins[0], name=name)
+            values[node.output[0]] = model.tanh(operand(0), name=name)
         elif op == "Softmax":
             # opset >= 13 defaults axis to -1; older opsets default to 1
             # (coalesced trailing dims) — round-1 advisor finding
             default_axis = -1 if self.opset >= 13 else 1
             axis = a.get("axis", default_axis)
-            if self.opset < 13 and axis not in (-1, ins[0].ndim - 1):
+            if self.opset < 13 and axis not in (-1, operand(0).ndim - 1):
                 raise NotImplementedError(
                     f"{name}: opset-{self.opset} Softmax axis={axis} has "
                     "flatten-then-softmax semantics the importer does not model"
                 )
-            values[node.output[0]] = model.softmax(ins[0], dim=axis, name=name)
+            values[node.output[0]] = model.softmax(operand(0), dim=axis, name=name)
         elif op == "Add":
-            values[node.output[0]] = model.add(ins[0], ins[1], name=name)
+            values[node.output[0]] = model.add(operand(0), operand(1), name=name)
         elif op == "Sub":
-            values[node.output[0]] = model.subtract(ins[0], ins[1], name=name)
+            values[node.output[0]] = model.subtract(operand(0), operand(1), name=name)
         elif op == "Mul":
-            values[node.output[0]] = model.multiply(ins[0], ins[1], name=name)
+            values[node.output[0]] = model.multiply(operand(0), operand(1), name=name)
         elif op == "Concat":
-            values[node.output[0]] = model.concat(ins, axis=a.get("axis", -1), name=name)
+            values[node.output[0]] = model.concat(
+                [operand(i) for i in range(len(node.input))],
+                axis=a.get("axis", -1), name=name)
         elif op == "Dropout":
-            values[node.output[0]] = model.dropout(ins[0], a.get("ratio", 0.5), name=name)
+            values[node.output[0]] = model.dropout(operand(0), a.get("ratio", 0.5), name=name)
         elif op == "Reshape":
             shape_arr = next(self.inits[i] for i in node.input if i in self.inits)
             shape = [int(s) for s in shape_arr]
-            x = ins[0]
+            x = operand(0)
             # ONNX: 0 means "copy the input dim at this position" (unless
             # allowzero) — round-1 advisor finding
             if not a.get("allowzero", 0):
@@ -191,11 +234,56 @@ class ONNXModel:
                 shape[shape.index(-1)] = math.prod(x.shape) // known
             values[node.output[0]] = model.reshape(x, shape, name=name)
         elif op == "Transpose":
-            values[node.output[0]] = model.transpose(ins[0], a["perm"], name=name)
+            values[node.output[0]] = model.transpose(operand(0), a["perm"], name=name)
         elif op == "BatchNormalization":
-            values[node.output[0]] = model.batch_norm(ins[0], relu=False, name=name)
+            values[node.output[0]] = model.batch_norm(operand(0), relu=False, name=name)
         elif op == "Identity":
-            values[node.output[0]] = model.identity(ins[0], name=name)
+            values[node.output[0]] = model.identity(operand(0), name=name)
+        elif op == "Cast":
+            # TensorProto.DataType codes (onnx.proto): 1=f32 6=i32 7=i64
+            # 10=f16 11=f64
+            codes = {1: DataType.FLOAT, 6: DataType.INT32,
+                     7: DataType.INT64, 9: DataType.BOOLEAN,
+                     10: DataType.HALF, 11: DataType.DOUBLE,
+                     16: DataType.BFLOAT16}
+            if int(a["to"]) not in codes:
+                raise NotImplementedError(
+                    f"{name}: Cast to TensorProto dtype {a['to']}"
+                )
+            dt = codes[int(a["to"])]
+            values[node.output[0]] = model.cast(operand(0), dt, name=name)
+        elif op == "Split":
+            x = operand(0)
+            axis = a.get("axis", 0)
+            sizes = a.get("split")
+            if sizes is None:
+                if len(node.input) > 1 and node.input[1] not in self.inits:
+                    raise NotImplementedError(
+                        f"{name}: Split sizes are a traced tensor, not a "
+                        "constant — cannot mistranslate silently"
+                    )
+                split_init = next(
+                    (self.inits[i] for i in node.input[1:] if i in self.inits),
+                    None,
+                )
+                if split_init is not None:
+                    sizes = [int(v) for v in split_init]
+                else:
+                    sizes = len(node.output)  # equal split
+            parts = model.split(x, sizes, axis, name=name)
+            for out_name, t in zip(node.output, parts):
+                values[out_name] = t
+        elif op == "Unsqueeze":
+            x = operand(0)
+            axes = a.get("axes")
+            if axes is None:  # opset >= 13: axes arrive as an input tensor
+                axes = [int(v) for v in next(
+                    self.inits[i] for i in node.input[1:] if i in self.inits
+                )]
+            shape = list(x.shape)
+            for ax in sorted(ax % (x.ndim + len(axes)) for ax in axes):
+                shape.insert(ax, 1)
+            values[node.output[0]] = model.reshape(x, shape, name=name)
         else:
             raise NotImplementedError(f"ONNX op {op}")
 

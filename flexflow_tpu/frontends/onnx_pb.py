@@ -100,6 +100,7 @@ _DESC: Dict[str, Dict[int, Tuple]] = {
         2: ("f", "float"),
         3: ("i", "int"),
         4: ("s", "bytes"),
+        5: ("t", "msg", "TensorProto"),
         8: ("ints", "rint"),
         20: ("type", "int"),
     },
@@ -127,7 +128,8 @@ class Msg:
             name, kind = spec[0], spec[1]
             setattr(self, name, [] if kind in _REPEATED else
                     b"" if kind == "bytes" else
-                    "" if kind == "str" else 0)
+                    "" if kind == "str" else
+                    None if kind == "msg" else 0)
 
     def __repr__(self):
         return f"<{self._type} {self.__dict__}>"
@@ -265,6 +267,10 @@ def _ser_attr(name: str, val) -> bytes:
         _write_len_delim(out, 8, bytes(packed))
         _write_tag(out, 20, 0)
         _write_varint(out, 7)  # INTS
+    elif isinstance(val, np.ndarray):
+        _write_len_delim(out, 5, _ser_tensor(name, val))
+        _write_tag(out, 20, 0)
+        _write_varint(out, 4)  # TENSOR
     else:
         raise TypeError(f"attribute {name}: {type(val)}")
     return bytes(out)

@@ -517,3 +517,89 @@ def test_torch_nn_multihead_attention_parity():
     ours = np.asarray(ff.eval_batch([x]))
     theirs = module(torch.from_numpy(x)).detach().numpy()
     np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)
+
+
+def test_torch_mt5_ff_file_roundtrip(tmp_path):
+    """The JSON-lines .ff IR serializes the full mt5-style graph — traced
+    size() refs, slices, parameters — and rebuilds it without the live
+    module (reference .ff format, ``string_to_ff``)."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.fftype import DataType
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel, torch_to_ff
+
+    torch.manual_seed(0)
+    b, s, vocab = 2, 8, 64
+    module = _MiniMT5(vocab=vocab, s=s).eval()
+    path = str(tmp_path / "mt5.ff")
+    torch_to_ff(module, path)
+
+    pt = PyTorchModel(path)  # no module — file only
+    ff = FFModel(FFConfig(batch_size=b))
+    enc_in = ff.create_tensor((b, s), DataType.INT32, name="enc_ids")
+    dec_in = ff.create_tensor((b, s), DataType.INT32, name="dec_ids")
+    outs = pt.apply(ff, [enc_in, dec_in])
+    assert len(outs) == 1 and outs[0].shape == (b, s, vocab)
+
+
+def test_onnx_constant_split_cast_unsqueeze():
+    """Round-3 breadth: Constant folding, Split multi-output, Cast, and
+    Unsqueeze through the wire reader (reference handlers
+    handleConstant/handleSplit/handleCast/handleUnsqueeze)."""
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.frontends import onnx_pb
+    from flexflow_tpu.frontends.onnx_model import ONNXModel
+
+    rng = np.random.default_rng(6)
+    cval = rng.normal(size=(4, 8)).astype(np.float32)
+    nodes = [
+        onnx_pb.make_node("Split", ["x"], ["a", "b"], name="sp", axis=1,
+                          split=[8, 8]),
+        onnx_pb.make_node("Constant", [], ["cst"], name="c", value=cval),
+        onnx_pb.make_node("Add", ["a", "cst"], ["s"], name="addc"),
+        onnx_pb.make_node("Mul", ["s", "b"], ["m"], name="mul"),
+        onnx_pb.make_node("Unsqueeze", ["m"], ["u"], name="uq", axes=[1]),
+        onnx_pb.make_node("Flatten", ["u"], ["f"], name="fl"),
+        onnx_pb.make_node("Cast", ["f"], ["out"], name="cast", to=1),
+    ]
+    blob = onnx_pb.make_model(nodes, ["x"], ["out"])
+    om = ONNXModel(blob)
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 16), name="x")
+    outs = om.apply(ff, {"x": x})
+    assert outs[0].shape == (4, 8)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    om.transfer_weights(ff)
+    xv = rng.normal(size=(4, 16)).astype(np.float32)
+    ours = np.asarray(ff.eval_batch([xv]))
+    ref = (xv[:, :8] + cval) * xv[:, 8:]
+    np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_onnx_concat_with_constant_input():
+    """Regression (review finding): Concat and unary consumers must see
+    folded constants as graph tensors, not silently drop them."""
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.frontends import onnx_pb
+    from flexflow_tpu.frontends.onnx_model import ONNXModel
+
+    rng = np.random.default_rng(7)
+    cval = rng.normal(size=(4, 8)).astype(np.float32)
+    nodes = [
+        onnx_pb.make_node("Constant", [], ["cst"], name="c", value=cval),
+        onnx_pb.make_node("Relu", ["cst"], ["cr"], name="r"),
+        onnx_pb.make_node("Concat", ["x", "cr"], ["out"], name="cat", axis=1),
+    ]
+    blob = onnx_pb.make_model(nodes, ["x"], ["out"])
+    om = ONNXModel(blob)
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 16), name="x")
+    outs = om.apply(ff, {"x": x})
+    assert outs[0].shape == (4, 24)  # silently dropping cst would give 16
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    om.transfer_weights(ff)
+    xv = rng.normal(size=(4, 16)).astype(np.float32)
+    ours = np.asarray(ff.eval_batch([xv]))
+    ref = np.concatenate([xv, np.maximum(cval, 0.0)], axis=1)
+    np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-5)

@@ -142,6 +142,9 @@ class Model:
                 for cb in callbacks:
                     cb.on_epoch_begin(epoch)
                 it.reset()
+                # per-epoch metrics, like the reference's reset_metrics()
+                # each epoch (base_model.py:397)
+                pm = PerfMetrics()
                 for batch in it:
                     *bx, by = batch
                     loss, m = ff.executor.train_step(bx, by)

@@ -882,6 +882,9 @@ class FFModel:
         pm = PerfMetrics()
         for epoch in range(epochs):
             it.reset()
+            # per-EPOCH accumulation, like the reference's reset_metrics()
+            # at each epoch start (flexflow_cffi.py fit / base_model._train)
+            pm = PerfMetrics()
             for batch in it:
                 *bx, by = batch
                 loss, m = self.executor.train_step(bx, by)
@@ -897,10 +900,10 @@ class FFModel:
             if verbose:
                 print(
                     f"epoch {epoch}: loss={float(loss):.4f} "
-                    + " ".join(f"{k}={float(v):.4f}" for k, v in m.items())
-                    + f" throughput={pm.throughput():.2f} samples/s"
+                    f"accuracy={pm.accuracy:.4f} "
+                    f"throughput={pm.throughput():.2f} samples/s"
                 )
-        return pm
+        return pm  # the FINAL epoch's metrics (reference parity)
 
     def eval_batch(
         self, x: Sequence[np.ndarray], seq_length: Optional[int] = None

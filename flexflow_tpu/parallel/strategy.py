@@ -47,6 +47,13 @@ class OpSharding:
     # strategy-scoped op knobs (e.g. sp_impl for attention) — kept here, not
     # on Layer.attrs, so evaluating a candidate never mutates the graph
     extras: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # pipeline stage assignment — RESERVED.  The reference carries a dead
+    # OP_PIPELINE enum (ffconst.h:159) with no implementation, and SURVEY
+    # §7.3 directs the strategy IR to leave room for PP without building
+    # it: a future pipeline pass would partition layers by this field and
+    # lower stage boundaries to ppermute-based microbatch schedules.
+    # Serialized and round-tripped; no runtime effect today (stage 0).
+    stage: int = 0
 
     def key(self) -> tuple:
         """Value identity (memoization/dedup/change detection)."""
@@ -55,6 +62,7 @@ class OpSharding:
             tuple(sorted((k, v.key()) for k, v in self.weights.items())),
             tuple(None if t is None else t.key() for t in self.inputs),
             tuple(sorted(self.extras.items())),
+            self.stage,
         )
 
     def copy(self) -> "OpSharding":
@@ -63,6 +71,7 @@ class OpSharding:
             weights=dict(self.weights),
             inputs=list(self.inputs),
             extras=dict(self.extras),
+            stage=self.stage,
         )
 
 
@@ -95,6 +104,7 @@ class Strategy:
                         "weights": {k: enc_ts(v) for k, v in s.weights.items()},
                         "inputs": [None if t is None else enc_ts(t) for t in s.inputs],
                         "extras": s.extras,
+                        "stage": s.stage,
                     }
                     for guid, s in self.ops.items()
                 },
@@ -121,6 +131,7 @@ class Strategy:
                 weights={k: dec_ts(v) for k, v in s["weights"].items()},
                 inputs=[None if t is None else dec_ts(t) for t in s.get("inputs", [])],
                 extras=dict(s.get("extras", {})),
+                stage=int(s.get("stage", 0)),
             )
         return st
 

@@ -122,3 +122,58 @@ def test_dcn_axis_prices_collectives_higher():
     # non-DCN axes are unaffected
     assert dcn.all_reduce(nb, 4, axis="model") == ici.all_reduce(nb, 4, axis="model")
     assert dcn.all_gather(nb, 4, axis="data") > 5 * ici.all_gather(nb, 4, axis="data")
+
+
+def test_build_hybrid_slice_granule(monkeypatch):
+    """ADVICE r2: on a multi-slice pod with several processes per slice,
+    the DCN granule must be the SLICE (hosts of one slice never split
+    across the DCN axis), with the process granule only for single-slice
+    runs.  Captures the mesh_utils call instead of building a mesh."""
+    import types
+
+    import jax
+    from jax.experimental import mesh_utils
+
+    from flexflow_tpu.parallel.machine import MachineMesh
+
+    class FakeDev:
+        def __init__(self, slice_index):
+            self.slice_index = slice_index
+
+    captured = {}
+
+    def fake_chdm(ici, dcn, process_is_granule=False):
+        captured.update(ici=ici, dcn=dcn, pig=process_is_granule)
+        raise _Stop()
+
+    class _Stop(Exception):
+        pass
+
+    monkeypatch.setattr(
+        mesh_utils, "create_hybrid_device_mesh", fake_chdm
+    )
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+
+    # 2 slices x 4 devices, 2 processes per slice -> slice granule
+    monkeypatch.setattr(
+        jax, "devices", lambda: [FakeDev(i // 4) for i in range(8)]
+    )
+    mesh = MachineMesh((8, 1), ("data", "model"))
+    try:
+        mesh.build_hybrid(dcn_axis="data")
+    except _Stop:
+        pass
+    assert captured["dcn"] == (2, 1)  # granule count == slices, not procs
+    assert captured["ici"] == (4, 1)
+    assert captured["pig"] is False
+
+    # single slice, 4 processes -> process granule
+    monkeypatch.setattr(jax, "devices", lambda: [FakeDev(0) for _ in range(8)])
+    captured.clear()
+    try:
+        mesh.build_hybrid(dcn_axis="data")
+    except _Stop:
+        pass
+    assert captured["dcn"] == (4, 1)
+    assert captured["ici"] == (2, 1)
+    assert captured["pig"] is True

@@ -133,7 +133,7 @@ def run_bench(backend: str) -> None:
     from flexflow_tpu.ops.base import get_op_def
 
     on_tpu = jax.default_backend() == "tpu"
-    batch = 16 if on_tpu else 4
+    batch = int(os.environ.get("FFTPU_BENCH_BATCH", 16 if on_tpu else 4))
     seq = 512 if on_tpu else 64
     cfg_model = BERT_BASE if on_tpu else dict(hidden=128, heads=8, ff_dim=256, num_layers=2)
     dtype = "bfloat16" if on_tpu else "float32"

@@ -27,6 +27,7 @@ from flexflow_tpu.frontends.keras.layers import (
     Reshape,
     Subtract,
 )
+from flexflow_tpu.frontends.keras import preprocessing  # noqa: F401
 from flexflow_tpu.frontends.keras.models import Model, Sequential
 from flexflow_tpu.frontends.keras.optimizers import SGD, Adam
 

@@ -1,0 +1,37 @@
+"""Sequence preprocessing utilities (reference
+``python/flexflow/keras/preprocessing/sequence.py``): ``pad_sequences``
+with keras semantics — pre/post padding and truncation to a rectangular
+int array."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def pad_sequences(
+    sequences: Sequence[Sequence[int]],
+    maxlen: Optional[int] = None,
+    dtype: str = "int32",
+    padding: str = "pre",
+    truncating: str = "pre",
+    value: float = 0.0,
+) -> np.ndarray:
+    if padding not in ("pre", "post") or truncating not in ("pre", "post"):
+        raise ValueError("padding/truncating must be 'pre' or 'post'")
+    lengths = [len(s) for s in sequences]
+    if maxlen is None:
+        maxlen = max(lengths, default=0)
+    out = np.full((len(sequences), maxlen), value, dtype=np.dtype(dtype))
+    for i, s in enumerate(sequences):
+        s = np.asarray(s)
+        if len(s) > maxlen:
+            s = s[-maxlen:] if truncating == "pre" else s[:maxlen]
+        if len(s) == 0:
+            continue
+        if padding == "pre":
+            out[i, -len(s):] = s
+        else:
+            out[i, : len(s)] = s
+    return out

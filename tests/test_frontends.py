@@ -603,3 +603,17 @@ def test_onnx_concat_with_constant_input():
     ours = np.asarray(ff.eval_batch([xv]))
     ref = np.concatenate([xv, np.maximum(cval, 0.0)], axis=1)
     np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_keras_pad_sequences():
+    from flexflow_tpu.frontends.keras.preprocessing import pad_sequences
+
+    seqs = [[1, 2, 3], [4], [5, 6, 7, 8, 9]]
+    out = pad_sequences(seqs, maxlen=4)
+    assert out.shape == (3, 4)
+    np.testing.assert_array_equal(out[0], [0, 1, 2, 3])   # pre-pad
+    np.testing.assert_array_equal(out[1], [0, 0, 0, 4])
+    np.testing.assert_array_equal(out[2], [6, 7, 8, 9])   # pre-truncate
+    out = pad_sequences(seqs, maxlen=4, padding="post", truncating="post")
+    np.testing.assert_array_equal(out[0], [1, 2, 3, 0])
+    np.testing.assert_array_equal(out[2], [5, 6, 7, 8])

@@ -1,0 +1,78 @@
+"""Adaptive MoE: capacity rebalancing through the recompile hooks (R17).
+
+This is the reference's motivating RecompileState use case
+(``examples/cpp/mixture_of_experts/moe.cc:180`` commented usage +
+``include/flexflow/recompile.h:26-41``): train an MoE, watch a trigger,
+ALTER the model (here: grow the experts' capacity factor ``alpha`` when
+the early loss plateaus — dropped tokens from a tight capacity hurt
+convergence), recompile, and keep training with weights and optimizer
+state carried over.
+
+Run: JAX_PLATFORMS=cpu PYTHONPATH=. python examples/moe/adaptive_moe.py
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu import (
+    AdamOptimizer,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    RecompileState,
+)
+
+
+def build(model: FFModel, batch: int, alpha: float):
+    t = model.create_tensor((batch, 64), name="features")
+    t = model.moe(t, 4, 2, 64, alpha=alpha, lambda_bal=0.01, fused=True,
+                  name="moe")
+    t = model.dense(t, 10)
+    model.softmax(t)
+
+
+def main() -> int:
+    cfg = FFConfig(batch_size=64, epochs=4, learning_rate=0.01)
+    cfg.parse_args(sys.argv[1:])
+    model = FFModel(cfg)
+    build(model, cfg.batch_size, alpha=0.5)  # deliberately tight capacity
+
+    model.compile(
+        optimizer=AdamOptimizer(alpha=cfg.learning_rate),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+
+    def trigger(state: RecompileState) -> bool:
+        # fire once, a few iterations in, while capacity is still tight
+        ex = next(l for l in model.layers if l.op_type.value == "experts")
+        return state.iteration == 20 and ex.attrs.get("alpha", 1.0) < 1.0
+
+    def alter(m: FFModel) -> None:
+        ex = next(l for l in m.layers if l.op_type.value == "experts")
+        old = ex.attrs["alpha"]
+        ex.attrs["alpha"] = 2.0
+        print(f"[recompile] expert capacity alpha {old} -> 2.0 "
+              f"(iteration trigger)")
+
+    rs = RecompileState(trigger, alter)
+
+    rng = np.random.default_rng(0)
+    n = 2048
+    centers = rng.normal(size=(10, 64)).astype(np.float32) * 2
+    y = rng.integers(0, 10, size=n)
+    x = (centers[y] + rng.normal(size=(n, 64))).astype(np.float32)
+    y = y.astype(np.int32).reshape(n, 1)
+
+    pm = model.fit(x, y, recompile_state=rs)
+    print(f"final accuracy: {pm.accuracy:.4f} "
+          f"(recompilations: {rs.recompilations})")
+    ok = rs.recompilations == 1 and pm.accuracy > 0.8
+    print("ok" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -347,6 +347,7 @@ def estimate_strategy_cost(
     machine: Optional[TPUMachineModel] = None,
     lambda_mem: float = 0.0,
     node_time_fn=None,
+    cost_cache: Optional[Dict] = None,
 ) -> float:
     """Per-step time estimate for a whole strategy: node costs (compute +
     weight-grad sync) + per-edge reshard collectives.  Pure function of the
@@ -389,14 +390,25 @@ def estimate_strategy_cost(
                     for s, _ in get_op_def(layer.op_type).infer(layer)
                 ]
             )
-        total += node_cost(
-            layer,
-            os_,
-            mesh,
-            m,
-            lambda_mem=lambda_mem,
-            compute_time=node_time_fn(layer, os_) if node_time_fn else None,
-        )
+        if cost_cache is not None:
+            nk = ("n", int(layer.layer_guid), os_.key())
+            c = cost_cache.get(nk)
+            if c is None:
+                c = node_cost(
+                    layer, os_, mesh, m, lambda_mem=lambda_mem,
+                    compute_time=node_time_fn(layer, os_) if node_time_fn else None,
+                )
+                cost_cache[nk] = c
+            total += c
+        else:
+            total += node_cost(
+                layer,
+                os_,
+                mesh,
+                m,
+                lambda_mem=lambda_mem,
+                compute_time=node_time_fn(layer, os_) if node_time_fn else None,
+            )
         for i, t in enumerate(layer.inputs):
             src = producer_sharding(t)
             if src is None:
@@ -410,7 +422,17 @@ def estimate_strategy_cost(
                 "model" in src.axes_of(d) for d in range(len(src.spec))
             ):
                 continue
-            total += reshard_cost(
-                t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m
-            )
+            if cost_cache is not None:
+                ek = ("e", t.guid, src.key(), dst.key())
+                c = cost_cache.get(ek)
+                if c is None:
+                    c = reshard_cost(
+                        t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m
+                    )
+                    cost_cache[ek] = c
+                total += c
+            else:
+                total += reshard_cost(
+                    t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m
+                )
     return total

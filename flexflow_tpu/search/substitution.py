@@ -323,12 +323,15 @@ def base_optimize(
     ``src/runtime/simulator.cc:537-577``).  ``extra_xfers`` appends
     JSON-loaded rules to the generator set (``substitution_loader.cc``)."""
     m = machine or TPUMachineModel()
+    # per-run price memo: valid for this (mesh, machine, node_time_fn)
+    cost_cache: Dict = {}
 
     def cost_of(assign: Dict[int, OpSharding]) -> float:
         st = Strategy(mesh)
         st.ops = assign
         return estimate_strategy_cost(
-            layers, st, m, lambda_mem=lambda_mem, node_time_fn=node_time_fn
+            layers, st, m, lambda_mem=lambda_mem, node_time_fn=node_time_fn,
+            cost_cache=cost_cache,
         )
 
     xfers = generate_all_pcg_xfers(mesh) + list(extra_xfers or ())

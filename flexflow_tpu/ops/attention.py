@@ -234,7 +234,11 @@ class MultiHeadAttention(OpDef):
 # 17 vs 77 ms) — so dispatch is by memory need, not by default.  ~4 GiB
 # of f32 scores (plus the bf16 copy XLA keeps) approaches half of v5e's
 # 16 GB HBM once weights/activations are accounted.
-_FLASH_SCORE_BYTES_THRESHOLD = float(4 * (1 << 30))
+import os as _os
+
+_FLASH_SCORE_BYTES_THRESHOLD = float(
+    _os.environ.get("FFTPU_FLASH_THRESHOLD_BYTES", 4 * (1 << 30))
+)
 
 
 def _flash_ok(sq: int, sk: int, d: int, bh_local: int = 1) -> bool:

@@ -467,3 +467,46 @@ def test_dropout_train_eval():
     np.testing.assert_allclose(surv, 2.0, rtol=1e-5)
     (y,) = run_op(OperatorType.DROPOUT, dict(rate=0.5, seed=0), [x], training=False)
     np.testing.assert_array_equal(y, x)
+
+
+def test_group_by_aggregate_scatter_grads_match_dense_mask():
+    """The scatter/gather dispatch (round-3) must be gradient-equivalent
+    to the dense one-hot einsum formulation it replaced — autodiff through
+    scatter-add/gather vs through the mask einsums."""
+    import jax
+
+    from flexflow_tpu.ops.moe import (
+        dispatch_indices,
+        expert_capacity,
+        gather_combine,
+        make_dispatch,
+        scatter_group,
+    )
+
+    rng = np.random.default_rng(21)
+    t, d, n, k = 32, 16, 4, 2
+    data = jnp.asarray(rng.normal(size=(t, d)).astype(np.float32))
+    # distinct experts per token (torch.topk semantics)
+    assign = jnp.asarray(
+        np.stack([rng.permutation(n)[:k] for _ in range(t)]).astype(np.int32)
+    )
+    gates = jnp.asarray(rng.uniform(0.1, 1.0, size=(t, k)).astype(np.float32))
+    cap = expert_capacity(t, n, k, alpha=2.0)
+
+    def via_scatter(x):
+        slot, within = dispatch_indices(assign, n, cap)
+        g = scatter_group(x, slot, within, n, cap)
+        return jnp.sum(gather_combine(g * 2.0, slot, within, gates))
+
+    def via_dense(x):
+        dispatch, _, within = make_dispatch(assign, n, cap)
+        g = jnp.einsum("tec,td->ecd", dispatch, x)
+        w = gates * within.astype(gates.dtype)
+        eoh = jax.nn.one_hot(assign, n, dtype=jnp.float32)
+        w_te = jnp.einsum("tk,tke->te", w, eoh)
+        return jnp.sum(jnp.einsum("tec,te,ecd->td", dispatch, w_te, g * 2.0))
+
+    v1, g1 = jax.value_and_grad(via_scatter)(data)
+    v2, g2 = jax.value_and_grad(via_dense)(data)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5, atol=1e-6)

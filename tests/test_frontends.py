@@ -617,3 +617,34 @@ def test_keras_pad_sequences():
     out = pad_sequences(seqs, maxlen=4, padding="post", truncating="post")
     np.testing.assert_array_equal(out[0], [1, 2, 3, 0])
     np.testing.assert_array_equal(out[2], [5, 6, 7, 8])
+
+
+def test_torch_import_through_unity_search_trains():
+    """Full pipeline: torch.fx import -> Unity search -> sharded training
+    step on the 8-device mesh (frontend output is a first-class PCG for
+    the search, like the reference's imported models)."""
+    from flexflow_tpu import (
+        FFConfig, FFModel, LossType, MachineMesh, SGDOptimizer,
+    )
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel
+
+    torch.manual_seed(2)
+    module = _TorchMLP().eval()
+    ff = FFModel(FFConfig(batch_size=16, search_budget=4))
+    x = ff.create_tensor((16, 32), name="x")
+    pt = PyTorchModel(module)
+    outs = pt.apply(ff, [x])
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        mesh=MachineMesh((8, 1), ("data", "model")),
+    )
+    pt.transfer_weights(ff)
+    rng = np.random.default_rng(2)
+    xv = rng.normal(size=(16, 32)).astype(np.float32)
+    yv = rng.integers(0, 10, size=(16, 1)).astype(np.int32)
+    losses = []
+    for _ in range(4):
+        loss, _ = ff.executor.train_step([xv], yv)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]

@@ -162,9 +162,97 @@ def _fwd_kernel(
         )
 
 
+def _fwd_kernel_onepass(
+    seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+    *, sq: int, sk: int, causal: bool, sm_scale: float, dropout_rate: float,
+):
+    """Single-K-block forward (block_k == sk): the whole row of scores fits
+    in VMEM, so softmax is one pass — no online-softmax carry, no scratch,
+    no per-step rescale.  This is the short/medium-sequence regime where
+    the online-softmax machinery was pure overhead vs XLA's fused sdpa."""
+    block_q, d = q_ref.shape
+    bh = pl.program_id(0)
+    q_idx = pl.program_id(1)
+    s = _dot_nt(q_ref[:], k_ref[:]) * sm_scale
+    q_pos, k_pos = _positions(q_idx * block_q, 0, block_q, sk)
+    if causal:
+        visible = q_pos + (sk - sq) >= k_pos
+        s = jnp.where(visible, s, NEG_INF)
+    m = jnp.max(s, axis=1, keepdims=True)
+    p = jnp.exp(s - m)
+    if causal:
+        # rows with NO visible key (ragged sq > sk) have s == m == NEG_INF
+        # and exp(0) == 1 everywhere; zero them so such rows output 0 like
+        # the tiled kernel's skip-gate does
+        p = jnp.where(visible, p, 0.0)
+    l = jnp.sum(p, axis=1, keepdims=True)
+    if dropout_rate > 0.0:
+        u = _uniform01(seed_ref[0, 0].astype(jnp.uint32),
+                       jnp.uint32(bh), q_pos, k_pos)
+        keep = jnp.float32(1.0 - dropout_rate)
+        p = jnp.where(u >= dropout_rate, p / keep, 0.0)
+    l_safe = jnp.maximum(l, 1e-30)
+    acc = jnp.dot(
+        (p / l_safe).astype(v_ref.dtype), v_ref[:],
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[:] = acc.astype(o_ref.dtype)
+    lse_ref[:] = jnp.broadcast_to(m + jnp.log(l_safe), lse_ref.shape)
+
+
+def _flash_fwd_onepass(q, k, v, seed, causal, dropout_rate, block_q):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    sm_scale = 1.0 / math.sqrt(d)
+    n_q = sq // block_q
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    seed_arr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
+    kernel = functools.partial(
+        _fwd_kernel_onepass, sq=sq, sk=sk, causal=causal,
+        sm_scale=sm_scale, dropout_rate=dropout_rate,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda bh, qi: (0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, block_q, 128), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 128), jnp.float32),
+        ],
+        compiler_params=None if INTERPRET else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")
+        ),
+        interpret=INTERPRET,
+    )(seed_arr, qf, kf, vf)
+    return out.reshape(b, h, sq, d), lse[:, :, 0]
+
+
+# K/V row extent up to which the one-pass forward engages: the f32
+# score+prob tiles at (256, ONEPASS_MAX_SK) must stay well inside VMEM.
+# Causal uses a lower bound — one-pass cannot skip fully-masked blocks,
+# so past ~1k keys the tiled kernel's diagonal skip wins back the
+# online-softmax overhead.
+ONEPASS_MAX_SK = 2048
+ONEPASS_MAX_SK_CAUSAL = 1024
+
+
 def _flash_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k):
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    onepass_max = ONEPASS_MAX_SK_CAUSAL if causal else ONEPASS_MAX_SK
+    if sk <= onepass_max and sk % 128 == 0:
+        return _flash_fwd_onepass(q, k, v, seed, causal, dropout_rate, block_q)
     sm_scale = 1.0 / math.sqrt(d)
     n_q = sq // block_q
     n_kb = sk // block_k

@@ -144,3 +144,49 @@ def test_flash_engages_for_bert_head_dim():
     assert _flash_ok(512, 512, 64)
     assert _flash_ok(128, 128, 96)
     assert not _flash_ok(64, 64, 64)  # seq too small for the tile grid
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_tiled_path_parity(causal):
+    """The multi-K-block online-softmax kernel must stay covered now that
+    short sequences dispatch to the one-pass kernel: force the tiled path
+    and check parity against the reference sdpa."""
+    from flexflow_tpu.ops.pallas import flash_attention as fa
+
+    old = (fa.ONEPASS_MAX_SK, fa.ONEPASS_MAX_SK_CAUSAL)
+    fa.ONEPASS_MAX_SK = fa.ONEPASS_MAX_SK_CAUSAL = 0
+    try:
+        rng = np.random.default_rng(11)
+        q = jnp.asarray(rng.normal(size=(1, 2, 256, 64)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 2, 256, 64)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(1, 2, 256, 64)), jnp.float32)
+        out = fa.flash_attention(q, k, v, causal=causal)
+        ref = fa._sdpa_ref(q, k, v, causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+        # backward through the tiled forward's saved lse
+        g1 = jax.grad(lambda a: jnp.sum(
+            fa.flash_attention(a, k, v, causal=causal)))(q)
+        g2 = jax.grad(lambda a: jnp.sum(fa._sdpa_ref(a, k, v, causal)))(q)
+        np.testing.assert_allclose(
+            np.asarray(g1), np.asarray(g2), atol=5e-5, rtol=5e-5
+        )
+    finally:
+        fa.ONEPASS_MAX_SK, fa.ONEPASS_MAX_SK_CAUSAL = old
+
+
+def test_flash_onepass_fully_masked_rows_zero():
+    """Causal ragged cross-attention (sq > sk): rows with no visible key
+    must output zeros (review finding: one-pass softmax of an all-masked
+    row would otherwise emit mean(V))."""
+    from flexflow_tpu.ops.pallas import flash_attention as fa
+
+    rng = np.random.default_rng(12)
+    q = jnp.asarray(rng.normal(size=(1, 1, 256, 64)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 1, 128, 64)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 1, 128, 64)), jnp.float32)
+    out = np.asarray(fa.flash_attention(q, k, v, causal=True))
+    # first sq - sk = 128 query rows see no key
+    np.testing.assert_allclose(out[0, 0, :128], 0.0, atol=1e-6)
+    assert np.abs(out[0, 0, 128:]).max() > 0

@@ -905,6 +905,38 @@ class FFModel:
                 )
         return pm  # the FINAL epoch's metrics (reference parity)
 
+    def eval(
+        self,
+        x: Union[np.ndarray, Sequence[np.ndarray]],
+        y: np.ndarray,
+        batch_size: Optional[int] = None,
+        verbose: bool = False,
+    ) -> PerfMetrics:
+        """Loss & metrics in test mode over the full dataset, batch by
+        batch (reference ``FFModel.eval``, ``flexflow_cffi.py:2106``:
+        reset metrics, iterate batches, accumulate PerfMetrics)."""
+        assert self.executor is not None, "call compile() first"
+        bs = batch_size or self.config.batch_size
+        xs = list(x) if isinstance(x, (list, tuple)) else [x]
+        loaders = [
+            SingleDataLoader(a, bs, None, None, shuffle=False) for a in xs
+        ] + [SingleDataLoader(np.asarray(y), bs, None, None, shuffle=False)]
+        it = BatchIterator(loaders)
+        ex = self.executor
+        pm = PerfMetrics()
+        import jax.numpy as _jnp
+
+        for batch in it:
+            *bx, by = batch
+            logits = ex.forward(bx)
+            m = ex.metrics.compute(logits, _jnp.asarray(by))
+            pm.update({k: float(v) for k, v in m.items()}, bs)
+        if verbose:
+            print("eval: " + " ".join(
+                f"{k}={v:.4f}" for k, v in (("accuracy", pm.accuracy),)
+            ))
+        return pm
+
     def eval_batch(
         self, x: Sequence[np.ndarray], seq_length: Optional[int] = None
     ) -> jax.Array:

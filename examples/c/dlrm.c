@@ -128,6 +128,14 @@ int main(void) {
     if (fabsf(w2[i] - w[i]) > 1e-6f) fail("weight roundtrip mismatch");
   printf("weight roundtrip ok (%lld floats)\n", (long long)n);
 
+  /* step-level control: one more training step, loss must be finite */
+  double step_loss = 0;
+  if (flexflow_model_train_step(model, 2, inputs, dims, ndims, dtypes, y, 1,
+                                &step_loss) != 0)
+    fail("train_step");
+  if (!(step_loss == step_loss) || step_loss < 0) fail("train_step loss");
+  printf("train_step loss: %.4f\n", step_loss);
+
   /* eval through the multi-input path */
   static float out[N * CLASSES];
   int64_t wrote =

@@ -678,6 +678,39 @@ int64_t flexflow_model_eval(ff_handle* model, int n_inputs, const void** xs,
   return n;
 }
 
+int flexflow_model_train_step(ff_handle* model, int n_inputs,
+                              const void** xs, const int64_t* const* xdims,
+                              const int* x_ndims, const int* x_dtypes,
+                              const void* y, int y_dtype, double* out_loss) {
+  PyObject* xl = np_array_list(n_inputs, xs, xdims, x_ndims, x_dtypes);
+  if (!xl) return -1;
+  int64_t ydims[2] = {xdims[0][0], 1};
+  PyObject* ya = np_array_copy(y, ydims, 2, dtype_name(y_dtype));
+  if (!ya) {
+    Py_DECREF(xl);
+    return -1;
+  }
+  PyObject* ex = getattr_checked(model->obj, "executor");
+  PyObject* r =
+      ex ? PyObject_CallMethod(ex, "train_step", "OO", xl, ya) : nullptr;
+  Py_XDECREF(ex);
+  Py_DECREF(xl);
+  Py_DECREF(ya);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  if (out_loss) {
+    PyObject* loss = PySequence_GetItem(r, 0);
+    PyObject* f = loss ? PyNumber_Float(loss) : nullptr;
+    *out_loss = f ? PyFloat_AsDouble(f) : -1.0;
+    Py_XDECREF(f);
+    Py_XDECREF(loss);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
 // ------------------------------------------------------- weight access
 // Reference: flexflow_tensor get/set family (flexflow_c.cc); names are
 // newline-separated "layer/weight" pairs.

@@ -102,6 +102,14 @@ int64_t flexflow_model_eval(ff_handle* model, int n_inputs, const void** xs,
                             const int64_t* const* xdims, const int* x_ndims,
                             const int* x_dtypes, float* out, int64_t out_len);
 
+/* one training step (the reference ABI's forward/backward/update phase
+ * drivers collapse into ONE jitted step on TPU; this is the step-level
+ * control a C training loop needs).  Returns 0 and writes the loss. */
+int flexflow_model_train_step(ff_handle* model, int n_inputs,
+                              const void** xs, const int64_t* const* xdims,
+                              const int* x_ndims, const int* x_dtypes,
+                              const void* y, int y_dtype, double* out_loss);
+
 /* weight access (reference flexflow_tensor_get/set_tensor_float).
  * Layer/weight names: newline-separated "layer/weight" listing. */
 int64_t flexflow_model_weight_names(ff_handle* model, char* buf,

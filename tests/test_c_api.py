@@ -78,4 +78,6 @@ def test_c_driver_trains_two_input_dlrm(libflexflow_c, tmp_path_factory):
     acc = float(r.stdout.split("final accuracy:")[1].split()[0])
     assert acc > 0.7, r.stdout
     assert "weight roundtrip ok" in r.stdout
+    assert "train_step loss:" in r.stdout
     assert "eval wrote 1024 floats" in r.stdout
+

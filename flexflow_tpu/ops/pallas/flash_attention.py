@@ -239,11 +239,12 @@ def _flash_fwd_onepass(q, k, v, seed, causal, dropout_rate, block_q):
 
 
 # K/V row extent up to which the one-pass forward engages: the f32
-# score+prob tiles at (256, ONEPASS_MAX_SK) must stay well inside VMEM.
-# Causal uses a lower bound — one-pass cannot skip fully-masked blocks,
-# so past ~1k keys the tiled kernel's diagonal skip wins back the
-# online-softmax overhead.
-ONEPASS_MAX_SK = 2048
+# score/prob tiles at (256, sk) plus K/V must stay WELL inside the
+# ~16 MiB VMEM with headroom for Mosaic's double-buffering — 1024 keeps
+# live f32 tiles ~2 MiB.  Causal gets no extra range: one-pass cannot
+# skip fully-masked diagonal blocks, so longer causal rows pay ~2x the
+# masked-region work the tiled kernel's skip-gate avoids.
+ONEPASS_MAX_SK = 1024
 ONEPASS_MAX_SK_CAUSAL = 1024
 
 

@@ -38,6 +38,29 @@ _NEG = -1e30  # finite mask value: keeps online-softmax nan-free
 
 
 def _local_sdpa(q, k, v, rng=None, *, causal: bool, dropout_rate: float = 0.0):
+    import jax as _jax
+
+    from flexflow_tpu.ops.attention import _flash_ok
+
+    # the Ulysses local step sees FULL sequence length per device — at
+    # long context its (S, S) einsum scores hit the same memory wall the
+    # global path dispatches around, so apply the same flash policy.
+    # Hardware-only: pallas-inside-shard_map is exercised on chip, while
+    # CPU test meshes keep the einsum reference path.
+    sq, sk, d = q.shape[2], k.shape[2], q.shape[3]
+    if _jax.default_backend() == "tpu" and _flash_ok(
+        sq, sk, d, q.shape[0] * q.shape[1]
+    ):
+        from flexflow_tpu.ops.pallas.flash_attention import flash_attention
+
+        seed = (
+            _jax.random.randint(rng, (), 0, 2**31 - 1)
+            if (rng is not None and dropout_rate > 0.0)
+            else 0
+        )
+        return flash_attention(
+            q, k, v, causal=causal, dropout_rate=dropout_rate, seed=seed
+        )
     """Full-sequence SDPA on local blocks — same math as the global path
     (ops.attention.sdpa: scale, end-aligned causal tril, prob dropout)."""
     from flexflow_tpu.ops.attention import sdpa

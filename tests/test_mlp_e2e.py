@@ -113,3 +113,47 @@ def test_weight_roundtrip():
     model.set_weights(w)
     w2 = model.get_weights()
     np.testing.assert_array_equal(w2["dense_0"]["kernel"], 1.0)
+
+
+def test_ffmodel_eval_full_dataset():
+    """FFModel.eval: reference FFModel.eval parity — test-mode metrics
+    accumulated over every batch of the dataset."""
+    cfg = FFConfig(batch_size=64, epochs=2, learning_rate=0.05)
+    model = build_mlp(cfg)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+        mesh=MachineMesh((1, 1), ("data", "model")),
+    )
+    x, y = make_blobs()
+    model.fit(x, y, verbose=False)
+    pm = model.eval(x, y)
+    assert pm.train_all == len(x)
+    assert pm.accuracy > 0.8
+
+
+def test_module_launcher_runs_script(tmp_path):
+    """python -m flexflow_tpu script.py (flexflow_python analog)."""
+    import os
+    import subprocess
+    import sys
+
+    script = tmp_path / "tiny.py"
+    script.write_text(
+        "import sys\n"
+        "from flexflow_tpu import FFConfig\n"
+        "cfg = FFConfig()\n"
+        "cfg.parse_args(sys.argv[1:])\n"
+        "print('launched with batch', cfg.batch_size)\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "flexflow_tpu", str(script), "-b", "96"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "launched with batch 96" in r.stdout

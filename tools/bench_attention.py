@@ -65,7 +65,13 @@ def sdpa(q, k, v, causal=False):
 
 
 def main():
+    import os
+
+    from flexflow_tpu.ops.pallas import flash_attention as fa
     from flexflow_tpu.ops.pallas.flash_attention import flash_attention
+
+    if os.environ.get("FFTPU_FORCE_TILED") == "1":
+        fa.ONEPASS_MAX_SK = fa.ONEPASS_MAX_SK_CAUSAL = 0  # A/B the kernels
 
     try:
         from jax.experimental.pallas.ops.tpu.flash_attention import (

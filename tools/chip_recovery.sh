@@ -1,0 +1,27 @@
+#!/bin/bash
+# One-shot measurement plan for when the TPU tunnel recovers (round-3
+# kernel work is otherwise unmeasured — see BASELINE.md round-3 note).
+# Saves everything under .bench_logs/ for doc updates.
+set -u
+cd /root/repo
+export PYTHONPATH=/root/repo:/root/.axon_site
+mkdir -p .bench_logs
+
+echo "== probe =="
+timeout 90 python -c "import jax; print(jax.devices())" || exit 1
+
+echo "== attention sweep (adaptive blocks + one-pass) =="
+timeout 1800 python tools/bench_attention.py 2>&1 | grep -v WARNING \
+  | tee .bench_logs/attn_adaptive.jsonl
+
+echo "== attention sweep (forced tiled, for A/B) =="
+FFTPU_FORCE_TILED=1 timeout 1500 python tools/bench_attention.py 2>&1 \
+  | grep -v WARNING | tee .bench_logs/attn_tiled.jsonl
+
+echo "== bench.py (headline + attn_core extras) =="
+timeout 2700 python bench.py | tee .bench_logs/bench_b16.json
+
+echo "== bench.py batch 32 =="
+FFTPU_BENCH_BATCH=32 timeout 2700 python bench.py | tee .bench_logs/bench_b32.json
+
+echo "== done; update BASELINE.md / README from these =="

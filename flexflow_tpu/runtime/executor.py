@@ -296,6 +296,7 @@ class Executor:
         self.state = state
         self.opt_state = self.optimizer.init_state(params)
         if self.zero1:
+            self._zero1_axes = self._zero1_token_axes()
             self._zero1_specs = jax.tree.map(self._zero1_pspec, self.opt_state)
             self.opt_state = jax.tree.map(
                 self._zero1_place, self.opt_state, self._zero1_specs
@@ -305,10 +306,22 @@ class Executor:
     def _zero1_pspec(self, x) -> Optional[PartitionSpec]:
         """Merged sharding spec for one moment leaf: keep whatever sharding
         it inherited from its param (e.g. a TP 'model' axis — discarding it
-        would INCREASE memory) and add 'data' to the first unsharded dim it
-        divides.  Computed once at init from concrete arrays; reused as a
-        constraint inside the jitted step (tracers carry no sharding)."""
-        dp = self.strategy.mesh.axis_size("data")
+        would INCREASE memory) and add the token-sharded mesh axes to the
+        first unsharded dim that divides them.  Computed once at init from
+        concrete arrays; reused as a constraint inside the jitted step
+        (tracers carry no sharding).
+
+        Both 'data' and 'expert' split the token batch, so gradients of
+        params not already sharded on them are full sums replicated across
+        both — ZeRO-1's "shard over every data-parallel replica" means the
+        combined dp*ep degree.  Sharding over the combined axes (one dim,
+        one tuple) also keeps the weight-grad reshard expressible as an
+        all-to-all: with 'data' alone on a dp*ep mesh the grad of a dense
+        fed by an (('data','expert'),None)-sharded activation needs an
+        8-way-dim0 -> 4-way-dim1 transition, which GSPMD can only do by
+        full rematerialization (observed in MULTICHIP_r03: "Involuntary
+        full rematerialization" on the moe+zero1 phase)."""
+        mm = self.strategy.mesh
         if not hasattr(x, "ndim") or x.ndim < 1:
             return None
         cur = getattr(x, "sharding", None)
@@ -322,13 +335,41 @@ class Executor:
             if e
             for a in ((e,) if isinstance(e, str) else tuple(e))
         }
-        if "data" in used:
-            return None  # already data-sharded somewhere
+        axes = tuple(a for a in self._zero1_axes if a not in used)
+        if not axes:
+            return None
+        deg = 1
+        for a in axes:
+            deg *= mm.axis_size(a)
         for i in range(x.ndim):
-            if spec[i] is None and x.shape[i] % dp == 0:
-                spec[i] = "data"
+            if spec[i] is None and x.shape[i] % deg == 0:
+                spec[i] = axes if len(axes) > 1 else axes[0]
                 return PartitionSpec(*spec)
-        return None
+        # no single dim fits the combined degree — place axes greedily on
+        # separate free dims, largest degree first (keeps the biggest
+        # memory win; any sharding of a replicated moment is valid)
+        placed = False
+        for a in sorted(axes, key=mm.axis_size, reverse=True):
+            for i in range(x.ndim):
+                if spec[i] is None and x.shape[i] % mm.axis_size(a) == 0:
+                    spec[i] = a
+                    placed = True
+                    break
+        return PartitionSpec(*spec) if placed else None
+
+    def _zero1_token_axes(self) -> Tuple[str, ...]:
+        """Mesh axes that split the token batch: 'data' plus every EP axis
+        any strategy entry declares (the strategy layer parameterizes the
+        axis name via ``expert_parallel_strategy(..., ep_axis=...)``, so it
+        must not be hardcoded here).  Gradients of params unsharded on
+        these axes are full sums replicated across them, so ZeRO-1 may
+        shard moments over their combined degree."""
+        axes = ["data"]
+        for s in self.strategy.ops.values():
+            a = (getattr(s, "extras", None) or {}).get("ep_axis")
+            if a and a not in axes:
+                axes.append(a)
+        return tuple(a for a in axes if self.strategy.mesh.axis_size(a) > 1)
 
     def _zero1_place(self, x, ps):
         if ps is None or self.mesh is None:

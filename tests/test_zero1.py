@@ -68,6 +68,67 @@ def test_zero1_matches_replicated_and_shards_moments():
     assert local[0] == m.shape[0] // 8, "moments re-replicated after step"
 
 
+def test_zero1_expert_parallel_no_involuntary_remat(capfd):
+    """Regression (MULTICHIP_r03): on a dp×ep mesh, ZeRO-1 moments sharded
+    over 'data' alone made the dense weight-grad need an 8-way-dim0 →
+    4-way-dim1 reshard, which GSPMD lowers by replicating the whole tensor
+    ("Involuntary full rematerialization").  Moments must shard over the
+    combined ('data','expert') token axes so the transition stays an
+    all-to-all, and the compiled step must carry a bounded all-gather count
+    and emit no SPMD full-remat warning at compile time."""
+    from flexflow_tpu.parallel.strategy import expert_parallel_strategy
+
+    dp, ep = 4, 2
+    tokens = 8 * dp * ep
+    cfg = FFConfig(batch_size=tokens, enable_zero1=True)
+    model = FFModel(cfg)
+    t = model.create_tensor((tokens, 32), name="tokens")
+    t = model.moe(t, 2 * ep, 2, 64, alpha=2.0, lambda_bal=0.01, fused=True)
+    t = model.dense(t, 8, name="head")
+    model.softmax(t)
+    mesh = MachineMesh((dp, ep), ("data", "expert"))
+    model.compile(
+        optimizer=AdamOptimizer(alpha=1e-3),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+        mesh=mesh,
+        strategy=expert_parallel_strategy(model.layers, mesh),
+        seed=0,
+    )
+    ex = model.executor
+
+    # the head moment shards over BOTH token axes (dp*ep = 8-way), not dp
+    m = ex.opt_state["m"]["head"]["kernel"]  # (32, 8)
+    assert len(m.sharding.device_set) == 8, m.sharding
+    assert m.addressable_shards[0].data.shape[0] == m.shape[0] // 8, (
+        "moment not sharded over the combined data*expert degree"
+    )
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(tokens, 32)).astype(np.float32)
+    y = rng.integers(0, 8, size=(tokens, 1)).astype(np.int32)
+
+    step = ex._step_jit = ex._build_step()  # reuse below: train_step must not recompile
+    xs = [
+        ex._place(a, ex._input_pspec(tt), tt.shape[0])
+        for a, tt in zip([x], ex.graph_inputs)
+    ]
+    ys = ex._place(y, ex._label_pspec(), ex.graph_inputs[0].shape[0])
+    capfd.readouterr()  # drop anything emitted before compile
+    compiled = step.lower(ex.params, ex.state, ex.opt_state, xs, ys, 0).compile()
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err, err
+
+    # bounded collective budget: grad sync + ZeRO-1 param-delta gather.
+    # Measured 4 at fix time; headroom for XLA version drift, but well
+    # below the replicate-everything fallback.
+    n_ag = compiled.as_text().count(" all-gather(")
+    assert n_ag <= 6, f"all-gather count regressed: {n_ag}"
+
+    loss, _ = ex.train_step([x], y)
+    assert np.isfinite(float(loss))
+
+
 def test_zero1_composes_with_tensor_parallel():
     """Moments inherited TP-sharded from their params must KEEP the model
     axis and gain the data axis on a free dim (discarding TP would grow

@@ -70,15 +70,22 @@ class PhysicalTopology:
 
         Legality rule (the constraint ``register_all_machine_views``-style
         free factorization ignores, round-2 verdict item 5): every logical
-        axis must occupy either (a) a product of WHOLE physical dims, or
-        (b) a divisor split of exactly ONE physical dim.  An axis that
-        would have to snake across parts of several dims (e.g. 8 on a 4×4
-        slice) has no ICI-contiguous ring and is rejected.
+        axis must occupy (a) a product of WHOLE physical dims, (b) a
+        divisor split of exactly ONE physical dim, or (c) a contiguous
+        block of whole dims times the FIRST split of one more dim (e.g. 8
+        on a 4×4 slice as a 4×2 block — a boustrophedon ring exists).
+        Axes that would have to snake across strided fragments of several
+        dims (e.g. 3 on anything, 8 on a 4×2) are rejected.
 
         Returns ``{axis_index: (n, link_mult)}`` or ``None`` if illegal.
         ``link_mult`` is the ring-bandwidth multiplier: 2.0 when the axis
         closes a torus ring through wraparound links (bidirectional ring
-        uses both directions of the wrap cycle), 1.0 on an open line.
+        uses both directions of the wrap cycle), 1.0 on an open line, and
+        1/s for the second and later splits of one physical dim — those
+        rings hop stride-s neighbors, so each physical link carries s
+        interleaved rings (equivalently each logical hop is s links long)
+        and per-ring bandwidth drops by s.  Greedy largest-axis-first, so
+        the biggest axes land on the full-bandwidth embeddings.
         """
         sizes = list(logical_shape)
         if math.prod(sizes) > self.size:
@@ -88,8 +95,23 @@ class PhysicalTopology:
             key=lambda i: -sizes[i],
         )
         remaining = list(self.dims)  # remaining split capacity per dim
+        splits = [1] * len(self.dims)  # product of split factors taken
         whole = [True] * len(self.dims)  # dim not yet split/used
         out = {i: (1, 1.0) for i in range(len(sizes)) if sizes[i] == 1}
+        nd = len(self.dims)
+
+        def take_whole(pick):
+            for i in pick:
+                whole[i] = False
+                remaining[i] = 1
+            # ring closes if every picked dim wraps (a multi-dim block
+            # of full wrapped dims embeds a Hamiltonian torus ring)
+            return 2.0 if all(self.wrap[i] for i in pick) else 1.0
+
+        def untake_whole(pick):
+            for i in pick:
+                whole[i] = True
+                remaining[i] = self.dims[i]
 
         def rec(k: int) -> bool:
             if k == len(order):
@@ -97,38 +119,57 @@ class PhysicalTopology:
             ax = order[k]
             a = sizes[ax]
             # (a) product of whole dims: try subsets (small dim count)
-            nd = len(self.dims)
             for mask in range(1, 1 << nd):
                 pick = [i for i in range(nd) if mask >> i & 1]
                 if not all(whole[i] for i in pick):
                     continue
                 if math.prod(self.dims[i] for i in pick) != a:
                     continue
-                for i in pick:
-                    whole[i] = False
-                    remaining[i] = 1
-                # ring closes if every picked dim wraps (a multi-dim block
-                # of full wrapped dims embeds a Hamiltonian torus ring)
-                mult = 2.0 if all(self.wrap[i] for i in pick) else 1.0
-                out[ax] = (a, mult)
+                out[ax] = (a, take_whole(pick))
                 if rec(k + 1):
                     return True
-                for i in pick:
-                    whole[i] = True
-                    remaining[i] = self.dims[i]
-                continue
+                untake_whole(pick)
             # (b) divisor split of one dim (open line: no wrap for a
-            # partial ring)
-            for i in range(nd):
+            # partial ring).  Unsplit dims first so full-bandwidth
+            # embeddings are exhausted before strided ones.
+            for i in sorted(range(nd), key=lambda j: splits[j]):
                 if remaining[i] % a == 0 and remaining[i] > 1:
                     was_whole = whole[i]
+                    mult = 1.0 / splits[i]
                     remaining[i] //= a
+                    splits[i] *= a
                     whole[i] = False
+                    out[ax] = (a, mult)
+                    if rec(k + 1):
+                        return True
+                    splits[i] //= a
+                    remaining[i] = remaining[i] * a
+                    whole[i] = was_whole
+            # (c) whole dims × the first split of one more dim: a
+            # contiguous sub-grid block; any p×r grid with p*r even has a
+            # Hamiltonian cycle, so an open boustrophedon ring exists
+            for mask in range(1, 1 << nd):
+                pick = [i for i in range(nd) if mask >> i & 1]
+                if not all(whole[i] for i in pick):
+                    continue
+                p = math.prod(self.dims[i] for i in pick)
+                if p == 1 or a % p or a == p:
+                    continue
+                r = a // p
+                for j in range(nd):
+                    if j in pick or not whole[j] or remaining[j] % r or r == 1:
+                        continue
+                    take_whole(pick)
+                    remaining[j] //= r
+                    splits[j] *= r
+                    whole[j] = False
                     out[ax] = (a, 1.0)
                     if rec(k + 1):
                         return True
-                    remaining[i] = remaining[i] * a
-                    whole[i] = was_whole
+                    splits[j] //= r
+                    remaining[j] *= r
+                    whole[j] = True
+                    untake_whole(pick)
             return False
 
         return out if rec(0) else None

@@ -27,6 +27,83 @@ from flexflow_tpu.parallel.spec import TensorSharding
 from flexflow_tpu.tensor import Layer
 
 
+class _MemoList(list):
+    """List that invalidates its owner OpSharding's key() memo on every
+    in-place mutation — strategy builders assign entry.output[i] /
+    entry.inputs[j] directly, and a stale memo would silently corrupt the
+    search's dedup and cost caches."""
+
+    def __init__(self, it, owner):
+        super().__init__(it)
+        self._owner = owner
+
+    def _inv(self):
+        self._owner.__dict__.pop("_key_memo", None)
+
+    def __setitem__(self, i, v):
+        self._inv()
+        super().__setitem__(i, v)
+
+    def __delitem__(self, i):
+        self._inv()
+        super().__delitem__(i)
+
+    def append(self, v):
+        self._inv()
+        super().append(v)
+
+    def extend(self, it):
+        self._inv()
+        super().extend(it)
+
+    def insert(self, i, v):
+        self._inv()
+        super().insert(i, v)
+
+    def pop(self, *a):
+        self._inv()
+        return super().pop(*a)
+
+    def clear(self):
+        self._inv()
+        super().clear()
+
+
+class _MemoDict(dict):
+    """Dict counterpart of :class:`_MemoList` (entry.weights / extras)."""
+
+    def __init__(self, it, owner):
+        super().__init__(it)
+        self._owner = owner
+
+    def _inv(self):
+        self._owner.__dict__.pop("_key_memo", None)
+
+    def __setitem__(self, k, v):
+        self._inv()
+        super().__setitem__(k, v)
+
+    def __delitem__(self, k):
+        self._inv()
+        super().__delitem__(k)
+
+    def update(self, *a, **kw):
+        self._inv()
+        super().update(*a, **kw)
+
+    def pop(self, *a):
+        self._inv()
+        return super().pop(*a)
+
+    def setdefault(self, k, d=None):
+        self._inv()
+        return super().setdefault(k, d)
+
+    def clear(self):
+        self._inv()
+        super().clear()
+
+
 @dataclasses.dataclass
 class OpSharding:
     """Sharding decision for one PCG node.
@@ -55,11 +132,23 @@ class OpSharding:
     # Serialized and round-tripped; no runtime effect today (stage 0).
     stage: int = 0
 
+    def __post_init__(self):
+        # self-invalidating containers: ANY in-place mutation of the four
+        # key()-hashed fields clears the memo, so strategy builders can
+        # assign entry.output[i] / entry.weights[name] / entry.inputs[j] /
+        # entry.extras[k] freely even after key() was called
+        self.output = _MemoList(self.output, self)
+        self.weights = _MemoDict(self.weights, self)
+        self.inputs = _MemoList(self.inputs, self)
+        self.extras = _MemoDict(self.extras, self)
+
     def key(self) -> tuple:
         """Value identity (memoization/dedup/change detection).  Memoized:
-        the search treats OpShardings as immutable values (mutation goes
-        through :meth:`copy`), and key() dominated search profiles at 1.7M
-        calls per BERT-Large run."""
+        the search treats OpShardings as values, and key() dominated
+        search profiles at 1.7M calls per BERT-Large run.  The memo is
+        safe against mutation: field reassignment invalidates it via
+        ``__setattr__``, in-place container mutation via the _MemoList /
+        _MemoDict wrappers installed in ``__post_init__``."""
         k = self.__dict__.get("_key_memo")
         if k is None:
             k = (
@@ -71,6 +160,16 @@ class OpSharding:
             )
             self.__dict__["_key_memo"] = k
         return k
+
+    def __setattr__(self, name, value):
+        if name != "_key_memo":
+            self.__dict__.pop("_key_memo", None)
+        object.__setattr__(self, name, value)
+
+    def set_extra(self, name: str, value) -> None:
+        """Memo-safe in-place extras update."""
+        self.__dict__.pop("_key_memo", None)
+        self.extras[name] = value
 
     def copy(self) -> "OpSharding":
         return OpSharding(
@@ -305,7 +404,7 @@ def expert_parallel_strategy(
         ospec = list(o.spec)
         ospec[0] = tok
         entry.output[0] = TensorSharding(spec=tuple(ospec), partial_axes=o.partial_axes)
-        entry.extras["ep_axis"] = ep_axis
+        entry.set_extra("ep_axis", ep_axis)
     return st
 
 

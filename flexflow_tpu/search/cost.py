@@ -16,6 +16,7 @@ from __future__ import annotations
 import math
 from typing import Dict, List, Optional, Tuple
 
+from flexflow_tpu.fftype import DataType
 from flexflow_tpu.ops.base import get_op_def
 from flexflow_tpu.parallel.machine import MachineMesh
 from flexflow_tpu.parallel.strategy import OpSharding, Strategy
@@ -212,6 +213,7 @@ def reshard_cost(
     dst: "TensorSharding",
     mesh: MachineMesh,
     machine: TPUMachineModel,
+    with_backward: bool = False,
 ) -> float:
     """Collective time to move a tensor from distribution ``src`` to ``dst``.
 
@@ -226,13 +228,30 @@ def reshard_cost(
       * local slice    — axes added to a dim (``Repartition``; ~latency only)
     Deterministic pure function — unit-testable, unlike the reference's
     device-measured xfers (SURVEY §4.7 gap).
+
+    ``with_backward`` additionally charges the transpose collective the
+    autodiff of this edge runs in the backward pass — equal bytes for the
+    layout transposes (all-gather↔reduce-scatter, all-to-all↔all-to-all)
+    and a real all-gather for the cotangent of a forward slice.  Partial
+    resolution stays 1× here; its backward half (the column-parallel dx
+    all-reduce) is charged input-sized at the consumer node by
+    ``node_cost``.  Strategy costing must set it: pricing forward
+    reshards only systematically favors activation-sharded hybrids over
+    data parallelism (a 2D-sharded MLP "won" by exactly the unpriced
+    backward half before round 4).
     """
     from flexflow_tpu.parallel.spec import TensorSharding  # noqa: F401
 
     total = float(math.prod(shape)) * elt_bytes
     cost = 0.0
 
-    # partial-sum resolution (axes partial in src, not in dst)
+    bwd = 2.0 if with_backward else 1.0
+    # partial-sum resolution (axes partial in src, not in dst).  Priced 1×
+    # even under with_backward: the matching backward collective (the
+    # column-parallel dx all-reduce at the paired boundary) is charged
+    # where it actually runs — at the consumer node, input-sized — by
+    # node_cost's dgrad-sync term, and its bytes differ from this edge's
+    # whenever the pair isn't width-symmetric.
     pending = [a for a in src.partial_axes if a not in dst.partial_axes]
     shard_deg = max(1, src.total_degree(mesh))
     for a in pending:
@@ -253,7 +272,7 @@ def reshard_cost(
     for a in moved:
         n = mesh.axis_size(a)
         if n > 1:
-            cost += machine.all_to_all(bytes_per_dev_dst, n, axis=a)
+            cost += bwd * machine.all_to_all(bytes_per_dev_dst, n, axis=a)
     gather_factor = 1
     gather_axis = None
     for a in removed:
@@ -261,11 +280,26 @@ def reshard_cost(
         if a in machine.dcn_axes:
             gather_axis = a  # any DCN participant prices the whole gather
     if gather_factor > 1:
-        cost += machine.all_gather(bytes_per_dev_dst, gather_factor, axis=gather_axis)
+        cost += bwd * machine.all_gather(
+            bytes_per_dev_dst, gather_factor, axis=gather_axis
+        )
     # axes only in dst: local dynamic-slice, charge latency once
     added = [a for a in dst_map if a not in src_map]
     if added:
         cost += machine.latency
+        if with_backward:
+            # the cotangent of a forward slice is gathered back across the
+            # added axes — a real collective, unlike the forward slice
+            added_deg = 1
+            add_axis = None
+            for a in added:
+                added_deg *= mesh.axis_size(a)
+                if a in machine.dcn_axes:
+                    add_axis = a
+            if added_deg > 1:
+                cost += machine.all_gather(
+                    bytes_per_dev_dst * added_deg, added_deg, axis=add_axis
+                )
     return cost
 
 
@@ -331,6 +365,63 @@ def node_cost(
             t += m.all_reduce(wb / wd, sync, axis=sync_axis)
         if lambda_mem > 0.0:
             t += lambda_mem * (wb / wd)
+    # backward dgrad sync (Megatron's backward half): a weight-sharding
+    # axis the op's input layout doesn't carry means some dgrad
+    # contraction runs over a dim sharded by that axis, so the input
+    # cotangent comes out partial over it and autodiff resolves it with
+    # an input-sized all-reduce before handing it to the producer.
+    # Canonical cases: column-parallel linear (dx = dy @ W^T contracts
+    # the sharded out-dim); fused TP attention (dx before the sharded
+    # QKV projections).  Row-parallel inside a Megatron pair is exempt —
+    # its input spec carries the axis.  Integer inputs (embedding ids)
+    # are not differentiated, so vocab-sharded embeddings charge nothing.
+    part_deg = 1
+    for a in (out0.partial_axes if out0 is not None else ()):
+        part_deg *= mesh.axis_size(a)
+    out_deg_full = (out0.total_degree(mesh) if out0 is not None else 1) * part_deg
+    waxes_all = set()
+    # weight-side compute split beyond what the output carries (fused
+    # Experts EP): the op partitions its own computation over the weight
+    # axis and owns the dispatch collectives (all-to-all in its forward
+    # AND backward) — no dgrad partial arises, so no charge
+    if degree <= out_deg_full:
+        for w in opdef.weights(layer):
+            if w.trainable:
+                ws = sharding.weights.get(w.name)
+                if ws is not None:
+                    waxes_all |= set(ws.used_axes())
+    if waxes_all:
+        in_axes = set()
+        for ts in sharding.inputs:
+            if ts is not None:
+                for d in range(len(ts.spec)):
+                    in_axes |= set(ts.axes_of(d))
+        seen_guids = set()
+        float_in_bytes = 0.0
+        for tin in layer.inputs:
+            # graph inputs are exempt: grad is taken w.r.t. params only,
+            # so a graph input's cotangent (and its partial resolution) is
+            # dead code XLA eliminates — only produced activations whose
+            # cotangent flows to an upstream layer pay the all-reduce
+            if (
+                tin.guid in seen_guids
+                or tin.owner_layer is None
+                or tin.dtype in (
+                    DataType.INT32, DataType.INT64, DataType.BOOLEAN,
+                )
+            ):
+                continue
+            seen_guids.add(tin.guid)
+            float_in_bytes += math.prod(tin.shape) * _dtype_nbytes(tin.dtype)
+        for a in sorted(waxes_all - in_axes):
+            n = mesh.axis_size(a)
+            if n > 1 and float_in_bytes:
+                # input shard degree: the op's full compute degree
+                # (INCLUDING partial axes — fused TP attention carries the
+                # weight axis as an output partial, not an output shard)
+                # divided by this axis's own factor
+                in_deg = max(1, out_deg_full // n)
+                t += m.all_reduce(float_in_bytes / in_deg, n, axis=a)
     if lambda_mem > 0.0 and out0 is not None:
         out_b = sum(
             math.prod(s) * _dtype_nbytes(dt) for s, dt in opdef.infer(layer)
@@ -379,7 +470,10 @@ def estimate_strategy_cost(
             t = layer.inputs[0]
             src = producer_sharding(t) or TensorSharding.replicated(t.ndim)
             dst = resolve_parallel_sharding(layer, src, mesh)
-            total += reshard_cost(t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m)
+            total += reshard_cost(
+                t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m,
+                with_backward=True,
+            )
             pop_out[layer.outputs[0].guid] = dst
             continue
         os_ = strategy.op_sharding(layer)
@@ -427,12 +521,14 @@ def estimate_strategy_cost(
                 c = cost_cache.get(ek)
                 if c is None:
                     c = reshard_cost(
-                        t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m
+                        t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m,
+                        with_backward=True,
                     )
                     cost_cache[ek] = c
                 total += c
             else:
                 total += reshard_cost(
-                    t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m
+                    t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m,
+                    with_backward=True,
                 )
     return total

@@ -96,7 +96,10 @@ class SearchHelper:
         if _sh_key(src) == _sh_key(dst):
             return 0.0
         return reshard_cost(
-            t.shape, _dtype_nbytes(t.dtype), src, dst, self.mesh, self.machine
+            t.shape, _dtype_nbytes(t.dtype), src, dst, self.mesh, self.machine,
+            # graph inputs have no cotangent (grad is w.r.t. params only),
+            # so their edges carry no backward transpose collective
+            with_backward=t.owner_layer is not None,
         )
 
     def solve(self) -> Tuple[float, Dict[int, OpSharding]]:
@@ -180,7 +183,8 @@ class SearchHelper:
     ) -> float:
         t = layer.inputs[0]
         return reshard_cost(
-            t.shape, _dtype_nbytes(t.dtype), src, dst, self.mesh, self.machine
+            t.shape, _dtype_nbytes(t.dtype), src, dst, self.mesh, self.machine,
+            with_backward=t.owner_layer is not None,
         )
 
     def to_strategy(self, assign: Dict[int, OpSharding]) -> Strategy:

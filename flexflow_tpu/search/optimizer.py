@@ -116,7 +116,9 @@ def _unity_search_impl(
         if profiler is not None:
             from flexflow_tpu.search.simulator import MeasuredCostModel
 
-            node_time_fn = MeasuredCostModel(profiler, mv, machine).node_time
+            node_time_fn = MeasuredCostModel(
+                profiler, mv, machine, layers=layers
+            ).node_time
 
         def run(lam: float, _mv=mv, _ntf=node_time_fn):
             return graph_optimize(

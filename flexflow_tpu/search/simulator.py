@@ -28,7 +28,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from flexflow_tpu.fftype import DataType
+from flexflow_tpu.fftype import DataType, OperatorType
 from flexflow_tpu.ops.base import OpContext, get_op_def
 from flexflow_tpu.parallel.machine import MachineMesh
 from flexflow_tpu.parallel.strategy import OpSharding, Strategy
@@ -54,11 +54,76 @@ def _local_shape(shape: Tuple[int, ...], sharding, mesh: MachineMesh) -> Tuple[i
     return tuple(out)
 
 
+# XLA reliably fuses these into their producer (unary elementwise, norms,
+# dropout): they cost ~nothing when compiled TOGETHER with the anchor but a
+# full HBM round-trip when timed in isolation — exactly SURVEY §7.3 risk #2
+# ("cost measurement under XLA").  Segments bound that error.
+_FUSABLE_FOLLOWERS = frozenset({
+    OperatorType.RELU, OperatorType.SIGMOID, OperatorType.TANH,
+    OperatorType.ELU, OperatorType.GELU, OperatorType.RSQRT,
+    OperatorType.EXP, OperatorType.SIN, OperatorType.COS,
+    OperatorType.POW, OperatorType.IDENTITY,
+    OperatorType.SCALAR_MULTIPLY, OperatorType.SCALAR_ADD,
+    OperatorType.SCALAR_SUB, OperatorType.SCALAR_TRUE_DIV,
+    OperatorType.DROPOUT, OperatorType.SOFTMAX,
+    OperatorType.LAYERNORM, OperatorType.RMS_NORM,
+})
+# ops worth anchoring a fused segment on (MXU / gather work)
+_SEGMENT_ANCHORS = frozenset({
+    OperatorType.LINEAR, OperatorType.CONV2D, OperatorType.BATCHMATMUL,
+    OperatorType.EMBEDDING, OperatorType.MULTIHEAD_ATTENTION,
+})
+
+
+def find_fusion_segments(layers: List[Layer]) -> Dict[int, List[Layer]]:
+    """Linear fusion chains ``anchor_guid -> [anchor, follower, ...]``.
+
+    A follower joins when it is the SOLE consumer of the running output,
+    is a fusable elementwise/norm op, and takes no other produced tensor
+    (residual adds that join a second live branch break the chain — their
+    fusion depends on the other branch's schedule)."""
+    consumers: Dict[int, List[Layer]] = {}
+    produced = set()
+    for l in layers:
+        for t in l.inputs:
+            consumers.setdefault(t.guid, []).append(l)
+        for t in l.outputs:
+            produced.add(t.guid)
+    segs: Dict[int, List[Layer]] = {}
+    used: set = set()
+    for l in layers:
+        if l.op_type not in _SEGMENT_ANCHORS or int(l.layer_guid) in used:
+            continue
+        chain = [l]
+        cur = l
+        while cur.outputs:
+            cons = consumers.get(cur.outputs[0].guid, [])
+            if len(cons) != 1:
+                break
+            nxt = cons[0]
+            if nxt.op_type not in _FUSABLE_FOLLOWERS:
+                break
+            others = [
+                t for t in nxt.inputs
+                if t.guid != cur.outputs[0].guid and t.guid in produced
+            ]
+            if others:
+                break
+            chain.append(nxt)
+            cur = nxt
+        if len(chain) > 1:
+            segs[int(l.layer_guid)] = chain
+            used.update(int(c.layer_guid) for c in chain)
+    return segs
+
+
 class OpProfiler:
     """Compile-and-time profiler with a persistent cost cache.
 
     Cache key: ``(layer.params_key(), local input shapes)`` — the analog of
-    the reference's (OperatorParameters, MachineView) hash.
+    the reference's (OperatorParameters, MachineView) hash.  Segment
+    measurement (``measure_segment``) compiles a whole fusion chain as one
+    program, keyed by every member's params and the anchor's local shapes.
     """
 
     def __init__(self, cache_file: Optional[str] = None, iters: int = 5) -> None:
@@ -110,51 +175,76 @@ class OpProfiler:
             self._failed.add(key)
         return t
 
-    def _run(
+    def measure_segment(
         self,
-        layer: Layer,
-        local_in: List[Tuple[int, ...]],
+        chain: List[Layer],
         sharding: Optional[OpSharding],
         mesh: MachineMesh,
     ) -> float:
-        import jax
+        """Seconds for one fwd+bwd of a whole fusion chain compiled as ONE
+        jitted program at the anchor's per-shard shapes (the fix for
+        SURVEY §7.3 risk #2: isolated per-op timing charges a full HBM
+        round-trip for followers XLA would fuse away).  ``sharding`` is
+        the ANCHOR's OpSharding; unary followers inherit its output
+        layout, follower weights the matching trailing-dim slice."""
+        anchor = chain[0]
+        out0 = sharding.output[0] if sharding and sharding.output else None
+        local_in = []
+        for i, t in enumerate(anchor.inputs):
+            ts = None
+            if sharding and i < len(sharding.inputs):
+                ts = sharding.inputs[i]
+            local_in.append(_local_shape(t.shape, ts, mesh))
+        key = repr((
+            "seg",
+            tuple(l.params_key() for l in chain),
+            tuple(local_in),
+            None if out0 is None else out0.key(),
+        ))
+        if key in self.cache:
+            return self.cache[key]
+        if key in self._failed:
+            return -1.0
+        t = self._run_segment(chain, local_in, sharding, mesh)
+        if t > 0:
+            self.cache[key] = t
+        else:
+            self._failed.add(key)
+        return t
+
+    @staticmethod
+    def _mk_array(rng, shape, dt: DataType):
         import jax.numpy as jnp
 
-        opdef = get_op_def(layer.op_type)
-        rng = np.random.default_rng(0)
+        if dt in (DataType.INT32, DataType.INT64):
+            return jnp.asarray(rng.integers(0, 2, size=shape), dt.to_jnp())
+        return jnp.asarray(rng.normal(size=shape), dt.to_jnp())
 
-        def mk(shape, dt: DataType):
-            if dt in (DataType.INT32, DataType.INT64):
-                return jnp.asarray(rng.integers(0, 2, size=shape), dt.to_jnp())
-            return jnp.asarray(rng.normal(size=shape), dt.to_jnp())
-
-        ins = [mk(s, t.dtype) for s, t in zip(local_in, layer.inputs)]
-        params = {}
-        for w in opdef.weights(layer):
-            ws = sharding.weights.get(w.name) if sharding else None
-            params[w.name] = mk(_local_shape(w.shape, ws, mesh), w.dtype)
+    def _time_fwd_loss(self, fwd_loss, params, ins) -> float:
+        """Shared timing harness: jit (value_and_grad when anything is
+        differentiable), compile+warmup once, then wall-clock self.iters
+        runs.  ONE copy on purpose — _run and _run_segment must stay
+        comparable, so any change to iteration count / dtype handling /
+        sync placement applies to both tiers."""
+        import jax
+        import jax.numpy as jnp
 
         float_in = [
             i for i, x in enumerate(ins) if jnp.issubdtype(x.dtype, jnp.inexact)
         ]
-
-        def fwd_loss(p, xs):
-            full = list(ins)
-            for i, x in zip(float_in, xs):
-                full[i] = x
-            outs = opdef.forward(layer, p, full, OpContext(training=False))
-            return sum(
-                jnp.sum(o.astype(jnp.float32))
-                for o in outs
-                if jnp.issubdtype(o.dtype, jnp.floating)
-            )
-
         xs = [ins[i] for i in float_in]
+
+        def loss_with_subst(p, xs_):
+            full = list(ins)
+            for i, x in zip(float_in, xs_):
+                full[i] = x
+            return fwd_loss(p, full)
+
         has_grad = bool(params) or bool(xs)
         if has_grad:
-            fn = jax.jit(jax.value_and_grad(fwd_loss, argnums=(0, 1)))
+            fn = jax.jit(jax.value_and_grad(loss_with_subst, argnums=(0, 1)))
         else:
-            fn = jax.jit(fwd_loss)
+            fn = jax.jit(loss_with_subst)
         try:
             out = fn(params, xs)  # compile + warmup
             jax.block_until_ready(out)
@@ -168,23 +258,138 @@ class OpProfiler:
             # isolation fall back to the analytic roofline
             return -1.0
 
+    def _run_segment(
+        self,
+        chain: List[Layer],
+        local_in: List[Tuple[int, ...]],
+        sharding: Optional[OpSharding],
+        mesh: MachineMesh,
+    ) -> float:
+        import jax.numpy as jnp
+
+        anchor = chain[0]
+        out0 = sharding.output[0] if sharding and sharding.output else None
+        rng = np.random.default_rng(0)
+        mk = lambda shape, dt: self._mk_array(rng, shape, dt)  # noqa: E731
+
+        ins = [mk(s, t.dtype) for s, t in zip(local_in, anchor.inputs)]
+        params: Dict[Tuple[int, str], object] = {}
+        for l in chain:
+            opdef = get_op_def(l.op_type)
+            for w in opdef.weights(l):
+                if l is anchor:
+                    ws = sharding.weights.get(w.name) if sharding else None
+                elif out0 is not None and len(out0.spec) >= len(w.shape):
+                    # follower weights (layernorm scale/bias) span the
+                    # activation's trailing dims — mirror their sharding
+                    from flexflow_tpu.parallel.spec import TensorSharding
+
+                    ws = TensorSharding(spec=tuple(out0.spec[-len(w.shape):]))
+                else:
+                    ws = None
+                params[(int(l.layer_guid), w.name)] = mk(
+                    _local_shape(w.shape, ws, mesh), w.dtype
+                )
+
+        def fwd_loss(p, full):
+            cur = full
+            for l in chain:
+                opdef = get_op_def(l.op_type)
+                lp = {
+                    w.name: p[(int(l.layer_guid), w.name)]
+                    for w in opdef.weights(l)
+                }
+                outs = opdef.forward(l, lp, cur, OpContext(training=False))
+                cur = [outs[0]]  # followers are single-input by discovery
+            return sum(
+                jnp.sum(o.astype(jnp.float32))
+                for o in cur
+                if jnp.issubdtype(o.dtype, jnp.floating)
+            )
+
+        return self._time_fwd_loss(fwd_loss, params, ins)
+
+    def _run(
+        self,
+        layer: Layer,
+        local_in: List[Tuple[int, ...]],
+        sharding: Optional[OpSharding],
+        mesh: MachineMesh,
+    ) -> float:
+        import jax.numpy as jnp
+
+        opdef = get_op_def(layer.op_type)
+        rng = np.random.default_rng(0)
+        mk = lambda shape, dt: self._mk_array(rng, shape, dt)  # noqa: E731
+
+        ins = [mk(s, t.dtype) for s, t in zip(local_in, layer.inputs)]
+        params = {}
+        for w in opdef.weights(layer):
+            ws = sharding.weights.get(w.name) if sharding else None
+            params[w.name] = mk(_local_shape(w.shape, ws, mesh), w.dtype)
+
+        def fwd_loss(p, full):
+            outs = opdef.forward(layer, p, full, OpContext(training=False))
+            return sum(
+                jnp.sum(o.astype(jnp.float32))
+                for o in outs
+                if jnp.issubdtype(o.dtype, jnp.floating)
+            )
+
+        return self._time_fwd_loss(fwd_loss, params, ins)
+
 
 class MeasuredCostModel:
-    """Cost provider blending measured per-op times with the analytic model
+    """Cost provider blending measured times with the analytic model
     (measured when available and positive, roofline otherwise).  Plug into
-    ``SearchHelper``/``estimate_strategy_cost`` via ``node_time_fn``."""
+    ``SearchHelper``/``estimate_strategy_cost`` via ``node_time_fn``.
+
+    With ``layers`` provided, fusion segments (anchor + trailing
+    elementwise/norm chain) are timed as ONE compiled program: the whole
+    segment's time is charged at the anchor and its members cost zero —
+    so the DP ranks candidates by fused reality, not by per-op times that
+    double-charge HBM traffic XLA eliminates (SURVEY §7.3 risk #2)."""
 
     def __init__(
         self,
         profiler: OpProfiler,
         mesh: MachineMesh,
         machine: Optional[TPUMachineModel] = None,
+        layers: Optional[List[Layer]] = None,
     ) -> None:
         self.profiler = profiler
         self.mesh = mesh
         self.machine = (machine or TPUMachineModel()).for_mesh(mesh)
+        self.segments = find_fusion_segments(layers) if layers else {}
+        self._member_anchor = {
+            int(m.layer_guid): a
+            for a, ch in self.segments.items()
+            for m in ch[1:]
+        }
+        # anchors whose segment measurement has succeeded at least once;
+        # members price 0 only then (DP visits anchors first — topological)
+        self._segment_ok: set = set()
 
     def node_time(self, layer: Layer, sharding: Optional[OpSharding]) -> float:
+        guid = int(layer.layer_guid)
+        if guid in self.segments:
+            t = self.profiler.measure_segment(
+                self.segments[guid], sharding, self.mesh
+            )
+            if t > 0:
+                self._segment_ok.add(guid)
+                return t
+            # a segment that fails under SOME sharding is disabled
+            # entirely: otherwise members keep pricing 0.0 (anchor ok
+            # under another sharding) while this sharding's anchor falls
+            # back to isolated per-op — dropping the followers' time from
+            # exactly the candidate whose fused measurement broke
+            members = self.segments.pop(guid)[1:]
+            self._segment_ok.discard(guid)
+            for m in members:
+                self._member_anchor.pop(int(m.layer_guid), None)
+        elif self._member_anchor.get(guid) in self._segment_ok:
+            return 0.0  # charged at the segment anchor
         t = self.profiler.measure(layer, sharding, self.mesh)
         if t > 0:
             return t
@@ -362,7 +567,10 @@ def simulate_strategy(
             src_tasks = produced.get(t.guid, [None] * n_dev)
             src_sh = producer_sharding(t) or TensorSharding.replicated(t.ndim)
             dst_sh = resolve_parallel_sharding(layer, src_sh, mesh)
-            dur = reshard_cost(t.shape, _dtype_nbytes(t.dtype), src_sh, dst_sh, mesh, m)
+            dur = reshard_cost(
+                t.shape, _dtype_nbytes(t.dtype), src_sh, dst_sh, mesh, m,
+                with_backward=True,
+            )
             ct = collective(layer.name, dur, src_tasks)
             for o in layer.outputs:
                 produced[o.guid] = ct
@@ -388,7 +596,8 @@ def simulate_strategy(
                 dst = TensorSharding.replicated(t.ndim)
             if src is not None and dst is not None and src.key() != dst.key():
                 dur = reshard_cost(
-                    t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m
+                    t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m,
+                    with_backward=True,
                 )
                 if dur > 0:
                     ct = collective(f"reshard:{t.name}->{layer.name}", dur, p)

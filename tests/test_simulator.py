@@ -210,26 +210,31 @@ def test_collective_straggler_sync():
 
 
 def test_collective_cost_scaling_matches_measured():
-    """VERDICT item 5 tail: the analytic collective costs must scale with
-    bytes the way real XLA collectives do.  Absolute times differ (host
-    mesh != ICI) but the log-log scaling exponent of all-reduce over a
-    16x size range must land near the model's (both ~linear past the
-    latency floor).  Wall-clock sensitive, so opt-in
-    (FFTPU_TIMING_TESTS=1); tools/validate_costmodel.py is the manual
-    driver."""
+    """The analytic collective costs must scale with bytes the way real XLA
+    collectives do.  Absolute times differ (host mesh != ICI) but the
+    log-log scaling exponent of all-reduce over a 16x size range must land
+    near the model's (both ~linear past the latency floor).  Runs in every
+    CI pass: median-of-5 timing windows plus one retry absorb shared-host
+    scheduler noise (this was opt-in via FFTPU_TIMING_TESTS before —
+    leaving the cost model's only empirical anchor out of CI).
+    tools/validate_costmodel.py remains the manual full-sweep driver."""
     import sys, os
-    if os.environ.get("FFTPU_TIMING_TESTS") != "1":
-        pytest.skip("timing-sensitive; set FFTPU_TIMING_TESTS=1")
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
     from validate_costmodel import (
         measure_collectives, model_exponent, scaling_exponent,
     )
 
-    measured = measure_collectives(
-        sizes_kb=(128, 2048), iters=8,
-        collectives=("all_reduce", "all_to_all"),
-    )
-    for coll in ("all_reduce", "all_to_all"):
-        got = scaling_exponent(measured[coll])
-        want = model_exponent(coll, sizes_kb=(128, 2048))
-        assert abs(got - want) < 0.5, (coll, got, want)
+    last = {}
+    for _attempt in range(2):
+        measured = measure_collectives(
+            sizes_kb=(128, 2048), iters=8, windows=5,
+            collectives=("all_reduce", "all_to_all"),
+        )
+        last = {
+            coll: (scaling_exponent(measured[coll]),
+                   model_exponent(coll, sizes_kb=(128, 2048)))
+            for coll in ("all_reduce", "all_to_all")
+        }
+        if all(abs(got - want) < 0.5 for got, want in last.values()):
+            return
+    raise AssertionError(f"collective scaling exponents off: {last}")

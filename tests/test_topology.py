@@ -21,15 +21,37 @@ from flexflow_tpu.search.cost import TPUMachineModel
 
 # ------------------------------------------------------------ legality
 def test_illegal_factorization_rejected():
-    """8-way axis on a 4x4 slice has no contiguous ring: it would snake
-    across parts of both dims."""
+    """Non-divisor and oversize axes are rejected; an 8-way axis on a 4x4
+    slice IS legal — a whole dim times a half-dim is a contiguous 4x2
+    block with a boustrophedon ring (round-3 advisor finding)."""
     t = PhysicalTopology((4, 4))
-    assert not t.legal((8, 2))
-    assert not t.legal((2, 8))
+    assert t.legal((8, 2))
+    assert t.legal((2, 8))
+    assert not t.legal((3, 4))  # 3 divides nothing
+    assert not t.legal((8, 4))  # 32 > 16 chips
     assert t.legal((4, 4))
     assert t.legal((16, 1))  # whole-grid product
     assert t.legal((2, 2, 2, 2))  # nested splits of each dim
     assert t.legal((4, 2, 2))
+
+
+def test_strided_split_priced_down():
+    """Second and later splits of one physical dim ride stride-s links:
+    every physical link carries s interleaved rings, so the multiplier is
+    1/s, while first splits and whole-dim/block embeddings price 1.0."""
+    t = PhysicalTopology((4, 4))
+    # (2,2,2,2): each physical dim splits twice -> two full-bw axes (first
+    # splits of each dim) and two at 1/2 (the strided second splits)
+    mults = sorted(m for _, m in t.assign((2, 2, 2, 2)).values())
+    assert mults == [0.5, 0.5, 1.0, 1.0], mults
+    # (8,2): 8 = whole dim x first split (contiguous 4x2 block, full bw);
+    # the 2 rides the second split of the halved dim at 1/2
+    got = t.assign((8, 2))
+    assert got[0] == (8, 1.0), got
+    assert got[1] == (2, 0.5), got
+    # 8 on a 4x2 tray consumes the whole grid at full bandwidth
+    tray = PhysicalTopology((4, 2))
+    assert tray.assign((8, 1))[0] == (8, 1.0)
 
 
 def test_v5e_tray_shapes():
@@ -100,9 +122,10 @@ def test_machine_file_chip_and_topology(tmp_path):
     assert m.hbm_bw == pytest.approx(8.19e11)
     assert m.dcn_axes == ("data",)
     # the DCN axis is unconstrained by the per-slice ICI grid (it spans
-    # slices); an 8-way ICI axis still has no contiguous ring on 4x4
+    # slices); 8-way ICI axes embed as contiguous 4x2 blocks on a 4x4
     assert m.legal_mesh(MachineMesh((8, 2), ("data", "model")))
-    assert not m.legal_mesh(MachineMesh((2, 8), ("data", "model")))
+    assert m.legal_mesh(MachineMesh((2, 8), ("data", "model")))
+    assert not m.legal_mesh(MachineMesh((2, 6), ("data", "model")))
 
 
 def test_detect_off_tpu_returns_defaults():

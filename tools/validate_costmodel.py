@@ -21,7 +21,10 @@ import numpy as np
 
 
 def measure_collectives(sizes_kb=(256, 1024, 4096), n_dev=8, iters=20,
-                        collectives=None):
+                        collectives=None, windows=1):
+    """Time each collective at each size.  ``windows`` > 1 takes the median
+    of that many independent timing windows — the scaling exponent from a
+    single window is noise-prone on a shared host."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
@@ -60,11 +63,14 @@ def measure_collectives(sizes_kb=(256, 1024, 4096), n_dev=8, iters=20,
             )
             x = jnp.ones((n_dev * n,), jnp.float32)
             float(f(x)[0])  # compile + warmup
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                r = f(x)
-            float(r[0])
-            times.append((time.perf_counter() - t0) / iters)
+            samples = []
+            for _ in range(windows):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    r = f(x)
+                float(r[0])
+                samples.append((time.perf_counter() - t0) / iters)
+            times.append(float(np.median(samples)))
         results[name] = dict(zip(sizes_kb, times))
     return results
 

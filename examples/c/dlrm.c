@@ -3,9 +3,12 @@
  * driven by src/runtime/cpp_driver.cc, multi-input via the dataloader
  * family in src/c/flexflow_c.cc).
  *
- * Exercises the round-3 C API additions: multi-input fit/eval with mixed
- * dtypes (f32 + int32), reshape, concat, embedding, and weight get/set
- * round-trip.
+ * Exercises the round-3 C API (multi-input fit/eval with mixed dtypes,
+ * reshape, concat, embedding, weight get/set) plus the round-4 OBJECT
+ * surface (reference flexflow_c.h:209-278, :561-616, :672-690): Adam
+ * optimizer object with hyper-parameters chosen from C, Glorot/zero
+ * initializers attached from C, a C-side dataloader batch loop,
+ * per-parameter handles, tensor introspection, and trace begin/end.
  */
 #include <math.h>
 #include <stdio.h>
@@ -15,11 +18,13 @@
 #include "flexflow_c.h"
 
 #define N 256
+#define B 64
 #define DENSE_F 4
 #define SPARSE_F 2
 #define VOCAB 8
 #define EMB_D 8
 #define CLASSES 4
+#define EPOCHS 30
 
 static void fail(const char* what) {
   fprintf(stderr, "%s failed: %s\n", what, flexflow_last_error());
@@ -32,8 +37,15 @@ int main(void) {
   char* argv[] = {"dlrm_c", "--batch-size", "64"};
   ff_handle* cfg = flexflow_config_create(3, argv);
   if (!cfg) fail("config_create");
+  if (flexflow_config_get_batch_size(cfg) != B) fail("config_get_batch_size");
   ff_handle* model = flexflow_model_create(cfg);
   if (!model) fail("model_create");
+
+  /* initializers chosen from C (reference *_initializer_create) */
+  ff_handle* glorot = flexflow_glorot_uniform_initializer_create(42);
+  ff_handle* zeros = flexflow_zero_initializer_create();
+  ff_handle* norm = flexflow_norm_initializer_create(7, 0.0, 0.05);
+  if (!glorot || !zeros || !norm) fail("initializer_create");
 
   int64_t ddims[2] = {N, DENSE_F};
   ff_handle* dense_in =
@@ -43,13 +55,14 @@ int main(void) {
       flexflow_model_create_tensor(model, 2, sdims, 1, "sparse_in");
   if (!dense_in || !sparse_in) fail("create_tensor");
 
-  /* bottom MLP over dense features */
-  ff_handle* bot = flexflow_model_dense(model, dense_in, 8, 1);
-  if (!bot) fail("dense");
+  /* bottom MLP over dense features — full dense surface w/ initializers */
+  ff_handle* bot = flexflow_model_dense_full(model, dense_in, 8, 1 /*relu*/,
+                                             1 /*bias*/, glorot, zeros, "bot");
+  if (!bot) fail("dense_full");
   /* embedding over the categorical ids: (N, SPARSE_F, EMB_D) -> flat */
-  ff_handle* emb =
-      flexflow_model_embedding(model, sparse_in, VOCAB, EMB_D);
-  if (!emb) fail("embedding");
+  ff_handle* emb = flexflow_model_embedding_init(model, sparse_in, VOCAB,
+                                                 EMB_D, norm, "emb0");
+  if (!emb) fail("embedding_init");
   int64_t rdims[2] = {N, SPARSE_F * EMB_D};
   ff_handle* embf = flexflow_model_reshape(model, emb, 2, rdims);
   if (!embf) fail("reshape");
@@ -64,8 +77,24 @@ int main(void) {
   ff_handle* probs = flexflow_model_softmax(model, logits);
   if (!probs) fail("softmax");
 
-  if (flexflow_model_compile(model, 0 /*sparse-cce*/, 1 /*adam*/, 0.01) != 0)
-    fail("compile");
+  /* tensor introspection on the output handle */
+  if (flexflow_tensor_get_ndim(probs) != 2) fail("tensor_get_ndim");
+  int64_t tdims[2] = {0, 0};
+  if (flexflow_tensor_get_dims(probs, tdims) != 2 || tdims[0] != N ||
+      tdims[1] != CLASSES)
+    fail("tensor_get_dims");
+  if (flexflow_tensor_get_dtype(probs) != 0) fail("tensor_get_dtype");
+  if (flexflow_tensor_get_dtype(sparse_in) != 1) fail("tensor_get_dtype i32");
+
+  /* Adam object with hyper-parameters from C + explicit metric list */
+  ff_handle* adam =
+      flexflow_adam_optimizer_create(model, 0.02, 0.9, 0.999, 0.0, 1e-8);
+  if (!adam) fail("adam_create");
+  if (flexflow_adam_optimizer_set_lr(adam, 0.01) != 0) fail("adam_set_lr");
+  int metrics[1] = {0 /*accuracy*/};
+  if (flexflow_model_compile_optimizer(model, adam, 0 /*sparse-cce*/, metrics,
+                                       1) != 0)
+    fail("compile_optimizer");
   printf("parameters: %lld\n",
          (long long)flexflow_model_num_parameters(model));
 
@@ -83,25 +112,70 @@ int main(void) {
       xd[i * DENSE_F + j] = (float)rand() / RAND_MAX - 0.5f;
   }
 
-  const void* inputs[2] = {xd, xs};
-  const int64_t* dims[2] = {ddims, sdims};
-  int ndims[2] = {2, 2};
-  int dtypes[2] = {0, 1};
-  double acc = 0, thr = 0;
-  if (flexflow_model_fit(model, 2, inputs, dims, ndims, dtypes, y, 1, 30,
-                         &acc, &thr) != 0)
-    fail("fit");
-  printf("final accuracy: %.4f\n", acc);
-  printf("throughput: %.1f samples/s\n", thr);
+  /* C-side dataloaders (reference single_dataloader group) */
+  int64_t ydims[2] = {N, 1};
+  ff_handle* dl_xd =
+      flexflow_single_dataloader_create(model, xd, ddims, 2, 0, B, 0);
+  ff_handle* dl_xs =
+      flexflow_single_dataloader_create(model, xs, sdims, 2, 1, B, 0);
+  ff_handle* dl_y =
+      flexflow_single_dataloader_create(model, y, ydims, 2, 1, B, 0);
+  if (!dl_xd || !dl_xs || !dl_y) fail("dataloader_create");
+  if (flexflow_single_dataloader_get_num_samples(dl_xd) != N)
+    fail("dl num_samples");
+  int nb = flexflow_single_dataloader_get_num_batches(dl_xd);
+  if (nb != N / B) fail("dl num_batches");
 
-  /* weight round-trip: read, perturb, write, read back */
+  /* training loop driven batch-by-batch from C */
+  static float bxd[B * DENSE_F];
+  static int32_t bxs[B * SPARSE_F];
+  static int32_t by[B];
+  int64_t bddims[2] = {B, DENSE_F};
+  int64_t bsdims[2] = {B, SPARSE_F};
+  const void* binputs[2] = {bxd, bxs};
+  const int64_t* bdims[2] = {bddims, bsdims};
+  int bndims[2] = {2, 2};
+  int bdtypes[2] = {0, 1};
+  double step_loss = 0, last_loss = 0;
+  int traced = 0;
+  for (int epoch = 0; epoch < EPOCHS; ++epoch) {
+    flexflow_single_dataloader_reset(dl_xd);
+    flexflow_single_dataloader_reset(dl_xs);
+    flexflow_single_dataloader_reset(dl_y);
+    for (;;) {
+      int64_t got = flexflow_single_dataloader_next_batch(dl_xd, bxd,
+                                                          sizeof(bxd));
+      if (got == 0) break; /* epoch end */
+      if (got != (int64_t)sizeof(bxd)) fail("next_batch xd");
+      if (flexflow_single_dataloader_next_batch(dl_xs, bxs, sizeof(bxs)) !=
+          (int64_t)sizeof(bxs))
+        fail("next_batch xs");
+      if (flexflow_single_dataloader_next_batch(dl_y, by, sizeof(by)) !=
+          (int64_t)sizeof(by))
+        fail("next_batch y");
+      if (flexflow_model_train_step(model, 2, binputs, bdims, bndims, bdtypes,
+                                    by, 1, &step_loss) != 0)
+        fail("train_step");
+      if (!(step_loss == step_loss)) fail("train_step loss NaN");
+      if (!traced) {
+        /* after the first (compiling) step, later steps must replay */
+        if (flexflow_begin_trace(model, 1) != 0) fail("begin_trace");
+        traced = 1;
+      }
+    }
+    last_loss = step_loss;
+  }
+  if (flexflow_end_trace(model, 1) != 0) fail("end_trace (step recompiled)");
+  printf("final loss: %.4f\n", last_loss);
+
+  /* per-parameter handle round-trip on the embedding table */
   char names[4096];
   if (flexflow_model_weight_names(model, names, sizeof(names)) < 0)
     fail("weight_names");
   char* line = strtok(names, "\n");
   char layer[256] = {0}, weight[256] = {0};
   while (line) { /* first embedding kernel */
-    if (strstr(line, "embedding") && strstr(line, "/kernel")) {
+    if (strstr(line, "emb0") && strstr(line, "/kernel")) {
       const char* slash = strrchr(line, '/');
       size_t ll = (size_t)(slash - line);
       memcpy(layer, line, ll);
@@ -112,43 +186,60 @@ int main(void) {
     line = strtok(NULL, "\n");
   }
   if (!layer[0]) fail("find embedding weight");
-  int64_t n = flexflow_model_get_weight(model, layer, weight, NULL, 0);
-  if (n != VOCAB * EMB_D) fail("get_weight size");
+  ff_handle* param = flexflow_model_get_parameter(model, layer, weight);
+  if (!param) fail("get_parameter");
+  int64_t n = flexflow_parameter_num_elements(model, param);
+  if (n != VOCAB * EMB_D) fail("parameter_num_elements");
   float* w = (float*)malloc(n * sizeof(float));
-  if (flexflow_model_get_weight(model, layer, weight, w, n) != n)
-    fail("get_weight");
+  if (flexflow_parameter_get_f32(model, param, w, n) != n)
+    fail("parameter_get");
   for (int64_t i = 0; i < n; ++i) w[i] += 1.0f;
   int64_t wdims[2] = {VOCAB, EMB_D};
-  if (flexflow_model_set_weight(model, layer, weight, w, wdims, 2) != 0)
-    fail("set_weight");
+  if (flexflow_parameter_set_f32(model, param, w, wdims, 2) != 0)
+    fail("parameter_set");
   float* w2 = (float*)malloc(n * sizeof(float));
-  if (flexflow_model_get_weight(model, layer, weight, w2, n) != n)
-    fail("get_weight2");
+  if (flexflow_parameter_get_f32(model, param, w2, n) != n)
+    fail("parameter_get2");
   for (int64_t i = 0; i < n; ++i)
-    if (fabsf(w2[i] - w[i]) > 1e-6f) fail("weight roundtrip mismatch");
-  printf("weight roundtrip ok (%lld floats)\n", (long long)n);
+    if (fabsf(w2[i] - w[i]) > 1e-6f) fail("parameter roundtrip mismatch");
+  for (int64_t i = 0; i < n; ++i) w[i] -= 1.0f; /* restore for eval */
+  if (flexflow_parameter_set_f32(model, param, w, wdims, 2) != 0)
+    fail("parameter_restore");
+  printf("parameter roundtrip ok (%lld floats)\n", (long long)n);
 
-  /* step-level control: one more training step, loss must be finite */
-  double step_loss = 0;
-  if (flexflow_model_train_step(model, 2, inputs, dims, ndims, dtypes, y, 1,
-                                &step_loss) != 0)
-    fail("train_step");
-  if (!(step_loss == step_loss) || step_loss < 0) fail("train_step loss");
-  printf("train_step loss: %.4f\n", step_loss);
-
-  /* eval through the multi-input path */
+  /* eval through the multi-input path; accuracy computed C-side */
+  const void* inputs[2] = {xd, xs};
+  const int64_t* dims[2] = {ddims, sdims};
+  int ndims[2] = {2, 2};
+  int dtypes[2] = {0, 1};
   static float out[N * CLASSES];
   int64_t wrote =
       flexflow_model_eval(model, 2, inputs, dims, ndims, dtypes, out,
                           N * CLASSES);
   if (wrote != N * CLASSES) fail("eval");
-  printf("eval wrote %lld floats\n", (long long)wrote);
+  int correct = 0;
+  for (int i = 0; i < N; ++i) {
+    int arg = 0;
+    for (int c = 1; c < CLASSES; ++c)
+      if (out[i * CLASSES + c] > out[i * CLASSES + arg]) arg = c;
+    correct += (arg == y[i]);
+  }
+  double acc = (double)correct / N;
+  printf("final accuracy: %.4f\n", acc);
 
   free(w);
   free(w2);
+  flexflow_handle_destroy(param);
+  flexflow_single_dataloader_destroy(dl_xd);
+  flexflow_single_dataloader_destroy(dl_xs);
+  flexflow_single_dataloader_destroy(dl_y);
+  flexflow_adam_optimizer_destroy(adam);
+  flexflow_initializer_destroy(glorot);
+  flexflow_initializer_destroy(zeros);
+  flexflow_initializer_destroy(norm);
   flexflow_handle_destroy(probs);
   flexflow_handle_destroy(model);
   flexflow_handle_destroy(cfg);
   flexflow_finalize();
-  return 0;
+  return acc > 0.7 ? 0 : 2;
 }

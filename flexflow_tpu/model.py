@@ -914,23 +914,37 @@ class FFModel:
     ) -> PerfMetrics:
         """Loss & metrics in test mode over the full dataset, batch by
         batch (reference ``FFModel.eval``, ``flexflow_cffi.py:2106``:
-        reset metrics, iterate batches, accumulate PerfMetrics)."""
+        reset metrics, iterate batches, accumulate PerfMetrics).  A tail
+        batch shorter than ``batch_size`` is padded to the compiled batch
+        shape (one jit trace) but only its real rows enter the metrics,
+        each batch weighted by its actual row count."""
         assert self.executor is not None, "call compile() first"
         bs = batch_size or self.config.batch_size
-        xs = list(x) if isinstance(x, (list, tuple)) else [x]
-        loaders = [
-            SingleDataLoader(a, bs, None, None, shuffle=False) for a in xs
-        ] + [SingleDataLoader(np.asarray(y), bs, None, None, shuffle=False)]
-        it = BatchIterator(loaders)
+        xs = [
+            np.asarray(a)
+            for a in (x if isinstance(x, (list, tuple)) else [x])
+        ]
+        ya = np.asarray(y)
         ex = self.executor
         pm = PerfMetrics()
         import jax.numpy as _jnp
 
-        for batch in it:
-            *bx, by = batch
+        n = xs[0].shape[0]
+        assert all(a.shape[0] == n for a in xs) and ya.shape[0] == n, (
+            f"inputs/labels disagree on sample count: "
+            f"{[a.shape[0] for a in xs]} vs labels {ya.shape[0]}"
+        )
+        for start in range(0, n, bs):
+            rows = min(bs, n - start)
+            bx = [a[start:start + rows] for a in xs]
+            if rows < bs:
+                bx = [
+                    np.concatenate([b, np.repeat(b[-1:], bs - rows, axis=0)])
+                    for b in bx
+                ]
             logits = ex.forward(bx)
-            m = ex.metrics.compute(logits, _jnp.asarray(by))
-            pm.update({k: float(v) for k, v in m.items()}, bs)
+            m = ex.metrics.compute(logits[:rows], _jnp.asarray(ya[start:start + rows]))
+            pm.update({k: float(v) for k, v in m.items()}, rows)
         if verbose:
             print("eval: " + " ".join(
                 f"{k}={v:.4f}" for k, v in (("accuracy", pm.accuracy),)
@@ -959,6 +973,25 @@ class FFModel:
         for lname, ws in jax.tree.map(np.asarray, self.executor.state).items():
             out.setdefault(lname, {}).update(ws)
         return out
+
+    def weight_shape(self, layer_name: str, weight_name: str) -> Tuple[int, ...]:
+        """Global shape of one weight from executor/layer METADATA — no
+        device-to-host transfer (the C API's parameter handles size
+        buffers with this; ``get_weights`` would materialize every
+        table)."""
+        if self.executor is not None:
+            for store in (self.executor.params, self.executor.state):
+                arr = store.get(layer_name, {}).get(weight_name)
+                if arr is not None:
+                    return tuple(int(s) for s in arr.shape)
+        for l in self.layers:
+            if l.name == layer_name:
+                from flexflow_tpu.ops.base import get_op_def
+
+                for w in get_op_def(l.op_type).weights(l):
+                    if w.name == weight_name:
+                        return tuple(int(s) for s in w.shape)
+        raise KeyError(f"no weight {layer_name}/{weight_name}")
 
     @staticmethod
     def _weight_bucket(ex: Executor, lname: str, wname: str):

@@ -21,6 +21,7 @@
 
 #include <cstdarg>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <string>
 
@@ -436,7 +437,9 @@ int flexflow_model_fit_f32(ff_handle* model, const float* x,
 }
 
 // Forward one float32 batch; writes the flattened logits into out
-// (caller-sized out_len floats).  Returns number of floats written or -1.
+// (copying at most out_len floats).  Returns the FULL logits element
+// count (may exceed out_len — size the buffer and call again, matching
+// the flexflow_model_get_weight sizing convention) or -1 on error.
 int64_t flexflow_model_eval_f32(ff_handle* model, const float* x,
                                 const int64_t* xdims, int x_ndim, float* out,
                                 int64_t out_len) {
@@ -469,10 +472,10 @@ int64_t flexflow_model_eval_f32(ff_handle* model, const float* x,
   Py_ssize_t blen;
   PyBytes_AsStringAndSize(bytes, &buf, &blen);
   int64_t n = blen / (int64_t)sizeof(float);
-  if (n > out_len) n = out_len;
-  std::memcpy(out, buf, n * sizeof(float));
+  int64_t ncopy = n < out_len ? n : out_len;
+  if (out && ncopy > 0) std::memcpy(out, buf, ncopy * sizeof(float));
   Py_DECREF(bytes);
-  return n;
+  return n;  // full count: lets the caller distinguish a short buffer
 }
 
 // ------------------------------------------------ round-3 parity layers
@@ -672,10 +675,10 @@ int64_t flexflow_model_eval(ff_handle* model, int n_inputs, const void** xs,
   Py_ssize_t blen;
   PyBytes_AsStringAndSize(bytes, &buf, &blen);
   int64_t n = blen / (int64_t)sizeof(float);
-  if (n > out_len) n = out_len;
-  std::memcpy(out, buf, n * sizeof(float));
+  int64_t ncopy = n < out_len ? n : out_len;
+  if (out && ncopy > 0) std::memcpy(out, buf, ncopy * sizeof(float));
   Py_DECREF(bytes);
-  return n;
+  return n;  // full count: lets the caller distinguish a short buffer
 }
 
 int flexflow_model_train_step(ff_handle* model, int n_inputs,
@@ -825,6 +828,655 @@ int64_t flexflow_model_num_parameters(ff_handle* model) {
   int64_t v = PyLong_AsLongLong(n);
   Py_DECREF(n);
   return v;
+}
+
+// ================================================== round-4 object surface
+// The reference ABI exposes optimizer / initializer / dataloader / tensor
+// handle OBJECT groups (flexflow_c.h:209-278 optimizer+initializer create;
+// :561-616 dataloader + attach; :672-690 trace control).  Same groups here,
+// all as ff_handle-wrapped Python objects.
+
+// ------------------------------------------------------------- optimizers
+static ff_handle* make_optimizer(const char* cls, const char* kwfmt, ...) {
+  PyObject* mod = ff_module();
+  if (!mod) return nullptr;
+  PyObject* c = getattr_checked(mod, cls);
+  if (!c) return nullptr;
+  PyObject* kwargs = PyDict_New();
+  va_list ap;
+  va_start(ap, kwfmt);
+  for (const char* p = kwfmt; *p; ++p) {
+    const char* key = va_arg(ap, const char*);
+    PyObject* v = nullptr;
+    if (*p == 'd') v = PyFloat_FromDouble(va_arg(ap, double));
+    if (*p == 'b') v = PyBool_FromLong(va_arg(ap, int));
+    PyDict_SetItemString(kwargs, key, v);
+    Py_XDECREF(v);
+  }
+  va_end(ap);
+  PyObject* args = PyTuple_New(0);
+  PyObject* o = PyObject_Call(c, args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(c);
+  return wrap(o);
+}
+
+// `model` binds the optimizer to an FFModel (the reference does the same at
+// creation, flexflow_c.h:209): set_lr after compile then invalidates the
+// model's jitted train step so the new rate takes effect (hyper-parameters
+// are trace-time constants under jit — without the bind, a post-compile
+// set_lr would report success but keep training at the old rate).  NULL is
+// allowed for a free-standing optimizer (set hyper-params before compile).
+ff_handle* flexflow_sgd_optimizer_create(ff_handle* model, double lr,
+                                         double momentum, int nesterov,
+                                         double weight_decay) {
+  ff_handle* h = make_optimizer("SGDOptimizer", "ddbd", "lr", lr, "momentum",
+                                momentum, "nesterov", nesterov,
+                                "weight_decay", weight_decay);
+  if (h && model) PyObject_SetAttrString(h->obj, "_c_model", model->obj);
+  return h;
+}
+
+ff_handle* flexflow_adam_optimizer_create(ff_handle* model, double alpha,
+                                          double beta1, double beta2,
+                                          double weight_decay,
+                                          double epsilon) {
+  ff_handle* h = make_optimizer("AdamOptimizer", "ddddd", "alpha", alpha,
+                                "beta1", beta1, "beta2", beta2,
+                                "weight_decay", weight_decay, "epsilon",
+                                epsilon);
+  if (h && model) PyObject_SetAttrString(h->obj, "_c_model", model->obj);
+  return h;
+}
+
+// drop the bound model's compiled step so the next train_step retraces
+// with the updated hyper-parameters
+static void invalidate_compiled_step(PyObject* opt) {
+  PyObject* m = PyObject_GetAttrString(opt, "_c_model");
+  if (!m) {
+    PyErr_Clear();
+    return;  // free-standing optimizer: nothing compiled against it yet
+  }
+  PyObject* ex = PyObject_GetAttrString(m, "executor");
+  Py_DECREF(m);
+  if (!ex) {
+    PyErr_Clear();
+    return;
+  }
+  if (ex != Py_None) PyObject_SetAttrString(ex, "_step_jit", Py_None);
+  Py_DECREF(ex);
+}
+
+static int set_double_attr(ff_handle* h, const char* attr, double v) {
+  if (!h) return -1;
+  PyObject* f = PyFloat_FromDouble(v);
+  int rc = PyObject_SetAttrString(h->obj, attr, f);
+  Py_DECREF(f);
+  if (rc != 0) capture_py_error();
+  return rc;
+}
+
+int flexflow_sgd_optimizer_set_lr(ff_handle* opt, double lr) {
+  int rc = set_double_attr(opt, "lr", lr);
+  if (rc == 0) invalidate_compiled_step(opt->obj);
+  return rc;
+}
+
+int flexflow_adam_optimizer_set_lr(ff_handle* opt, double alpha) {
+  int rc = set_double_attr(opt, "alpha", alpha);
+  if (rc == 0) invalidate_compiled_step(opt->obj);
+  return rc;
+}
+
+void flexflow_sgd_optimizer_destroy(ff_handle* h) { flexflow_handle_destroy(h); }
+void flexflow_adam_optimizer_destroy(ff_handle* h) { flexflow_handle_destroy(h); }
+
+// compile with an optimizer OBJECT and an explicit metric list
+// (metric codes: 0 accuracy, 1 categorical ce, 2 sparse categorical ce,
+//  3 mse, 4 rmse, 5 mae — ffconst.h METRICS_* analog)
+int flexflow_model_compile_optimizer(ff_handle* model, ff_handle* optimizer,
+                                     int loss, const int* metrics,
+                                     int n_metrics) {
+  PyObject* mod = ff_module();
+  if (!mod || !optimizer) return -1;
+  PyObject* loss_cls = getattr_checked(mod, "LossType");
+  if (!loss_cls) return -1;
+  const char* lname = loss == 1   ? "CATEGORICAL_CROSSENTROPY"
+                      : loss == 2 ? "MEAN_SQUARED_ERROR_AVG_REDUCE"
+                                  : "SPARSE_CATEGORICAL_CROSSENTROPY";
+  PyObject* lt = getattr_checked(loss_cls, lname);
+  Py_DECREF(loss_cls);
+  if (!lt) return -1;
+  static const char* kMetricNames[] = {
+      "ACCURACY", "CATEGORICAL_CROSSENTROPY",
+      "SPARSE_CATEGORICAL_CROSSENTROPY", "MEAN_SQUARED_ERROR",
+      "ROOT_MEAN_SQUARED_ERROR", "MEAN_ABSOLUTE_ERROR"};
+  PyObject* m_cls = getattr_checked(mod, "MetricsType");
+  PyObject* mlist = PyList_New(0);
+  for (int i = 0; m_cls && i < n_metrics; ++i) {
+    if (metrics[i] < 0 || metrics[i] > 5) continue;
+    PyObject* m = getattr_checked(m_cls, kMetricNames[metrics[i]]);
+    if (m) {
+      PyList_Append(mlist, m);
+      Py_DECREF(m);
+    }
+  }
+  Py_XDECREF(m_cls);
+  PyObject* kwargs = PyDict_New();
+  PyDict_SetItemString(kwargs, "optimizer", optimizer->obj);
+  PyDict_SetItemString(kwargs, "loss_type", lt);
+  PyDict_SetItemString(kwargs, "metrics", mlist);
+  Py_DECREF(lt);
+  Py_DECREF(mlist);
+  PyObject* meth = getattr_checked(model->obj, "compile");
+  if (!meth) {
+    Py_DECREF(kwargs);
+    return -1;
+  }
+  PyObject* args = PyTuple_New(0);
+  PyObject* r = PyObject_Call(meth, args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(meth);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+// ------------------------------------------------------------ initializers
+static ff_handle* make_from_module(const char* modname, const char* cls,
+                                   const char* fmt, ...) {
+  PyObject* mod = PyImport_ImportModule(modname);
+  if (!mod) {
+    capture_py_error();
+    return nullptr;
+  }
+  PyObject* c = getattr_checked(mod, cls);
+  Py_DECREF(mod);
+  if (!c) return nullptr;
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject* args = PyTuple_New((Py_ssize_t)std::strlen(fmt));
+  for (Py_ssize_t i = 0; fmt[i]; ++i) {
+    PyObject* v = nullptr;
+    if (fmt[i] == 'i') v = PyLong_FromLong(va_arg(ap, int));
+    if (fmt[i] == 'd') v = PyFloat_FromDouble(va_arg(ap, double));
+    PyTuple_SET_ITEM(args, i, v);
+  }
+  va_end(ap);
+  PyObject* o = PyObject_Call(c, args, nullptr);
+  Py_DECREF(args);
+  Py_DECREF(c);
+  return wrap(o);
+}
+
+ff_handle* flexflow_glorot_uniform_initializer_create(int seed) {
+  return make_from_module("flexflow_tpu.initializer", "GlorotUniform", "i",
+                          seed);
+}
+ff_handle* flexflow_zero_initializer_create(void) {
+  return make_from_module("flexflow_tpu.initializer", "ZeroInitializer", "");
+}
+ff_handle* flexflow_ones_initializer_create(void) {
+  return make_from_module("flexflow_tpu.initializer", "OnesInitializer", "");
+}
+ff_handle* flexflow_uniform_initializer_create(int seed, double minv,
+                                               double maxv) {
+  return make_from_module("flexflow_tpu.initializer", "UniformInitializer",
+                          "idd", seed, minv, maxv);
+}
+ff_handle* flexflow_norm_initializer_create(int seed, double mean,
+                                            double stddev) {
+  return make_from_module("flexflow_tpu.initializer", "NormInitializer",
+                          "idd", seed, mean, stddev);
+}
+ff_handle* flexflow_constant_initializer_create(double value) {
+  return make_from_module("flexflow_tpu.initializer", "ConstantInitializer",
+                          "d", value);
+}
+void flexflow_initializer_destroy(ff_handle* h) { flexflow_handle_destroy(h); }
+
+// dense with the full reference parameter surface (flexflow_c.h
+// flexflow_model_add_dense: activation, use_bias, kernel/bias initializer)
+ff_handle* flexflow_model_dense_full(ff_handle* model, ff_handle* input,
+                                     int out_dim, int activation,
+                                     int use_bias, ff_handle* kernel_init,
+                                     ff_handle* bias_init, const char* name) {
+  PyObject* act = acti_mode(activation);
+  if (!act) return nullptr;
+  PyObject* kwargs = PyDict_New();
+  PyDict_SetItemString(kwargs, "activation", act);
+  Py_DECREF(act);
+  PyObject* ub = PyBool_FromLong(use_bias);
+  PyDict_SetItemString(kwargs, "use_bias", ub);
+  Py_DECREF(ub);
+  if (kernel_init)
+    PyDict_SetItemString(kwargs, "kernel_initializer", kernel_init->obj);
+  if (bias_init)
+    PyDict_SetItemString(kwargs, "bias_initializer", bias_init->obj);
+  if (name) {
+    PyObject* n = PyUnicode_FromString(name);
+    PyDict_SetItemString(kwargs, "name", n);
+    Py_DECREF(n);
+  }
+  PyObject* meth = getattr_checked(model->obj, "dense");
+  if (!meth) {
+    Py_DECREF(kwargs);
+    return nullptr;
+  }
+  PyObject* args = Py_BuildValue("(Oi)", input->obj, out_dim);
+  PyObject* t = PyObject_Call(meth, args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(meth);
+  return wrap(t);
+}
+
+ff_handle* flexflow_model_embedding_init(ff_handle* model, ff_handle* input,
+                                         int num_entries, int out_dim,
+                                         ff_handle* kernel_init,
+                                         const char* name) {
+  PyObject* kwargs = PyDict_New();
+  if (kernel_init)
+    PyDict_SetItemString(kwargs, "kernel_initializer", kernel_init->obj);
+  if (name) {
+    PyObject* n = PyUnicode_FromString(name);
+    PyDict_SetItemString(kwargs, "name", n);
+    Py_DECREF(n);
+  }
+  PyObject* meth = getattr_checked(model->obj, "embedding");
+  if (!meth) {
+    Py_DECREF(kwargs);
+    return nullptr;
+  }
+  PyObject* args = Py_BuildValue("(Oii)", input->obj, num_entries, out_dim);
+  PyObject* t = PyObject_Call(meth, args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(meth);
+  return wrap(t);
+}
+
+// ----------------------------------------------------------- tensor handles
+int flexflow_tensor_get_ndim(ff_handle* t) {
+  PyObject* sh = getattr_checked(t->obj, "shape");
+  if (!sh) return -1;
+  Py_ssize_t n = PySequence_Length(sh);
+  Py_DECREF(sh);
+  return (int)n;
+}
+
+int flexflow_tensor_get_dims(ff_handle* t, int64_t* out) {
+  PyObject* sh = getattr_checked(t->obj, "shape");
+  if (!sh) return -1;
+  Py_ssize_t n = PySequence_Length(sh);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* d = PySequence_GetItem(sh, i);
+    out[i] = d ? PyLong_AsLongLong(d) : -1;
+    Py_XDECREF(d);
+  }
+  Py_DECREF(sh);
+  return (int)n;
+}
+
+// 0 f32, 1 i32, 2 i64, 3 f64; -1 unknown (matches the fit/eval dtype codes)
+int flexflow_tensor_get_dtype(ff_handle* t) {
+  PyObject* dt = getattr_checked(t->obj, "dtype");
+  if (!dt) return -1;
+  PyObject* v = PyObject_GetAttrString(dt, "value");
+  Py_DECREF(dt);
+  if (!v) {
+    capture_py_error();
+    return -1;
+  }
+  const char* s = PyUnicode_AsUTF8(v);
+  int code = -1;
+  if (s) {
+    if (std::strcmp(s, "float32") == 0) code = 0;
+    if (std::strcmp(s, "int32") == 0) code = 1;
+    if (std::strcmp(s, "int64") == 0) code = 2;
+    if (std::strcmp(s, "float64") == 0) code = 3;
+  }
+  Py_DECREF(v);
+  return code;
+}
+
+// A parameter handle is a ("layer_name", "weight_name") pair; get/set run
+// through the model's weight table (the reference's parameter handles
+// resolve region requirements instead, flexflow_c.h:441-520).
+ff_handle* flexflow_model_get_parameter(ff_handle* model,
+                                        const char* layer_name,
+                                        const char* weight_name) {
+  // validate eagerly through shape METADATA (weight_shape raises on a bad
+  // name without materializing any table to host)
+  PyObject* sh = PyObject_CallMethod(model->obj, "weight_shape", "ss",
+                                     layer_name, weight_name);
+  if (!sh) {
+    capture_py_error();
+    return nullptr;
+  }
+  Py_DECREF(sh);
+  return wrap(Py_BuildValue("(ss)", layer_name, weight_name));
+}
+
+static int param_names(ff_handle* param, const char** lname,
+                       const char** wname) {
+  if (!param || !PyTuple_Check(param->obj)) {
+    g_last_error = "not a parameter handle";
+    return -1;
+  }
+  *lname = PyUnicode_AsUTF8(PyTuple_GET_ITEM(param->obj, 0));
+  *wname = PyUnicode_AsUTF8(PyTuple_GET_ITEM(param->obj, 1));
+  return (*lname && *wname) ? 0 : -1;
+}
+
+int64_t flexflow_parameter_get_f32(ff_handle* model, ff_handle* param,
+                                   float* out, int64_t out_len) {
+  const char *l, *w;
+  if (param_names(param, &l, &w) != 0) return -1;
+  return flexflow_model_get_weight(model, l, w, out, out_len);
+}
+
+int flexflow_parameter_set_f32(ff_handle* model, ff_handle* param,
+                               const float* data, const int64_t* dims,
+                               int ndim) {
+  const char *l, *w;
+  if (param_names(param, &l, &w) != 0) return -1;
+  return flexflow_model_set_weight(model, l, w, data, dims, ndim);
+}
+
+int64_t flexflow_parameter_num_elements(ff_handle* model, ff_handle* param) {
+  const char *l, *w;
+  if (param_names(param, &l, &w) != 0) return -1;
+  // metadata only — sizing must not pull gigabyte tables to host
+  PyObject* sh =
+      PyObject_CallMethod(model->obj, "weight_shape", "ss", l, w);
+  if (!sh) {
+    capture_py_error();
+    return -1;
+  }
+  int64_t n = 1;
+  Py_ssize_t nd = PySequence_Length(sh);
+  for (Py_ssize_t i = 0; i < nd; ++i) {
+    PyObject* d = PySequence_GetItem(sh, i);
+    n *= d ? PyLong_AsLongLong(d) : 0;
+    Py_XDECREF(d);
+  }
+  Py_DECREF(sh);
+  return n;
+}
+
+// -------------------------------------------------------------- dataloader
+// (reference single_dataloader group, flexflow_c.h:635-660; ours copies
+// host batches out instead of attaching region pointers)
+ff_handle* flexflow_single_dataloader_create(ff_handle* model,
+                                             const void* data,
+                                             const int64_t* dims, int ndim,
+                                             int dtype, int batch_size,
+                                             int shuffle) {
+  (void)model;
+  static const char* kDtypes[] = {"float32", "int32", "int64", "float64"};
+  if (dtype < 0 || dtype > 3) {
+    g_last_error = "bad dtype code";
+    return nullptr;
+  }
+  PyObject* arr = np_array_copy(data, dims, ndim, kDtypes[dtype]);
+  if (!arr) return nullptr;
+  PyObject* mod = PyImport_ImportModule("flexflow_tpu.dataloader");
+  if (!mod) {
+    Py_DECREF(arr);
+    capture_py_error();
+    return nullptr;
+  }
+  PyObject* cls = getattr_checked(mod, "SingleDataLoader");
+  Py_DECREF(mod);
+  if (!cls) {
+    Py_DECREF(arr);
+    return nullptr;
+  }
+  PyObject* kwargs = PyDict_New();
+  PyObject* sh = PyBool_FromLong(shuffle);
+  PyDict_SetItemString(kwargs, "shuffle", sh);
+  Py_DECREF(sh);
+  PyObject* args = Py_BuildValue("(Oi)", arr, batch_size);
+  Py_DECREF(arr);
+  PyObject* dl = PyObject_Call(cls, args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(cls);
+  ff_handle* h = wrap(dl);
+  if (h) {
+    PyObject* zero = PyLong_FromLong(0);
+    PyObject_SetAttrString(dl, "_c_cursor", zero);
+    Py_DECREF(zero);
+  }
+  return h;
+}
+
+void flexflow_single_dataloader_destroy(ff_handle* h) {
+  flexflow_handle_destroy(h);
+}
+
+static int64_t get_int_attr(ff_handle* h, const char* attr) {
+  PyObject* v = getattr_checked(h->obj, attr);
+  if (!v) return -1;
+  int64_t n = PyLong_AsLongLong(v);
+  Py_DECREF(v);
+  return n;
+}
+
+int flexflow_single_dataloader_get_num_samples(ff_handle* dl) {
+  return (int)get_int_attr(dl, "num_samples");
+}
+
+int flexflow_single_dataloader_set_num_samples(ff_handle* dl, int n) {
+  PyObject* v = PyLong_FromLong(n);
+  int rc = PyObject_SetAttrString(dl->obj, "num_samples", v);
+  Py_DECREF(v);
+  if (rc != 0) capture_py_error();
+  return rc;
+}
+
+int flexflow_single_dataloader_get_num_batches(ff_handle* dl) {
+  return (int)get_int_attr(dl, "num_batches");
+}
+
+int flexflow_single_dataloader_reset(ff_handle* dl) {
+  PyObject* r = PyObject_CallMethod(dl->obj, "reset", nullptr);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  PyObject* zero = PyLong_FromLong(0);
+  PyObject_SetAttrString(dl->obj, "_c_cursor", zero);
+  Py_DECREF(zero);
+  return 0;
+}
+
+// Copies the next batch into `out` (at most out_capacity bytes) and
+// advances the cursor.  Returns the FULL batch byte count (size with a
+// first call, then copy — the get_weight convention), or 0 at epoch end
+// (call reset), or -1 on error.
+int64_t flexflow_single_dataloader_next_batch(ff_handle* dl, void* out,
+                                              int64_t out_capacity) {
+  int64_t cursor = get_int_attr(dl, "_c_cursor");
+  int64_t nb = get_int_attr(dl, "num_batches");
+  if (cursor < 0 || nb < 0) return -1;
+  if (cursor >= nb) return 0;
+  PyObject* batch =
+      PyObject_CallMethod(dl->obj, "next_batch", "i", (int)cursor);
+  if (!batch) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* np = np_module();
+  PyObject* arr =
+      np ? PyObject_CallMethod(np, "ascontiguousarray", "O", batch) : nullptr;
+  Py_DECREF(batch);
+  if (!arr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* bytes = PyObject_CallMethod(arr, "tobytes", nullptr);
+  Py_DECREF(arr);
+  if (!bytes) {
+    capture_py_error();
+    return -1;
+  }
+  char* buf;
+  Py_ssize_t blen;
+  PyBytes_AsStringAndSize(bytes, &buf, &blen);
+  int64_t ncopy = blen < out_capacity ? blen : out_capacity;
+  if (out && ncopy > 0) std::memcpy(out, buf, ncopy);
+  Py_DECREF(bytes);
+  PyObject* nxt = PyLong_FromLongLong(cursor + 1);
+  PyObject_SetAttrString(dl->obj, "_c_cursor", nxt);
+  Py_DECREF(nxt);
+  return (int64_t)blen;
+}
+
+// ----------------------------------------------------------- trace control
+// Reference begin/end trace capture a Legion trace for replay
+// (flexflow_c.h:672-690).  Under XLA the jitted step IS the captured
+// trace; begin/end instead delimit a region asserted to REPLAY the cached
+// executable: end returns -1 if the step function was rebuilt (recompile)
+// inside the region — the same program-invariance contract a Legion trace
+// enforces at runtime.
+static PyObject* current_step_jit(ff_handle* model) {
+  // strong reference to the model's compiled step (or None); holding it
+  // across the trace region makes the end-of-region identity comparison
+  // address-reuse-proof (a freed object's address can be recycled)
+  PyObject* ex = PyObject_GetAttrString(model->obj, "executor");
+  PyObject* step = nullptr;
+  if (ex && ex != Py_None) step = PyObject_GetAttrString(ex, "_step_jit");
+  Py_XDECREF(ex);
+  if (!step) {
+    PyErr_Clear();
+    Py_INCREF(Py_None);
+    step = Py_None;
+  }
+  return step;
+}
+
+int flexflow_begin_trace(ff_handle* model, int trace_id) {
+  PyObject* step = current_step_jit(model);
+  char attr[64];
+  std::snprintf(attr, sizeof(attr), "_c_trace_%d", trace_id);
+  int rc = PyObject_SetAttrString(model->obj, attr, step);
+  Py_DECREF(step);
+  if (rc != 0) {
+    capture_py_error();
+    return -1;
+  }
+  return 0;
+}
+
+int flexflow_end_trace(ff_handle* model, int trace_id) {
+  char attr[64];
+  std::snprintf(attr, sizeof(attr), "_c_trace_%d", trace_id);
+  PyObject* saved = PyObject_GetAttrString(model->obj, attr);
+  if (!saved) {
+    capture_py_error();
+    return -1;  // end without matching begin
+  }
+  PyObject* step = current_step_jit(model);
+  // 0 = the region replayed the program captured at begin.  saved==None
+  // means no step existed at begin: the region's first run IS the trace
+  // capture; recompiles between the endpoints are unobservable then (the
+  // check sees endpoints only).
+  int ok = (saved == Py_None || saved == step) ? 0 : -1;
+  Py_DECREF(saved);
+  Py_DECREF(step);
+  PyObject_DelAttrString(model->obj, attr);
+  return ok;
+}
+
+// ------------------------------------------------------------------ config
+int flexflow_config_get_batch_size(ff_handle* cfg) {
+  return (int)get_int_attr(cfg, "batch_size");
+}
+
+int flexflow_config_get_epochs(ff_handle* cfg) {
+  return (int)get_int_attr(cfg, "epochs");
+}
+
+int flexflow_config_set_epochs(ff_handle* cfg, int epochs) {
+  PyObject* v = PyLong_FromLong(epochs);
+  int rc = PyObject_SetAttrString(cfg->obj, "epochs", v);
+  Py_DECREF(v);
+  if (rc != 0) capture_py_error();
+  return rc;
+}
+
+// ----------------------------------------------- op parity (unary + misc)
+static ff_handle* unary_op(ff_handle* model, ff_handle* input,
+                           const char* meth) {
+  return wrap(PyObject_CallMethod(model->obj, meth, "O", input->obj));
+}
+
+ff_handle* flexflow_model_gelu(ff_handle* m, ff_handle* x) {
+  return unary_op(m, x, "gelu");
+}
+ff_handle* flexflow_model_sigmoid(ff_handle* m, ff_handle* x) {
+  return unary_op(m, x, "sigmoid");
+}
+ff_handle* flexflow_model_tanh(ff_handle* m, ff_handle* x) {
+  return unary_op(m, x, "tanh");
+}
+ff_handle* flexflow_model_exp(ff_handle* m, ff_handle* x) {
+  return unary_op(m, x, "exp");
+}
+ff_handle* flexflow_model_identity(ff_handle* m, ff_handle* x) {
+  return unary_op(m, x, "identity");
+}
+
+ff_handle* flexflow_model_scalar_multiply(ff_handle* m, ff_handle* x,
+                                          double scalar) {
+  return wrap(
+      PyObject_CallMethod(m->obj, "scalar_multiply", "Od", x->obj, scalar));
+}
+
+ff_handle* flexflow_model_pow(ff_handle* m, ff_handle* x, double exponent) {
+  return wrap(PyObject_CallMethod(m->obj, "pow", "Od", x->obj, exponent));
+}
+
+ff_handle* flexflow_model_rms_norm(ff_handle* m, ff_handle* x, double eps) {
+  return wrap(PyObject_CallMethod(m->obj, "rms_norm", "Od", x->obj, eps));
+}
+
+ff_handle* flexflow_model_gather(ff_handle* m, ff_handle* data,
+                                 ff_handle* index, int dim) {
+  return wrap(PyObject_CallMethod(m->obj, "gather", "OOi", data->obj,
+                                  index->obj, dim));
+}
+
+static ff_handle* reduce_op(ff_handle* m, ff_handle* x, const char* meth,
+                            const int* axes, int n_axes, int keepdims) {
+  PyObject* ax = PyList_New(n_axes);
+  for (int i = 0; i < n_axes; ++i)
+    PyList_SET_ITEM(ax, i, PyLong_FromLong(axes[i]));
+  PyObject* kd = PyBool_FromLong(keepdims);
+  PyObject* t = PyObject_CallMethod(m->obj, meth, "OOO", x->obj, ax, kd);
+  Py_DECREF(ax);
+  Py_DECREF(kd);
+  return wrap(t);
+}
+
+ff_handle* flexflow_model_reduce_sum(ff_handle* m, ff_handle* x,
+                                     const int* axes, int n_axes,
+                                     int keepdims) {
+  return reduce_op(m, x, "reduce_sum", axes, n_axes, keepdims);
+}
+
+ff_handle* flexflow_model_reduce_mean(ff_handle* m, ff_handle* x,
+                                      const int* axes, int n_axes,
+                                      int keepdims) {
+  return reduce_op(m, x, "reduce_mean", axes, n_axes, keepdims);
 }
 
 }  // extern "C"

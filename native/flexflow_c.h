@@ -81,7 +81,10 @@ ff_handle* flexflow_model_moe(ff_handle* model, ff_handle* input,
 int flexflow_model_compile(ff_handle* model, int loss, int optimizer,
                            double lr);
 
-/* train / eval: single float32 input, int32 labels (xdims[0] samples) */
+/* train / eval: single float32 input, int32 labels (xdims[0] samples).
+ * The eval variants copy at most out_len floats into out but return the
+ * FULL logits element count (like flexflow_model_get_weight), so callers
+ * can size the buffer and distinguish truncation from completion. */
 int flexflow_model_fit_f32(ff_handle* model, const float* x,
                            const int64_t* xdims, int x_ndim, const int32_t* y,
                            int epochs, double* out_accuracy,
@@ -122,6 +125,119 @@ int flexflow_model_set_weight(ff_handle* model, const char* layer_name,
                               const int64_t* dims, int ndim);
 
 int64_t flexflow_model_num_parameters(ff_handle* model);
+
+/* ===================================================== object surface
+ * Reference ABI object groups (flexflow_c.h:209-278 optimizer +
+ * initializer create; :561-616 dataloader; :672-690 trace control),
+ * re-expressed over ff_handle.  All handles free with their *_destroy
+ * (or the generic flexflow_handle_destroy). */
+
+/* optimizers: pass to flexflow_model_compile_optimizer.  `model` binds
+ * the optimizer so a post-compile set_lr can invalidate the model's
+ * compiled train step (hyper-parameters are trace-time constants there).
+ * NULL is allowed ONLY for the set-hyper-params-before-compile workflow:
+ * with a NULL model, set_lr after compile still returns 0 but the step
+ * keeps training at the old rate. */
+ff_handle* flexflow_sgd_optimizer_create(ff_handle* model, double lr,
+                                         double momentum, int nesterov,
+                                         double weight_decay);
+void flexflow_sgd_optimizer_destroy(ff_handle* h);
+int flexflow_sgd_optimizer_set_lr(ff_handle* opt, double lr);
+ff_handle* flexflow_adam_optimizer_create(ff_handle* model, double alpha,
+                                          double beta1, double beta2,
+                                          double weight_decay,
+                                          double epsilon);
+void flexflow_adam_optimizer_destroy(ff_handle* h);
+int flexflow_adam_optimizer_set_lr(ff_handle* opt, double alpha);
+/* loss: 0 sparse-categorical-ce, 1 categorical-ce, 2 mse; metric codes:
+ * 0 accuracy, 1 categorical-ce, 2 sparse-categorical-ce, 3 mse, 4 rmse,
+ * 5 mae */
+int flexflow_model_compile_optimizer(ff_handle* model, ff_handle* optimizer,
+                                     int loss, const int* metrics,
+                                     int n_metrics);
+
+/* initializers: attach via flexflow_model_dense_full /
+ * flexflow_model_embedding_init (NULL = the layer's default) */
+ff_handle* flexflow_glorot_uniform_initializer_create(int seed);
+ff_handle* flexflow_zero_initializer_create(void);
+ff_handle* flexflow_ones_initializer_create(void);
+ff_handle* flexflow_uniform_initializer_create(int seed, double minv,
+                                               double maxv);
+ff_handle* flexflow_norm_initializer_create(int seed, double mean,
+                                            double stddev);
+ff_handle* flexflow_constant_initializer_create(double value);
+void flexflow_initializer_destroy(ff_handle* h);
+ff_handle* flexflow_model_dense_full(ff_handle* model, ff_handle* input,
+                                     int out_dim, int activation,
+                                     int use_bias, ff_handle* kernel_init,
+                                     ff_handle* bias_init, const char* name);
+ff_handle* flexflow_model_embedding_init(ff_handle* model, ff_handle* input,
+                                         int num_entries, int out_dim,
+                                         ff_handle* kernel_init,
+                                         const char* name);
+
+/* tensor handles (layer outputs / created tensors) */
+int flexflow_tensor_get_ndim(ff_handle* t);
+int flexflow_tensor_get_dims(ff_handle* t, int64_t* out); /* returns ndim */
+int flexflow_tensor_get_dtype(ff_handle* t); /* 0 f32 1 i32 2 i64 3 f64 */
+
+/* parameter handles: (layer, weight) pairs resolved against the model's
+ * weight table; get returns the FULL element count (size-then-copy) */
+ff_handle* flexflow_model_get_parameter(ff_handle* model,
+                                        const char* layer_name,
+                                        const char* weight_name);
+int64_t flexflow_parameter_get_f32(ff_handle* model, ff_handle* param,
+                                   float* out, int64_t out_len);
+int flexflow_parameter_set_f32(ff_handle* model, ff_handle* param,
+                               const float* data, const int64_t* dims,
+                               int ndim);
+int64_t flexflow_parameter_num_elements(ff_handle* model, ff_handle* param);
+
+/* dataloader: host-side batch streaming (dtype codes as above);
+ * next_batch returns FULL batch bytes (copying at most out_capacity),
+ * 0 at epoch end, -1 on error */
+ff_handle* flexflow_single_dataloader_create(ff_handle* model,
+                                             const void* data,
+                                             const int64_t* dims, int ndim,
+                                             int dtype, int batch_size,
+                                             int shuffle);
+void flexflow_single_dataloader_destroy(ff_handle* h);
+int flexflow_single_dataloader_get_num_samples(ff_handle* dl);
+int flexflow_single_dataloader_set_num_samples(ff_handle* dl, int n);
+int flexflow_single_dataloader_get_num_batches(ff_handle* dl);
+int flexflow_single_dataloader_reset(ff_handle* dl);
+int64_t flexflow_single_dataloader_next_batch(ff_handle* dl, void* out,
+                                              int64_t out_capacity);
+
+/* trace control: under XLA the jitted step IS the captured trace;
+ * begin/end delimit a region asserted to replay it — end returns -1 if
+ * the step recompiled inside the region */
+int flexflow_begin_trace(ff_handle* model, int trace_id);
+int flexflow_end_trace(ff_handle* model, int trace_id);
+
+/* config accessors */
+int flexflow_config_get_batch_size(ff_handle* cfg);
+int flexflow_config_get_epochs(ff_handle* cfg);
+int flexflow_config_set_epochs(ff_handle* cfg, int epochs);
+
+/* op parity: unary + misc */
+ff_handle* flexflow_model_gelu(ff_handle* m, ff_handle* x);
+ff_handle* flexflow_model_sigmoid(ff_handle* m, ff_handle* x);
+ff_handle* flexflow_model_tanh(ff_handle* m, ff_handle* x);
+ff_handle* flexflow_model_exp(ff_handle* m, ff_handle* x);
+ff_handle* flexflow_model_identity(ff_handle* m, ff_handle* x);
+ff_handle* flexflow_model_scalar_multiply(ff_handle* m, ff_handle* x,
+                                          double scalar);
+ff_handle* flexflow_model_pow(ff_handle* m, ff_handle* x, double exponent);
+ff_handle* flexflow_model_rms_norm(ff_handle* m, ff_handle* x, double eps);
+ff_handle* flexflow_model_gather(ff_handle* m, ff_handle* data,
+                                 ff_handle* index, int dim);
+ff_handle* flexflow_model_reduce_sum(ff_handle* m, ff_handle* x,
+                                     const int* axes, int n_axes,
+                                     int keepdims);
+ff_handle* flexflow_model_reduce_mean(ff_handle* m, ff_handle* x,
+                                      const int* axes, int n_axes,
+                                      int keepdims);
 
 #ifdef __cplusplus
 }

@@ -341,3 +341,30 @@ def test_unity_search_explores_mesh_factorizations():
     )
     # compute-dominated -> should pick a data-heavy factorization
     assert st.mesh.axis_size("data") >= st.mesh.axis_size("model")
+
+
+def test_search_handles_branching_pcg():
+    """Fork/join PCGs (reference split_test.cc / MLP_Unify mlp.cc are
+    dedicated apps for exactly this): the DP must assign every branch,
+    price the join correctly, and do no worse than plain DP."""
+    import sys as _sys, os as _os
+    _sys.path.insert(0, _os.path.join(_os.path.dirname(__file__), ".."))
+    from examples.mlp.branching import mlp_unify, split_test
+    from flexflow_tpu.parallel.strategy import data_parallel_strategy
+
+    for builder, n_inputs in ((split_test, 1), (mlp_unify, 2)):
+        model = FFModel(FFConfig(batch_size=64))
+        builder(model, 64)
+        mesh = MachineMesh((8, 1), ("data", "model"))
+        st = unity_search(
+            model.layers, mesh, graph_inputs=model.graph_inputs, budget=6
+        )
+        assert len(model.graph_inputs) == n_inputs
+        # every layer with weights got an assignment
+        for l in model.layers:
+            if l.op_type.value in ("linear",):
+                assert st.op_sharding(l) is not None, l.name
+        dp = data_parallel_strategy(model.layers, MachineMesh((8, 1), ("data", "model")))
+        assert estimate_strategy_cost(model.layers, st) <= estimate_strategy_cost(
+            model.layers, dp
+        ) * 1.0001

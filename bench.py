@@ -304,7 +304,6 @@ def run_bench(backend: str) -> None:
     repeats = 5 if on_tpu else 3
     head = _median_sps(model, [x], y, batch, steps=steps, windows=repeats)
     samples_per_sec = head["samples_per_sec"]
-    dt = steps * batch / samples_per_sec
 
     # fwd FLOPs from the op inventory; train step ~ 3x fwd (fwd + bwd 2x)
     fwd_flops = sum(
@@ -315,7 +314,7 @@ def run_bench(backend: str) -> None:
     step_flops = 3.0 * fwd_flops
     device_kind = jax.devices()[0].device_kind
     peak = _peak_flops(device_kind) if on_tpu else None
-    mfu = (step_flops * steps / dt / peak) if peak else None
+    mfu = (step_flops / (head["step_time_ms"] / 1000.0) / peak) if peak else None
     record = {
         "metric": "bert_base_train_throughput",
         "value": round(samples_per_sec, 2),
@@ -328,7 +327,7 @@ def run_bench(backend: str) -> None:
         "compute_dtype": dtype,
         "batch": batch,
         "seq": seq,
-        "step_time_ms": round(1000.0 * dt / steps, 2),
+        "step_time_ms": head["step_time_ms"],
         "mfu": round(mfu, 4) if mfu is not None else None,
         "peak_flops": peak,
         "sps_min": head["sps_min"],
@@ -433,7 +432,12 @@ def main() -> None:
         errors.append("tpu probe failed (backend init unavailable)")
     result, err = _run_child("cpu", CPU_BENCH_TIMEOUT_S)
     if result is not None:
-        result["note"] = "; ".join(errors) if errors else None
+        # append, never overwrite: a timeout-salvage note from
+        # _run_child must survive into the artifact
+        notes = [e for e in errors if e] + (
+            [result["note"]] if result.get("note") else []
+        )
+        result["note"] = "; ".join(notes) if notes else None
         print(json.dumps(result))
         return
     errors.append(err)

@@ -127,13 +127,20 @@ def _fwd_kernel(
         s = _dot_nt(q_ref[:], k_ref[:]) * sm_scale
         q_pos, k_pos = _positions(q_idx * block_q, kb * block_k, block_q, block_k)
         if causal:
-            s = jnp.where(q_pos + (sk - sq) >= k_pos, s, NEG_INF)
+            visible = q_pos + (sk - sq) >= k_pos
+            s = jnp.where(visible, s, NEG_INF)
         m_prev = m_ref[:, :1]  # (block_q, 1)
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
+        if causal:
+            # a row with NO visible key (ragged sq > sk) has s == m_new ==
+            # NEG_INF and p = exp(0) = 1 everywhere — zero it so such rows
+            # output 0 (the one-pass kernel's rule; block-level skip only
+            # protects fully-masked BLOCKS)
+            p = jnp.where(visible, p, 0.0)
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         if dropout_rate > 0.0:
             u = _uniform01(seed_ref[0, 0].astype(jnp.uint32),
@@ -248,6 +255,38 @@ ONEPASS_MAX_SK = 1024
 ONEPASS_MAX_SK_CAUSAL = 1024
 
 
+def _causal_kb_map(block_q, block_k, sq, sk, causal):
+    """K/V block index map for grids iterating kb per q block.  Causal
+    grids gate compute on blocks above the diagonal with ``pl.when``, but
+    the BlockSpec fetch would still run — clamping the index to the last
+    VISIBLE block makes consecutive gated steps map to the SAME block, and
+    the Mosaic pipeline skips the DMA when the block index is unchanged,
+    so masked blocks cost a (cheap) grid step instead of HBM traffic
+    (~half of all K/V fetches at sq == sk).  Gated steps never read the
+    (stale) buffer: the same predicate guards the compute."""
+    if not causal:
+        return lambda bh, qi, kb: (bh, kb, 0)
+
+    def imap(bh, qi, kb):
+        kb_max = (qi * block_q + block_q - 1 + (sk - sq)) // block_k
+        return bh, jnp.minimum(kb, jnp.maximum(kb_max, 0)), 0
+
+    return imap
+
+
+def _causal_qb_map(block_q, block_k, sq, sk, causal):
+    """Q-side counterpart for the dk/dv grid (bh, ki, qb): blocks BEFORE
+    the diagonal are gated, so clamp qb up to the first visible q block."""
+    if not causal:
+        return lambda bh, ki, qb: (bh, qb, 0)
+
+    def imap(bh, ki, qb):
+        qb_min = jnp.maximum((ki * block_k - (sk - sq)) // block_q, 0)
+        return bh, jnp.maximum(qb, qb_min), 0
+
+    return imap
+
+
 def _flash_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k):
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -262,6 +301,7 @@ def _flash_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k):
     vf = v.reshape(b * h, sk, d)
     seed_arr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
 
+    kv_map = _causal_kb_map(block_q, block_k, sq, sk, causal)
     kernel = functools.partial(
         _fwd_kernel, n_kb=n_kb, sq=sq, sk=sk, causal=causal,
         sm_scale=sm_scale, dropout_rate=dropout_rate,
@@ -272,8 +312,8 @@ def _flash_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k):
         in_specs=[
             pl.BlockSpec((1, 1), lambda bh, qi, kb: (0, 0)),
             pl.BlockSpec((None, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
-            pl.BlockSpec((None, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
-            pl.BlockSpec((None, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((None, block_k, d), kv_map),
+            pl.BlockSpec((None, block_k, d), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
@@ -330,8 +370,13 @@ def _dq_kernel(
         s = _dot_nt(q_ref[:], k_ref[:]) * sm_scale
         q_pos, k_pos = _positions(q_idx * block_q, kb * block_k, block_q, block_k)
         if causal:
-            s = jnp.where(q_pos + (sk - sq) >= k_pos, s, NEG_INF)
+            visible = q_pos + (sk - sq) >= k_pos
+            s = jnp.where(visible, s, NEG_INF)
         p = jnp.exp(s - lse)
+        if causal:
+            # rows with no visible key save lse ~ NEG_INF, making
+            # exp(NEG_INF - lse) explode instead of vanish — zero them
+            p = jnp.where(visible, p, 0.0)
         dp = _dot_nt(do_ref[:], v_ref[:])
         if dropout_rate > 0.0:
             u = _uniform01(seed_ref[0, 0].astype(jnp.uint32),
@@ -381,8 +426,11 @@ def _dkv_kernel(
         s = _dot_nt(q_ref[:], k_ref[:]) * sm_scale
         q_pos, k_pos = _positions(qb * block_q, k_idx * block_k, block_q, block_k)
         if causal:
-            s = jnp.where(q_pos + (sk - sq) >= k_pos, s, NEG_INF)
+            visible = q_pos + (sk - sq) >= k_pos
+            s = jnp.where(visible, s, NEG_INF)
         p = jnp.exp(s - lse)
+        if causal:
+            p = jnp.where(visible, p, 0.0)  # see _dq_kernel
         if dropout_rate > 0.0:
             u = _uniform01(seed_ref[0, 0].astype(jnp.uint32),
                            jnp.uint32(bh), q_pos, k_pos)
@@ -431,14 +479,16 @@ def _flash_bwd(q, k, v, out, lse, do, seed, causal, dropout_rate, block_q, block
 
     common = dict(sq=sq, sk=sk, causal=causal, sm_scale=sm_scale,
                   dropout_rate=dropout_rate)
+    kv_map = _causal_kb_map(block_q, block_k, sq, sk, causal)
+    qb_map = _causal_qb_map(block_q, block_k, sq, sk, causal)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, n_kb=n_k, **common),
         grid=(b * h, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, 1), lambda bh, qi, kb: (0, 0)),
             pl.BlockSpec((None, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
-            pl.BlockSpec((None, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
-            pl.BlockSpec((None, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((None, block_k, d), kv_map),
+            pl.BlockSpec((None, block_k, d), kv_map),
             pl.BlockSpec((None, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
             pl.BlockSpec((None, block_q, 128), lambda bh, qi, kb: (bh, qi, 0)),
             pl.BlockSpec((None, block_q, 128), lambda bh, qi, kb: (bh, qi, 0)),
@@ -457,12 +507,12 @@ def _flash_bwd(q, k, v, out, lse, do, seed, causal, dropout_rate, block_q, block
         grid=(b * h, n_k, n_q),
         in_specs=[
             pl.BlockSpec((1, 1), lambda bh, ki, qb: (0, 0)),
-            pl.BlockSpec((None, block_q, d), lambda bh, ki, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, block_q, d), qb_map),
             pl.BlockSpec((None, block_k, d), lambda bh, ki, qb: (bh, ki, 0)),
             pl.BlockSpec((None, block_k, d), lambda bh, ki, qb: (bh, ki, 0)),
-            pl.BlockSpec((None, block_q, d), lambda bh, ki, qb: (bh, qb, 0)),
-            pl.BlockSpec((None, block_q, 128), lambda bh, ki, qb: (bh, qb, 0)),
-            pl.BlockSpec((None, block_q, 128), lambda bh, ki, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, block_q, d), qb_map),
+            pl.BlockSpec((None, block_q, 128), qb_map),
+            pl.BlockSpec((None, block_q, 128), qb_map),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda bh, ki, qb: (bh, ki, 0)),

@@ -190,3 +190,57 @@ def test_flash_onepass_fully_masked_rows_zero():
     # first sq - sk = 128 query rows see no key
     np.testing.assert_allclose(out[0, 0, :128], 0.0, atol=1e-6)
     assert np.abs(out[0, 0, 128:]).max() > 0
+
+
+def test_flash_tiled_fully_masked_rows_zero_fwd_and_bwd():
+    """Tiled-path counterpart of the one-pass masked-row rule (round-4
+    review finding): causal sq > sk leaves whole q rows with no visible
+    key INSIDE a partially visible block — p = exp(NEG_INF - NEG_INF) = 1
+    poisoned the forward (mean of V) and exp(s - lse) exploded dk/dv.
+    Force the tiled kernels and check rows are zero and grads match the
+    dense reference."""
+    from flexflow_tpu.ops.pallas import flash_attention as fa
+
+    old = (fa.ONEPASS_MAX_SK, fa.ONEPASS_MAX_SK_CAUSAL)
+    fa.ONEPASS_MAX_SK = fa.ONEPASS_MAX_SK_CAUSAL = 0
+    try:
+        rng = np.random.default_rng(13)
+        sq, sk = 384, 256  # 128 fully-masked rows sharing a block with live ones
+        q = jnp.asarray(rng.normal(size=(1, 1, sq, 64)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 1, sk, 64)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(1, 1, sk, 64)), jnp.float32)
+        out = np.asarray(
+            fa.flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+        )
+        ref = np.asarray(fa._sdpa_ref(q, k, v, causal=True))
+        np.testing.assert_allclose(out[0, 0, : sq - sk], 0.0, atol=1e-6)
+        np.testing.assert_allclose(
+            out[0, 0, sq - sk:], ref[0, 0, sq - sk:], atol=2e-5, rtol=2e-5
+        )
+
+        # grads compared through LIVE rows only: for fully-masked rows the
+        # dense reference softmaxes a constant row into uniform 1/sk probs
+        # (mean-of-V output + phantom dv mass) while the kernel uses the
+        # zero-output convention, so a sum-over-everything loss disagrees
+        # by exactly the reference's phantom contribution
+        live = sq - sk
+        ours = jax.grad(
+            lambda qq, kk, vv: jnp.sum(
+                fa.flash_attention(
+                    qq, kk, vv, causal=True, block_q=128, block_k=128
+                )[:, :, live:]
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        theirs = jax.grad(
+            lambda qq, kk, vv: jnp.sum(
+                fa._sdpa_ref(qq, kk, vv, causal=True)[:, :, live:]
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for g1, g2 in zip(ours, theirs):
+            np.testing.assert_allclose(
+                np.asarray(g1), np.asarray(g2), atol=5e-5, rtol=5e-5
+            )
+    finally:
+        fa.ONEPASS_MAX_SK, fa.ONEPASS_MAX_SK_CAUSAL = old

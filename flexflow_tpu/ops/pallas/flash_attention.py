@@ -255,6 +255,15 @@ ONEPASS_MAX_SK = 1024
 ONEPASS_MAX_SK_CAUSAL = 1024
 
 
+def _clamp_enabled() -> bool:
+    """A/B knob for on-chip measurement: FFTPU_NO_CAUSAL_CLAMP=1 restores
+    the fetch-everything index maps so the DMA-skip win is quantifiable
+    in isolation (tools/bench_attention.py)."""
+    import os
+
+    return os.environ.get("FFTPU_NO_CAUSAL_CLAMP") != "1"
+
+
 def _causal_kb_map(block_q, block_k, sq, sk, causal):
     """K/V block index map for grids iterating kb per q block.  Causal
     grids gate compute on blocks above the diagonal with ``pl.when``, but
@@ -264,7 +273,7 @@ def _causal_kb_map(block_q, block_k, sq, sk, causal):
     so masked blocks cost a (cheap) grid step instead of HBM traffic
     (~half of all K/V fetches at sq == sk).  Gated steps never read the
     (stale) buffer: the same predicate guards the compute."""
-    if not causal:
+    if not causal or not _clamp_enabled():
         return lambda bh, qi, kb: (bh, kb, 0)
 
     def imap(bh, qi, kb):
@@ -277,7 +286,7 @@ def _causal_kb_map(block_q, block_k, sq, sk, causal):
 def _causal_qb_map(block_q, block_k, sq, sk, causal):
     """Q-side counterpart for the dk/dv grid (bh, ki, qb): blocks BEFORE
     the diagonal are gated, so clamp qb up to the first visible q block."""
-    if not causal:
+    if not causal or not _clamp_enabled():
         return lambda bh, ki, qb: (bh, qb, 0)
 
     def imap(bh, ki, qb):

@@ -484,7 +484,7 @@ def test_accuracy_gated_mnist_example():
     r = subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "examples", "keras", "mnist_mlp.py"),
          "-e", "2", "-n", "1024"],
-        capture_output=True, text=True, timeout=300, env=env,
+        capture_output=True, text=True, timeout=600, env=env,
     )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "final accuracy:" in r.stdout

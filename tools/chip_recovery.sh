@@ -18,6 +18,13 @@ echo "== attention sweep (forced tiled, for A/B) =="
 FFTPU_FORCE_TILED=1 timeout 1500 python tools/bench_attention.py 2>&1 \
   | grep -v WARNING | tee .bench_logs/attn_tiled.jsonl
 
+echo "== attention sweep (tiled, causal DMA-clamp OFF, r4 A/B) =="
+# flash only: sdpa/jaxflash are knob-independent (already measured above),
+# and skipping them keeps the slower no-clamp variant inside the budget
+BENCH_IMPLS=flash FFTPU_FORCE_TILED=1 FFTPU_NO_CAUSAL_CLAMP=1 \
+  timeout 1500 python tools/bench_attention.py 2>&1 \
+  | grep -v WARNING | tee .bench_logs/attn_tiled_noclamp.jsonl
+
 echo "== bench.py (headline + attn_core extras) =="
 timeout 2700 python bench.py | tee .bench_logs/bench_b16.json
 

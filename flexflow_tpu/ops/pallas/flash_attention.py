@@ -258,7 +258,10 @@ ONEPASS_MAX_SK_CAUSAL = 1024
 def _clamp_enabled() -> bool:
     """A/B knob for on-chip measurement: FFTPU_NO_CAUSAL_CLAMP=1 restores
     the fetch-everything index maps so the DMA-skip win is quantifiable
-    in isolation (tools/bench_attention.py)."""
+    in isolation (tools/bench_attention.py).  PROCESS-START-ONLY: the env
+    var is read at trace time and the jit cache keys on shapes, so
+    toggling it mid-process silently reuses the first variant's compiled
+    kernel — A/B each setting in its own process (chip_recovery.sh does)."""
     import os
 
     return os.environ.get("FFTPU_NO_CAUSAL_CLAMP") != "1"

@@ -1,8 +1,9 @@
 """Strategy-quality goldens (VERDICT r3 #6): pin the SHAPE of the search
 winner on reference-derived configs, the way the OSDI'22 artifact pins
-expected behaviors per app (``/root/reference/scripts/osdi22ae/*.sh``:
-Unity search vs ``--only-data-parallel`` on bert/dlrm/mlp).  Asserts are
-structural — parsed from ``Strategy.to_json()`` — never cost scalars.
+expected behaviors per app (``/root/reference/scripts/osdi22ae/*.sh``).
+ALL SEVEN AE apps are covered — BERT, DLRM, MLP, ResNeXt-50,
+Inception-v3, XDL, CANDLE-Uno.  Asserts are structural — parsed from
+``Strategy.to_json()`` — never cost scalars.
 
 These goldens are what caught the round-4 cost-model fix: without
 backward-pass collective pricing the search preferred a 2D-sharded MLP
@@ -19,6 +20,17 @@ from flexflow_tpu.parallel.machine import PhysicalTopology
 from flexflow_tpu.search import TPUMachineModel, unity_search
 
 BUDGET = 10
+
+
+def _v5e_search(model, budget=BUDGET):
+    """Shared v5e-tray search setup for every non-torus golden."""
+    mach = TPUMachineModel.for_chip(
+        "TPU v5 lite", topology=PhysicalTopology((4, 2))
+    )
+    return unity_search(
+        model.layers, MachineMesh((8, 1), ("data", "model")),
+        budget=budget, machine=mach,
+    )
 
 
 def _winner(model, strategy):
@@ -75,13 +87,7 @@ def test_dlrm_golden_vocab_sharded_embeddings_unsharded_mlps():
     dense grads) and leaves the tiny MLP kernels unsharded."""
     model = FFModel(FFConfig(batch_size=2048))
     dlrm(model, batch=2048)
-    mach = TPUMachineModel.for_chip(
-        "TPU v5 lite", topology=PhysicalTopology((4, 2))
-    )
-    st = unity_search(
-        model.layers, MachineMesh((8, 1), ("data", "model")),
-        budget=BUDGET, machine=mach,
-    )
+    st = _v5e_search(model)
     w = _winner(model, st)
     assert w["mesh"]["model"] == 8, w["mesh"]
     for i in range(4):
@@ -105,13 +111,59 @@ def test_large_batch_mlp_golden_pure_data_parallel():
     t = model.dense(t, 1024, ActiMode.RELU, name="h1")
     t = model.dense(t, 8, name="out")
     model.softmax(t)
-    mach = TPUMachineModel.for_chip(
-        "TPU v5 lite", topology=PhysicalTopology((4, 2))
-    )
-    st = unity_search(
-        model.layers, MachineMesh((8, 1), ("data", "model")),
-        budget=BUDGET, machine=mach,
-    )
+    st = _v5e_search(model)
     w = _winner(model, st)
     assert w["mesh"] == {"data": 8, "model": 1}, w["mesh"]
     assert [k for k in w if k != "mesh"] == [], w
+
+
+def test_convnet_goldens_pure_data_parallel():
+    """ResNeXt-50 and Inception-v3 at batch 64 (OSDI AE configs
+    resnext-50.sh / inception.sh): conv towers are compute-dominated with
+    small per-layer weights — the winner is pure DP with no sharded
+    weights on a v5e tray."""
+    from flexflow_tpu.models.cnn import inception_v3, resnext50
+
+    for build in (resnext50, inception_v3):
+        model = FFModel(FFConfig(batch_size=64))
+        build(model, 64)
+        st = _v5e_search(model)
+        w = _winner(model, st)
+        assert w["mesh"] == {"data": 8, "model": 1}, (build.__name__, w["mesh"])
+        assert [k for k in w if k != "mesh"] == [], (build.__name__, w)
+
+
+def test_xdl_golden_vocab_sharded_embeddings():
+    """XDL (OSDI AE xdl.sh): embedding-table-dominated like DLRM — every
+    table vocab-sharded over the model axis."""
+    from flexflow_tpu.models.dlrm import xdl
+
+    model = FFModel(FFConfig(batch_size=256))
+    xdl(model, 256)
+    st = _v5e_search(model)
+    w = _winner(model, st)
+    assert w["mesh"]["model"] == 8, w["mesh"]
+    emb = [k for k in w if k.startswith("emb_")]
+    assert len(emb) == 4, w
+    for k in emb:
+        assert w[k]["kernel"][0] == ["model"], (k, w[k])
+
+
+def test_candle_uno_golden_tp_feature_towers():
+    """CANDLE-Uno (OSDI AE candle_uno.sh): wide feature-encoder MLPs
+    (multi-thousand-dim inputs) at small batch — the winner
+    tensor-shards the towers as Megatron pairs (first layer out-dim,
+    second layer in-dim)."""
+    from flexflow_tpu.models.candle_uno import candle_uno
+
+    model = FFModel(FFConfig(batch_size=64))
+    candle_uno(model, 64)
+    st = _v5e_search(model)
+    w = _winner(model, st)
+    assert w["mesh"]["model"] >= 2, w["mesh"]
+    first = [k for k in w if k.endswith("_0") and k.startswith("feat_")]
+    assert first, w
+    for k in first:
+        assert w[k]["kernel"][1] == ["model"], (k, w[k])
+        pair = k[:-2] + "_1"
+        assert pair in w and w[pair]["kernel"][0] == ["model"], (pair, w.get(pair))

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import numpy as np
@@ -246,13 +247,20 @@ def _flash_fwd_onepass(q, k, v, seed, causal, dropout_rate, block_q):
 
 
 # K/V row extent up to which the one-pass forward engages: the f32
-# score/prob tiles at (256, sk) plus K/V must stay WELL inside the
+# score/prob tiles at (block_q, sk) plus K/V must stay WELL inside the
 # ~16 MiB VMEM with headroom for Mosaic's double-buffering — 1024 keeps
-# live f32 tiles ~2 MiB.  Causal gets no extra range: one-pass cannot
-# skip fully-masked diagonal blocks, so longer causal rows pay ~2x the
-# masked-region work the tiled kernel's skip-gate avoids.
-ONEPASS_MAX_SK = 1024
-ONEPASS_MAX_SK_CAUSAL = 1024
+# live f32 tiles ~2 MiB at block_q=256.  Causal gets no extra range:
+# one-pass cannot skip fully-masked diagonal blocks, so longer causal
+# rows pay ~2x the masked-region work the tiled kernel's skip-gate
+# avoids.  FFTPU_ONEPASS_MAX_SK overrides both (process-start-only, read
+# at import) for on-chip threshold sweeps; _flash_fwd shrinks block_q to
+# hold the score-tile VMEM budget when the override extends the range.
+_ONEPASS_DEFAULT_MAX_SK = 1024
+ONEPASS_MAX_SK = ONEPASS_MAX_SK_CAUSAL = int(
+    os.environ.get("FFTPU_ONEPASS_MAX_SK", _ONEPASS_DEFAULT_MAX_SK)
+)
+# score-tile budget the default (256, 1024) config implies
+_ONEPASS_SCORE_BYTES = 256 * 1024 * 4
 
 
 def _clamp_enabled() -> bool:
@@ -304,7 +312,20 @@ def _flash_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k):
     sk = k.shape[2]
     onepass_max = ONEPASS_MAX_SK_CAUSAL if causal else ONEPASS_MAX_SK
     if sk <= onepass_max and sk % 128 == 0:
-        return _flash_fwd_onepass(q, k, v, seed, causal, dropout_rate, block_q)
+        # sk past the stock threshold only enters via the env-override
+        # sweep: shrink block_q to hold the score-tile VMEM budget there,
+        # but NEVER override an explicitly-requested block_q in the stock
+        # range (block-size sweeps must measure what they claim), and
+        # fall back to the tiled kernel when even bq=128 busts the budget
+        # (a >=4096 override would otherwise die in Mosaic VMEM alloc)
+        bq = block_q
+        if sk > _ONEPASS_DEFAULT_MAX_SK:
+            while bq > 128 and bq * sk * 4 > _ONEPASS_SCORE_BYTES:
+                bq //= 2
+        if sq % bq == 0 and bq * sk * 4 <= max(
+            _ONEPASS_SCORE_BYTES, block_q * _ONEPASS_DEFAULT_MAX_SK * 4
+        ):
+            return _flash_fwd_onepass(q, k, v, seed, causal, dropout_rate, bq)
     sm_scale = 1.0 / math.sqrt(d)
     n_q = sq // block_q
     n_kb = sk // block_k

@@ -25,6 +25,11 @@ BENCH_IMPLS=flash FFTPU_FORCE_TILED=1 FFTPU_NO_CAUSAL_CLAMP=1 \
   timeout 1500 python tools/bench_attention.py 2>&1 \
   | grep -v WARNING | tee .bench_logs/attn_tiled_noclamp.jsonl
 
+echo "== attention sweep (one-pass extended to sk=2048, r4 threshold sweep) =="
+BENCH_IMPLS=flash FFTPU_ONEPASS_MAX_SK=2048 timeout 1500 \
+  python tools/bench_attention.py 2>&1 \
+  | grep -v WARNING | tee .bench_logs/attn_onepass2048.jsonl
+
 echo "== bench.py (headline + attn_core extras) =="
 timeout 2700 python bench.py | tee .bench_logs/bench_b16.json
 

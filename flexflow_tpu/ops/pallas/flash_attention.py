@@ -307,19 +307,21 @@ def _causal_qb_map(block_q, block_k, sq, sk, causal):
     return imap
 
 
-def _flash_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k):
+def _flash_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k,
+               explicit_bq=False):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     onepass_max = ONEPASS_MAX_SK_CAUSAL if causal else ONEPASS_MAX_SK
     if sk <= onepass_max and sk % 128 == 0:
         # sk past the stock threshold only enters via the env-override
         # sweep: shrink block_q to hold the score-tile VMEM budget there,
-        # but NEVER override an explicitly-requested block_q in the stock
-        # range (block-size sweeps must measure what they claim), and
-        # fall back to the tiled kernel when even bq=128 busts the budget
-        # (a >=4096 override would otherwise die in Mosaic VMEM alloc)
+        # but NEVER override an explicitly-requested block_q (block-size
+        # sweeps must measure what they claim — over-budget explicit
+        # requests go tiled instead), and fall back to the tiled kernel
+        # when even bq=128 busts the budget (a >=4096 override would
+        # otherwise die in Mosaic VMEM alloc)
         bq = block_q
-        if sk > _ONEPASS_DEFAULT_MAX_SK:
+        if sk > _ONEPASS_DEFAULT_MAX_SK and not explicit_bq:
             while bq > 128 and bq * sk * 4 > _ONEPASS_SCORE_BYTES:
                 bq //= 2
         if sq % bq == 0 and bq * sk * 4 <= max(
@@ -579,18 +581,24 @@ def _pad_d(x, d_pad):
     return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, d_pad - d)])
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_core(q, k, v, seed, causal, dropout_rate, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_core(q, k, v, seed, causal, dropout_rate, block_q, block_k,
+                explicit_bq):
+    out, _ = _flash_fwd(
+        q, k, v, seed, causal, dropout_rate, block_q, block_k, explicit_bq
+    )
     return out
 
 
-def _core_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k)
+def _core_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k,
+              explicit_bq):
+    out, lse = _flash_fwd(
+        q, k, v, seed, causal, dropout_rate, block_q, block_k, explicit_bq
+    )
     return out, (q, k, v, out, lse, seed)
 
 
-def _core_bwd(causal, dropout_rate, block_q, block_k, res, do):
+def _core_bwd(causal, dropout_rate, block_q, block_k, explicit_bq, res, do):
     q, k, v, out, lse, seed = res
     dq, dk, dv = _flash_bwd(
         q, k, v, out, lse, do, seed, causal, dropout_rate, block_q, block_k
@@ -635,6 +643,7 @@ def flash_attention(
     DOUBLE the p@v work for zero gain.  Other head dims are zero-padded to
     the 128-lane grid (exact: scale uses the true D)."""
     d = q.shape[-1]
+    explicit_bq = block_q is not None
     if block_q is None or block_k is None:
         dq_, dk_ = default_blocks(q.shape[2], k.shape[2])
         block_q = block_q or dq_
@@ -652,7 +661,7 @@ def flash_attention(
         v = _pad_d(v, d_pad)
     out = _flash_core(
         q, k, v, jnp.asarray(seed, jnp.int32), causal, float(dropout_rate),
-        block_q, block_k,
+        block_q, block_k, explicit_bq,
     )
     return out[..., :d]
 

@@ -1,0 +1,119 @@
+"""Collate .bench_logs/*.jsonl (written by tools/chip_recovery.sh) into
+the BASELINE.md attention-core table + a dispatch-policy recommendation.
+
+Run after the chip recovery sweeps:
+    python tools/ab_report.py            # uses .bench_logs/
+    python tools/ab_report.py <dir>
+
+For every (shape, causal) config it joins the variants — adaptive
+(attn_adaptive), forced-tiled (attn_tiled), tiled-without-causal-clamp
+(attn_tiled_noclamp), one-pass-at-2048 (attn_onepass2048) — and prints:
+  * a markdown table ready to paste into BASELINE.md,
+  * per-config the fastest OUR variant vs sdpa vs the jax-bundled kernel,
+  * the measured crossover sequence length (smallest s where our best
+    flash beats sdpa fwd+bwd) to encode in the dispatch threshold.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict
+
+
+FILES = {
+    "adaptive": "attn_adaptive.jsonl",
+    "tiled": "attn_tiled.jsonl",
+    "tiled_noclamp": "attn_tiled_noclamp.jsonl",
+    "onepass2048": "attn_onepass2048.jsonl",
+}
+
+
+def _load(path: str):
+    rows = []
+    if not os.path.exists(path):
+        return rows
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "shape" in d:
+                rows.append(d)
+    return rows
+
+
+def main():
+    logdir = sys.argv[1] if len(sys.argv) > 1 else ".bench_logs"
+    variants: Dict[str, Dict[tuple, dict]] = {}
+    for name, fn in FILES.items():
+        variants[name] = {
+            (r["shape"], bool(r.get("causal"))): r
+            for r in _load(os.path.join(logdir, fn))
+        }
+    if not variants["adaptive"]:
+        print(f"no {FILES['adaptive']} under {logdir}; run chip_recovery.sh")
+        return
+
+    def ms(row, pre, impl):
+        v = row.get(f"{pre}_{impl}_ms") if row else None
+        return v if isinstance(v, (int, float)) else None
+
+    print("| config | sdpa fwd/bwd | flash fwd/bwd (best ours) | variant "
+          "| jax-bundled fwd/bwd |")
+    print("|---|---|---|---|---|")
+    # (causal, s) -> flash wins; the crossover per causal setting is the
+    # smallest s where flash wins at that AND every larger measured s —
+    # a single noisy win below a loss must not drag the threshold down
+    wins: Dict[bool, Dict[int, bool]] = {}
+    for key in sorted(variants["adaptive"], key=lambda k: (k[0], k[1])):
+        shape, causal = key
+        ad = variants["adaptive"].get(key)
+        best_name, best = "adaptive", ad
+        for name in ("tiled", "tiled_noclamp", "onepass2048"):
+            r = variants[name].get(key)
+            a, b = ms(r, "fwd", "flash"), ms(r, "bwd", "flash")
+            ba, bb = ms(best, "fwd", "flash"), ms(best, "bwd", "flash")
+            if a is not None and b is not None and (
+                ba is None or bb is None or a + b < ba + bb
+            ):
+                best_name, best = name, r
+        fmt = lambda a, b: (
+            f"{a}/{b}" if a is not None and b is not None else "—"
+        )
+        sdpa_f, sdpa_b = ms(ad, "fwd", "sdpa"), ms(ad, "bwd", "sdpa")
+        fl_f, fl_b = ms(best, "fwd", "flash"), ms(best, "bwd", "flash")
+        jx_f, jx_b = ms(ad, "fwd", "jaxflash"), ms(ad, "bwd", "jaxflash")
+        print(f"| {shape} causal={causal} | {fmt(sdpa_f, sdpa_b)} "
+              f"| {fmt(fl_f, fl_b)} | {best_name} | {fmt(jx_f, jx_b)} |")
+        if None not in (sdpa_f, sdpa_b, fl_f, fl_b):
+            s = int(shape.split("s")[-1].split()[0].split("d")[0].strip())
+            wins.setdefault(causal, {})[s] = fl_f + fl_b < sdpa_f + sdpa_b
+
+    any_cross = False
+    for causal, by_s in sorted(wins.items()):
+        crossover = None
+        for s in sorted(by_s, reverse=True):
+            if by_s[s]:
+                crossover = s
+            else:
+                break  # a loss at this s invalidates smaller candidates
+        if crossover is not None:
+            any_cross = True
+            print(f"\ncausal={causal}: our flash beats sdpa fwd+bwd at "
+                  f"s={crossover} and every larger measured length — set "
+                  f"the dispatch threshold (FFTPU_FLASH_THRESHOLD_BYTES) "
+                  f"so flash engages from there.")
+    if not any_cross:
+        print("\nno stable crossover where flash beats sdpa — keep the sdpa "
+              "dispatch and investigate the Mosaic pipeline before "
+              "re-measuring.")
+
+
+if __name__ == "__main__":
+    main()

@@ -26,8 +26,10 @@ BENCH_IMPLS=flash FFTPU_FORCE_TILED=1 FFTPU_NO_CAUSAL_CLAMP=1 \
   | grep -v WARNING | tee .bench_logs/attn_tiled_noclamp.jsonl
 
 echo "== attention sweep (one-pass extended to sk=2048, r4 threshold sweep) =="
-BENCH_IMPLS=flash FFTPU_ONEPASS_MAX_SK=2048 timeout 1500 \
-  python tools/bench_attention.py 2>&1 \
+# only the s=2048 rows can differ from the adaptive run (512 is one-pass
+# either way, 8192 is tiled either way): argv '0 0 2048' restricts to them
+BENCH_IMPLS=flash FFTPU_ONEPASS_MAX_SK=2048 timeout 900 \
+  python tools/bench_attention.py 0 0 2048 2>&1 \
   | grep -v WARNING | tee .bench_logs/attn_onepass2048.jsonl
 
 echo "== bench.py (headline + attn_core extras) =="
@@ -35,5 +37,8 @@ timeout 2700 python bench.py | tee .bench_logs/bench_b16.json
 
 echo "== bench.py batch 32 =="
 FFTPU_BENCH_BATCH=32 timeout 2700 python bench.py | tee .bench_logs/bench_b32.json
+
+echo "== collated report (paste into BASELINE.md) =="
+python tools/ab_report.py .bench_logs | tee .bench_logs/report.md
 
 echo "== done; update BASELINE.md / README from these =="

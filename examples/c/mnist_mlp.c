@@ -48,6 +48,7 @@ int main(int argc, char** argv) {
   }
   printf("parameters: %lld\n",
          (long long)flexflow_model_num_parameters(model));
+  printf("mesh devices: %d\n", flexflow_model_mesh_size(model));
 
   /* synthetic blobs: class centers + noise (same as tests/test_mlp_e2e) */
   float* x = malloc(sizeof(float) * N * D);

@@ -324,9 +324,10 @@ def _flash_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k,
         if sk > _ONEPASS_DEFAULT_MAX_SK and not explicit_bq:
             while bq > 128 and bq * sk * 4 > _ONEPASS_SCORE_BYTES:
                 bq //= 2
-        if sq % bq == 0 and bq * sk * 4 <= max(
-            _ONEPASS_SCORE_BYTES, block_q * _ONEPASS_DEFAULT_MAX_SK * 4
-        ):
+        # strict budget for default AND explicit blocks: an explicit
+        # over-budget request (e.g. block_q=2048 at sk=1024, an 8 MiB f32
+        # score tile) goes tiled rather than dying in Mosaic VMEM alloc
+        if sq % bq == 0 and bq * sk * 4 <= _ONEPASS_SCORE_BYTES:
             return _flash_fwd_onepass(q, k, v, seed, causal, dropout_rate, bq)
     sm_scale = 1.0 / math.sqrt(d)
     n_q = sq // block_q

@@ -1413,6 +1413,33 @@ int flexflow_config_set_epochs(ff_handle* cfg, int epochs) {
   return rc;
 }
 
+// device count of the compiled model's mesh (1 = unsharded): lets a C
+// caller verify a --mesh-shape flag actually took effect
+int flexflow_model_mesh_size(ff_handle* model) {
+  PyObject* st = PyObject_GetAttrString(model->obj, "strategy");
+  if (!st || st == Py_None) {
+    Py_XDECREF(st);
+    PyErr_Clear();
+    g_last_error = "model not compiled";
+    return -1;
+  }
+  PyObject* mesh = PyObject_GetAttrString(st, "mesh");
+  Py_DECREF(st);
+  if (!mesh) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* sz = PyObject_GetAttrString(mesh, "size");
+  Py_DECREF(mesh);
+  if (!sz) {
+    capture_py_error();
+    return -1;
+  }
+  int n = (int)PyLong_AsLongLong(sz);
+  Py_DECREF(sz);
+  return n;
+}
+
 // ----------------------------------------------- op parity (unary + misc)
 static ff_handle* unary_op(ff_handle* model, ff_handle* input,
                            const char* meth) {

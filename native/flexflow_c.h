@@ -220,6 +220,10 @@ int flexflow_config_get_batch_size(ff_handle* cfg);
 int flexflow_config_get_epochs(ff_handle* cfg);
 int flexflow_config_set_epochs(ff_handle* cfg, int epochs);
 
+/* device count of the compiled model's mesh (1 = unsharded, -1 = not
+ * compiled/error): verifies a --mesh-shape flag took effect */
+int flexflow_model_mesh_size(ff_handle* model);
+
 /* op parity: unary + misc */
 ff_handle* flexflow_model_gelu(ff_handle* m, ff_handle* x);
 ff_handle* flexflow_model_sigmoid(ff_handle* m, ff_handle* x);

@@ -17,6 +17,20 @@ from flexflow_tpu.runtime.capi import build_capi
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _build_example(src_name: str, build_dir: str, exe: str) -> None:
+    """Compile one examples/c driver against the built libflexflow_c."""
+    subprocess.run(
+        [
+            "cc", "-O2", os.path.join(REPO, "examples", "c", src_name),
+            "-I" + os.path.join(REPO, "native"),
+            "-L" + build_dir, "-lflexflow_c",
+            "-Wl,-rpath," + build_dir,
+            "-o", exe,
+        ],
+        check=True, capture_output=True,
+    )
+
+
 @pytest.fixture(scope="module")
 def libflexflow_c():
     so = build_capi()
@@ -28,17 +42,7 @@ def libflexflow_c():
 def test_c_driver_trains_mlp(libflexflow_c, tmp_path_factory):
     tmp = tmp_path_factory.mktemp("capi")
     exe = str(tmp / "mnist_mlp_c")
-    build_dir = os.path.dirname(libflexflow_c)
-    subprocess.run(
-        [
-            "cc", "-O2", os.path.join(REPO, "examples", "c", "mnist_mlp.c"),
-            "-I" + os.path.join(REPO, "native"),
-            "-L" + build_dir, "-lflexflow_c",
-            "-Wl,-rpath," + build_dir,
-            "-o", exe,
-        ],
-        check=True, capture_output=True,
-    )
+    _build_example("mnist_mlp.c", os.path.dirname(libflexflow_c), exe)
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"  # embedded interpreter: stay off the TPU
@@ -62,17 +66,7 @@ def test_c_driver_trains_two_input_dlrm(libflexflow_c, tmp_path_factory):
     accuracy computed in C."""
     tmp = tmp_path_factory.mktemp("capi_dlrm")
     exe = str(tmp / "dlrm_c")
-    build_dir = os.path.dirname(libflexflow_c)
-    subprocess.run(
-        [
-            "cc", "-O2", os.path.join(REPO, "examples", "c", "dlrm.c"),
-            "-I" + os.path.join(REPO, "native"),
-            "-L" + build_dir, "-lflexflow_c",
-            "-Wl,-rpath," + build_dir,
-            "-o", exe,
-        ],
-        check=True, capture_output=True,
-    )
+    _build_example("dlrm.c", os.path.dirname(libflexflow_c), exe)
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
@@ -90,3 +84,27 @@ def test_c_driver_trains_two_input_dlrm(libflexflow_c, tmp_path_factory):
     loss = float(r.stdout.split("final loss:")[1].split()[0])
     assert loss < 0.5, r.stdout  # the batch loop actually trained
 
+
+
+def test_c_driver_trains_on_8_device_mesh(libflexflow_c, tmp_path_factory):
+    """The C ABI drives the SHARDED runtime too: --mesh-shape 8x1 through
+    flexflow_config_create's argv puts the whole training run on the
+    virtual 8-device CPU mesh (data parallel), and the driver verifies it
+    took effect via flexflow_model_mesh_size."""
+    tmp = tmp_path_factory.mktemp("capi_mesh")
+    exe = str(tmp / "mnist_mlp_c")
+    _build_example("mnist_mlp.c", os.path.dirname(libflexflow_c), exe)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    r = subprocess.run(
+        [exe, "--mesh-shape", "8x1"], env=env, capture_output=True,
+        text=True, timeout=420,
+    )
+    assert r.returncode == 0, f"rc={r.returncode}\nstdout:{r.stdout}\nstderr:{r.stderr}"
+    assert "mesh devices: 8" in r.stdout, r.stdout
+    acc = float(r.stdout.split("final accuracy:")[1].split()[0])
+    assert acc > 0.7, r.stdout

@@ -71,11 +71,19 @@ def main():
     # smallest s where flash wins at that AND every larger measured s —
     # a single noisy win below a loss must not drag the threshold down
     wins: Dict[bool, Dict[int, bool]] = {}
-    for key in sorted(variants["adaptive"], key=lambda k: (k[0], k[1])):
+
+    def _seq(shape: str) -> int:
+        return int(shape.split("s")[-1].split()[0].split("d")[0].strip())
+
+    for key in sorted(
+        variants["adaptive"], key=lambda k: (k[1], _seq(k[0]))
+    ):
         shape, causal = key
         ad = variants["adaptive"].get(key)
         best_name, best = "adaptive", ad
         for name in ("tiled", "tiled_noclamp", "onepass2048"):
+            if name == "tiled_noclamp" and not causal:
+                continue  # the clamp knob is a no-op without causal masking
             r = variants[name].get(key)
             a, b = ms(r, "fwd", "flash"), ms(r, "bwd", "flash")
             ba, bb = ms(best, "fwd", "flash"), ms(best, "bwd", "flash")
@@ -92,8 +100,9 @@ def main():
         print(f"| {shape} causal={causal} | {fmt(sdpa_f, sdpa_b)} "
               f"| {fmt(fl_f, fl_b)} | {best_name} | {fmt(jx_f, jx_b)} |")
         if None not in (sdpa_f, sdpa_b, fl_f, fl_b):
-            s = int(shape.split("s")[-1].split()[0].split("d")[0].strip())
-            wins.setdefault(causal, {})[s] = fl_f + fl_b < sdpa_f + sdpa_b
+            wins.setdefault(causal, {})[_seq(shape)] = (
+                fl_f + fl_b < sdpa_f + sdpa_b
+            )
 
     any_cross = False
     for causal, by_s in sorted(wins.items()):

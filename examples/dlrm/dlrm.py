@@ -5,6 +5,7 @@ synthetic click data, with optional vocab-sharded embedding tables
 Run:
   python examples/dlrm/dlrm.py -b 64 -e 2
   python examples/dlrm/dlrm.py --mesh-shape 2x4       # dp x tp (vocab-sharded)
+  python examples/dlrm/dlrm.py --arch xdl             # reference xdl.cc
 """
 
 import argparse
@@ -12,7 +13,7 @@ import argparse
 import numpy as np
 
 from flexflow_tpu import FFConfig, FFModel, LossType, MachineMesh, SGDOptimizer
-from flexflow_tpu.models.dlrm import dlrm, dlrm_strategy
+from flexflow_tpu.models.dlrm import dlrm, dlrm_strategy, xdl
 
 
 def main():
@@ -23,11 +24,14 @@ def main():
     ap.add_argument("--num-tables", type=int, default=4)
     ap.add_argument("--sparse-feature-size", type=int, default=64)
     ap.add_argument("--bag-size", type=int, default=1)
+    ap.add_argument("--arch", choices=("dlrm", "xdl"), default="dlrm",
+                    help="xdl = embeddings->concat->MLP (reference xdl.cc)")
     args = ap.parse_args(rest)
 
     vocabs = tuple([args.embedding_size] * args.num_tables)
     model = FFModel(cfg)
-    dlrm(
+    build = dlrm if args.arch == "dlrm" else xdl
+    build(
         model, cfg.batch_size, embedding_sizes=vocabs,
         sparse_feature_size=args.sparse_feature_size, bag_size=args.bag_size,
     )
@@ -35,7 +39,7 @@ def main():
     mesh = None
     strategy = None
     if cfg.mesh_shape is not None:
-        mesh = MachineMesh(cfg.mesh_shape, ("data", "model")[: len(cfg.mesh_shape)])
+        mesh = MachineMesh(cfg.mesh_shape, cfg.mesh_axis_names[: len(cfg.mesh_shape)])
         strategy = dlrm_strategy(model.layers, mesh)
 
     model.compile(
@@ -51,7 +55,8 @@ def main():
     xs = [
         rng.integers(0, v, size=(n, args.bag_size)).astype(np.int32) for v in vocabs
     ]
-    xs.append(rng.normal(size=(n, 4)).astype(np.float32))
+    if args.arch == "dlrm":
+        xs.append(rng.normal(size=(n, 4)).astype(np.float32))
     y = rng.uniform(size=(n, 2)).astype(np.float32)
     pm = model.fit(xs, y)
     print(f"throughput: {pm.throughput():.1f} samples/s")

@@ -3,9 +3,9 @@ candle_uno.cc``): multi-tower drug-response regression — per-feature
 encoder MLPs (dose passthrough, cell rnaseq, drug descriptors) concat
 into a dense trunk with one regression output, MSE loss.
 
-Run:
-  python examples/candle_uno/candle_uno.py -b 64 -e 2
-  python examples/candle_uno/candle_uno.py --search-budget 8 \
+Run (from the repo root):
+  PYTHONPATH=. python examples/candle_uno/candle_uno.py -b 64 -e 2
+  PYTHONPATH=. python examples/candle_uno/candle_uno.py --search-budget 8 \
       --mesh-shape 2x4      # Unity finds TP on the wide feature towers
 """
 
@@ -21,7 +21,9 @@ from flexflow_tpu.models.candle_uno import (
 
 def main():
     cfg = FFConfig(batch_size=64, epochs=2, learning_rate=1e-3)
-    cfg.parse_args()
+    rest = cfg.parse_args()
+    if rest:
+        raise SystemExit(f"unknown arguments: {rest}")
 
     model = FFModel(cfg)
     candle_uno(model, cfg.batch_size)

@@ -12,7 +12,7 @@ import argparse
 
 import numpy as np
 
-from flexflow_tpu import FFConfig, FFModel, LossType, MachineMesh, SGDOptimizer
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
 from flexflow_tpu.models.dlrm import dlrm, dlrm_strategy, xdl
 
 
@@ -36,11 +36,8 @@ def main():
         sparse_feature_size=args.sparse_feature_size, bag_size=args.bag_size,
     )
 
-    mesh = None
-    strategy = None
-    if cfg.mesh_shape is not None:
-        mesh = MachineMesh(cfg.mesh_shape, cfg.mesh_axis_names[: len(cfg.mesh_shape)])
-        strategy = dlrm_strategy(model.layers, mesh)
+    mesh = cfg.build_mesh()
+    strategy = dlrm_strategy(model.layers, mesh) if mesh is not None else None
 
     model.compile(
         optimizer=SGDOptimizer(lr=cfg.learning_rate),

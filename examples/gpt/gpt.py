@@ -37,12 +37,10 @@ def main() -> int:
         model, batch, seq, hidden=64, heads=4, ff_dim=128, num_layers=2,
         vocab=vocab,
     )
-    mesh = None
+    mesh = cfg.build_mesh()
     strategy = None
-    if cfg.mesh_shape is not None:
-        mesh = MachineMesh(cfg.mesh_shape, cfg.mesh_axis_names[: len(cfg.mesh_shape)])
-        if mesh.axis_size("model") > 1:
-            strategy = tensor_parallel_strategy(model.layers, mesh)
+    if mesh is not None and mesh.axis_size("model") > 1:
+        strategy = tensor_parallel_strategy(model.layers, mesh)
     model.compile(
         optimizer=AdamOptimizer(alpha=1e-2),
         loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,

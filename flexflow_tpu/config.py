@@ -113,6 +113,19 @@ class FFConfig:
     def workers_per_node(self) -> int:
         return max(1, self.num_devices // max(1, self.num_nodes))
 
+    def build_mesh(self):
+        """The MachineMesh this config's ``--mesh-shape`` describes, or
+        None — the ONE cfg-to-mesh rule, shared by ``FFModel.compile`` and
+        the examples (a second copy silently diverging from compile's was
+        a round-4 review finding)."""
+        if self.mesh_shape is None:
+            return None
+        from flexflow_tpu.parallel.machine import MachineMesh
+
+        return MachineMesh(
+            self.mesh_shape, self.mesh_axis_names[: len(self.mesh_shape)]
+        )
+
     def parse_args(self, argv: Optional[Sequence[str]] = None) -> List[str]:
         """Parse reference-compatible CLI flags (``model.cc:3566-3730``).
 

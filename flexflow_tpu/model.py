@@ -645,10 +645,7 @@ class FFModel:
         logits = self.layers[-1].outputs[0]
 
         if mesh is None:
-            if cfg.mesh_shape is not None:
-                mesh = MachineMesh(cfg.mesh_shape, cfg.mesh_axis_names[: len(cfg.mesh_shape)])
-            else:
-                mesh = default_mesh()
+            mesh = cfg.build_mesh() or default_mesh()
         # machine model + profiler, shared by the search AND the
         # observability exports below so --taskgraph/--profiling report the
         # same costs the search optimized

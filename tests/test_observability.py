@@ -88,16 +88,31 @@ def test_profiling_table(capsys):
 
 
 def test_no_dead_config_flags():
-    """Every FFConfig field must be consumed somewhere in the package
-    outside config.py — 'a flag that does nothing is worse than no flag'
-    (VERDICT r1)."""
+    """Every FFConfig field must be consumed somewhere — 'a flag that does
+    nothing is worse than no flag' (VERDICT r1).  Consumed = referenced in
+    the package outside config.py, OR READ (not merely assigned by
+    parse_args) inside an FFConfig method that external code calls, e.g.
+    ``build_mesh`` reading ``mesh_shape``/``mesh_axis_names``."""
+    import re
+
     fields = [f.name for f in dataclasses.fields(FFConfig)]
     src = ""
     for root, _, files in os.walk(os.path.join(REPO, "flexflow_tpu")):
         for fn in files:
             if fn.endswith(".py") and fn != "config.py":
                 src += open(os.path.join(root, fn)).read()
-    dead = [f for f in fields if f not in src]
+    cfg_src = open(
+        os.path.join(REPO, "flexflow_tpu", "config.py")
+    ).read()
+
+    def read_in_config(f: str) -> bool:
+        for m in re.finditer(rf"self\.{f}\b", cfg_src):
+            rest = cfg_src[m.end():].lstrip(" ")
+            if not rest.startswith("=") or rest.startswith("=="):
+                return True  # a read, not an assignment target
+        return False
+
+    dead = [f for f in fields if f not in src and not read_in_config(f)]
     assert not dead, f"parsed-but-unused config flags: {dead}"
 
 

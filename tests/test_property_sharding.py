@@ -1,0 +1,133 @@
+"""Property-based invariants of the sharding algebra, reshard pricing,
+and physical-topology embedding (hypothesis): the generative counterpart
+of the golden tests — the reference has nothing equivalent (SURVEY §4.7
+notes its transfer estimates are never unit-tested at all).
+"""
+
+import math
+
+from hypothesis import given, settings, strategies as st_
+
+from flexflow_tpu.parallel.machine import MachineMesh, PhysicalTopology
+from flexflow_tpu.parallel.spec import TensorSharding
+from flexflow_tpu.parallel.strategy import OpSharding
+from flexflow_tpu.search.cost import TPUMachineModel, reshard_cost
+
+MESH = MachineMesh((2, 2, 2), ("data", "model", "seq"))
+AXES = ("data", "model", "seq")
+MACHINE = TPUMachineModel()
+
+
+def shardings(ndim: int):
+    """Random valid TensorShardings over MESH: each axis used at most
+    once across spec + partial_axes."""
+
+    @st_.composite
+    def build(draw):
+        axes = list(AXES)
+        spec = []
+        for _ in range(ndim):
+            take = draw(st_.sampled_from([0, 0, 0, 1, 1, 2]))
+            entry = []
+            for _ in range(take):
+                if not axes:
+                    break
+                a = draw(st_.sampled_from(axes))
+                axes.remove(a)
+                entry.append(a)
+            spec.append(
+                None if not entry
+                else (entry[0] if len(entry) == 1 else tuple(entry))
+            )
+        n_part = draw(st_.integers(0, len(axes)))
+        partial = tuple(axes[:n_part])
+        return TensorSharding(spec=tuple(spec), partial_axes=partial)
+
+    return build()
+
+
+@settings(max_examples=200, deadline=None)
+@given(src=shardings(2), dst=shardings(2))
+def test_reshard_cost_nonnegative_and_identity_free(src, dst):
+    cost = reshard_cost((64, 64), 4, src, dst, MESH, MACHINE)
+    assert cost >= 0.0
+    assert math.isfinite(cost)
+    # moving to the identical distribution resolves nothing -> at most
+    # the slice latency for axes "added" (there are none when identical)
+    assert reshard_cost((64, 64), 4, src, src, MESH, MACHINE) == 0.0
+
+
+@settings(max_examples=200, deadline=None)
+@given(src=shardings(2), dst=shardings(2))
+def test_reshard_backward_never_cheaper(src, dst):
+    """with_backward adds the autodiff transpose collectives — it can
+    only add cost, never remove it."""
+    fwd = reshard_cost((128, 32), 4, src, dst, MESH, MACHINE)
+    both = reshard_cost(
+        (128, 32), 4, src, dst, MESH, MACHINE, with_backward=True
+    )
+    assert both >= fwd
+
+
+@settings(max_examples=200, deadline=None)
+@given(s=shardings(3))
+def test_sharding_degree_consistency(s):
+    """total degree == product of per-dim degrees, and each divides the
+    mesh size."""
+    per_dim = 1
+    for d in range(3):
+        per_dim *= s.dim_degree(d, MESH)
+    assert s.total_degree(MESH) == per_dim
+    assert MESH.size % s.total_degree(MESH) == 0
+
+
+@settings(max_examples=150, deadline=None)
+@given(
+    dims=st_.lists(st_.sampled_from([2, 4]), min_size=1, max_size=3),
+    logical=st_.lists(st_.sampled_from([1, 2, 4, 8]), min_size=1, max_size=4),
+)
+def test_topology_assign_invariants(dims, logical):
+    """Whenever assign() accepts a logical shape: every axis gets its
+    full size, multipliers are positive powers of two (or halves), and
+    the embedding never claims more chips than exist."""
+    topo = PhysicalTopology(tuple(dims))
+    out = topo.assign(tuple(logical))
+    if math.prod(logical) > topo.size:
+        assert out is None
+        return
+    if out is None:
+        return  # legality may reject (e.g. non-divisor factors)
+    assert set(out) == set(range(len(logical)))
+    for i, (n, mult) in out.items():
+        assert n == logical[i]
+        assert mult > 0
+        # mult is 2 (torus), 1 (line), or 1/stride for interleaved splits
+        assert mult <= 2.0
+        frac = math.log2(mult)
+        assert abs(frac - round(frac)) < 1e-9, mult
+
+
+@settings(max_examples=150, deadline=None)
+@given(s1=shardings(2), s2=shardings(2))
+def test_opsharding_key_tracks_all_mutation_paths(s1, s2):
+    """key() must change (or at least recompute) under every in-place
+    container mutation — the r4 memo wrappers' contract."""
+    op = OpSharding(output=[s1])
+    k0 = op.key()
+    op.weights["w"] = s2
+    k1 = op.key()
+    assert k1 != k0  # weights entered the key
+    op.inputs.append(s2)
+    k2 = op.key()
+    assert k2 != k1
+    op.extras["flag"] = 1
+    assert op.key() != k2
+    op.output[0] = s2
+    k3 = op.key()
+    if s1.key() != s2.key():
+        assert k3 != k2
+    # copy() starts from the same value -> equal key, independent memo
+    cp = op.copy()
+    assert cp.key() == op.key()
+    cp.extras["other"] = 2
+    assert cp.key() != op.key()

@@ -141,6 +141,19 @@ static PyObject* np_array_copy(const void* data, const int64_t* dims,
   return owned;
 }
 
+// dtype codes shared across the ABI: 0 f32, 1 i32, 2 i64, 3 f64
+static PyObject* datatype_from_code(int dtype) {
+  PyObject* cls = getattr_checked(ff_module(), "DataType");
+  if (!cls) return nullptr;
+  const char* nm = dtype == 1   ? "INT32"
+                   : dtype == 2 ? "INT64"
+                   : dtype == 3 ? "DOUBLE"
+                                : "FLOAT";
+  PyObject* dt = getattr_checked(cls, nm);
+  Py_DECREF(cls);
+  return dt;
+}
+
 extern "C" {
 
 // ------------------------------------------------------------- lifecycle
@@ -206,15 +219,7 @@ ff_handle* flexflow_model_create_tensor(ff_handle* model, int ndim,
   PyObject* shape = PyTuple_New(ndim);
   for (int i = 0; i < ndim; ++i)
     PyTuple_SET_ITEM(shape, i, PyLong_FromLongLong(dims[i]));
-  PyObject* mod = ff_module();
-  PyObject* dt_cls = getattr_checked(mod, "DataType");
-  if (!dt_cls) {
-    Py_DECREF(shape);
-    return nullptr;
-  }
-  const char* dt_name = dtype == 1 ? "INT32" : dtype == 2 ? "INT64" : "FLOAT";
-  PyObject* dt = getattr_checked(dt_cls, dt_name);
-  Py_DECREF(dt_cls);
+  PyObject* dt = datatype_from_code(dtype);
   if (!dt) {
     Py_DECREF(shape);
     return nullptr;
@@ -1504,6 +1509,119 @@ ff_handle* flexflow_model_reduce_mean(ff_handle* m, ff_handle* x,
                                       const int* axes, int n_axes,
                                       int keepdims) {
   return reduce_op(m, x, "reduce_mean", axes, n_axes, keepdims);
+}
+
+ff_handle* flexflow_model_sin(ff_handle* m, ff_handle* x) {
+  return unary_op(m, x, "sin");
+}
+ff_handle* flexflow_model_cos(ff_handle* m, ff_handle* x) {
+  return unary_op(m, x, "cos");
+}
+ff_handle* flexflow_model_elu(ff_handle* m, ff_handle* x) {
+  return unary_op(m, x, "elu");
+}
+ff_handle* flexflow_model_rsqrt(ff_handle* m, ff_handle* x) {
+  return unary_op(m, x, "rsqrt");
+}
+
+static ff_handle* binary_op(ff_handle* m, ff_handle* a, ff_handle* b,
+                            const char* meth) {
+  return wrap(PyObject_CallMethod(m->obj, meth, "OO", a->obj, b->obj));
+}
+
+ff_handle* flexflow_model_divide(ff_handle* m, ff_handle* a, ff_handle* b) {
+  return binary_op(m, a, b, "divide");
+}
+ff_handle* flexflow_model_max(ff_handle* m, ff_handle* a, ff_handle* b) {
+  return binary_op(m, a, b, "max");
+}
+ff_handle* flexflow_model_min(ff_handle* m, ff_handle* a, ff_handle* b) {
+  return binary_op(m, a, b, "min");
+}
+
+ff_handle* flexflow_model_reverse(ff_handle* m, ff_handle* x, int axis) {
+  return wrap(PyObject_CallMethod(m->obj, "reverse", "Oi", x->obj, axis));
+}
+
+// cast: dtype codes as elsewhere (0 f32, 1 i32, 2 i64, 3 f64)
+ff_handle* flexflow_model_cast(ff_handle* m, ff_handle* x, int dtype) {
+  PyObject* dt = datatype_from_code(dtype);
+  if (!dt) return nullptr;
+  PyObject* t = PyObject_CallMethod(m->obj, "cast", "OO", x->obj, dt);
+  Py_DECREF(dt);
+  return wrap(t);
+}
+
+// --------------------------------------------- MoE piece ops (reference
+// exposes top_k / group_by / aggregate individually, flexflow_c.h — the
+// composite flexflow_model_moe remains the one-call form)
+int flexflow_model_top_k(ff_handle* m, ff_handle* x, int k, int sorted,
+                         ff_handle** out_values, ff_handle** out_indices) {
+  PyObject* r = PyObject_CallMethod(m->obj, "top_k", "OiO", x->obj, k,
+                                    sorted ? Py_True : Py_False);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* v = PySequence_GetItem(r, 0);
+  PyObject* ix = PySequence_GetItem(r, 1);
+  Py_DECREF(r);
+  if (!v || !ix) {
+    Py_XDECREF(v);
+    Py_XDECREF(ix);
+    capture_py_error();
+    return -1;
+  }
+  *out_values = wrap(v);
+  *out_indices = wrap(ix);
+  return 0;
+}
+
+// writes n_experts grouped-data handles + does NOT include the gate
+int flexflow_model_group_by(ff_handle* m, ff_handle* data, ff_handle* assign,
+                            int n_experts, double alpha, ff_handle** outs) {
+  PyObject* r = PyObject_CallMethod(m->obj, "group_by", "OOid", data->obj,
+                                    assign->obj, n_experts, alpha);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  Py_ssize_t n = PySequence_Length(r);
+  if (n < 0) {
+    Py_DECREF(r);
+    capture_py_error();
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* t = PySequence_GetItem(r, i);
+    if (!t) {
+      // unwind: free already-written handles and null them so a retrying
+      // caller neither leaks nor double-frees
+      for (Py_ssize_t j = 0; j < i; ++j) {
+        flexflow_handle_destroy(outs[j]);
+        outs[j] = nullptr;
+      }
+      Py_DECREF(r);
+      capture_py_error();
+      return -1;
+    }
+    outs[i] = wrap(t);
+  }
+  Py_DECREF(r);
+  return (int)n;
+}
+
+ff_handle* flexflow_model_aggregate(ff_handle* m, ff_handle** ins, int n_ins,
+                                    int n, double lambda_bal) {
+  PyObject* lst = PyList_New(n_ins);
+  for (int i = 0; i < n_ins; ++i) {
+    Py_INCREF(ins[i]->obj);
+    PyList_SET_ITEM(lst, i, ins[i]->obj);
+  }
+  PyObject* t =
+      PyObject_CallMethod(m->obj, "aggregate", "Oid", lst, n, lambda_bal);
+  Py_DECREF(lst);
+  return wrap(t);
 }
 
 }  // extern "C"

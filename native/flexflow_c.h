@@ -242,6 +242,28 @@ ff_handle* flexflow_model_reduce_sum(ff_handle* m, ff_handle* x,
 ff_handle* flexflow_model_reduce_mean(ff_handle* m, ff_handle* x,
                                       const int* axes, int n_axes,
                                       int keepdims);
+ff_handle* flexflow_model_sin(ff_handle* m, ff_handle* x);
+ff_handle* flexflow_model_cos(ff_handle* m, ff_handle* x);
+ff_handle* flexflow_model_elu(ff_handle* m, ff_handle* x);
+ff_handle* flexflow_model_rsqrt(ff_handle* m, ff_handle* x);
+ff_handle* flexflow_model_divide(ff_handle* m, ff_handle* a, ff_handle* b);
+ff_handle* flexflow_model_max(ff_handle* m, ff_handle* a, ff_handle* b);
+ff_handle* flexflow_model_min(ff_handle* m, ff_handle* a, ff_handle* b);
+ff_handle* flexflow_model_reverse(ff_handle* m, ff_handle* x, int axis);
+ff_handle* flexflow_model_cast(ff_handle* m, ff_handle* x, int dtype);
+
+/* MoE piece ops (the reference exposes top_k / group_by / aggregate
+ * individually; flexflow_model_moe remains the composite one-call form).
+ * top_k writes values+indices handles; group_by writes n_experts handles
+ * into outs and returns the count; aggregate's ins follow the python API:
+ * [topk_values, topk_indices, topk_indices, full_gate, expert_0, ...]
+ * (see FFModel.moe, the reference aggregate task's operand order). */
+int flexflow_model_top_k(ff_handle* m, ff_handle* x, int k, int sorted,
+                         ff_handle** out_values, ff_handle** out_indices);
+int flexflow_model_group_by(ff_handle* m, ff_handle* data, ff_handle* assign,
+                            int n_experts, double alpha, ff_handle** outs);
+ff_handle* flexflow_model_aggregate(ff_handle* m, ff_handle** ins, int n_ins,
+                                    int n, double lambda_bal);
 
 #ifdef __cplusplus
 }

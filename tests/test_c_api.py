@@ -108,3 +108,21 @@ def test_c_driver_trains_on_8_device_mesh(libflexflow_c, tmp_path_factory):
     assert "mesh devices: 8" in r.stdout, r.stdout
     acc = float(r.stdout.split("final accuracy:")[1].split()[0])
     assert acc > 0.7, r.stdout
+
+
+def test_c_driver_moe_from_piece_ops(libflexflow_c, tmp_path_factory):
+    """MoE assembled from the PIECE ops (top_k / group_by / aggregate)
+    entirely in C — the reference exposes these as separate operators and
+    its C++ MoE app composes them the same way."""
+    tmp = tmp_path_factory.mktemp("capi_moe")
+    exe = str(tmp / "moe_pieces_c")
+    _build_example("moe_pieces.c", os.path.dirname(libflexflow_c), exe)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [exe], env=env, capture_output=True, text=True, timeout=420
+    )
+    assert r.returncode == 0, f"rc={r.returncode}\nstdout:{r.stdout}\nstderr:{r.stderr}"
+    loss = float(r.stdout.split("final loss:")[1].split()[0])
+    assert loss < 1.0, r.stdout

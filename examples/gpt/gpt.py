@@ -70,6 +70,14 @@ def main() -> int:
               f"(first {losses[0]:.4f} last {losses[-1]:.4f})")
     ok = losses[-1] < losses[0]
     print("converging" if ok else "NOT converging")
+
+    # iterative decoding (reference FFIterationConfig-style): continue the
+    # stride-3 cycle the decoder just learned
+    from flexflow_tpu.models.transformer import gpt_generate
+
+    prompt = ((np.arange(4)[None, :] * 3) % vocab).repeat(batch, axis=0)
+    out = gpt_generate(model, prompt.astype(np.int32), max_new_tokens=8)
+    print(f"prompt {prompt[0].tolist()} -> generated {out[0, 4:].tolist()}")
     return 0 if ok else 1
 
 

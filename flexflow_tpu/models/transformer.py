@@ -153,3 +153,59 @@ BERT_LARGE = dict(hidden=1024, heads=16, ff_dim=4096, num_layers=24)
 # GPT-2 configs (causal-LM family for the decoder path)
 GPT2_SMALL = dict(hidden=768, heads=12, ff_dim=3072, num_layers=12)
 GPT2_MEDIUM = dict(hidden=1024, heads=16, ff_dim=4096, num_layers=24)
+
+
+def gpt_generate(
+    model,
+    prompt_ids,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    seed: int = 0,
+):
+    """Iterative decoding for a compiled :func:`gpt_decoder` model, the
+    reference's own NMT-style scheme (``FFIterationConfig::seq_length``,
+    ``include/flexflow/config.h:162-167``: decode = re-run the forward per
+    step; the reference has no KV cache either).  The causal mask makes
+    every position < t invariant to whatever sits beyond t, so ONE
+    fixed-shape compiled forward serves every step — no per-length
+    retrace.
+
+    ``prompt_ids``: (batch, prompt_len) int tokens, prompt_len >= 1.
+    Returns (batch, prompt_len + max_new_tokens) ids (greedy at
+    temperature 0, else softmax sampling with ``seed``).
+    """
+    import numpy as np
+
+    batch, seq = model.graph_inputs[0].shape
+    p = np.asarray(prompt_ids, np.int32)
+    assert p.ndim == 2 and p.shape[0] == batch, p.shape
+    start = p.shape[1]
+    end = start + max_new_tokens
+    assert 1 <= start <= seq
+    assert end <= seq, (
+        f"prompt_len + max_new_tokens = {end} exceeds the compiled "
+        f"sequence length {seq}; rebuild gpt_decoder with a longer seq"
+    )
+    cur = np.zeros((batch, seq), np.int32)
+    cur[:, :start] = p
+    rng = np.random.default_rng(seed)
+    for t in range(start, end):
+        probs = np.asarray(model.eval_batch([cur]))
+        probs = probs.reshape(batch, seq, -1)[:, t - 1]
+        if temperature <= 0.0:
+            nxt = probs.argmax(-1)
+        else:
+            # float64 throughout: rng.choice re-checks sum(p) == 1 at
+            # ~1e-8 tolerance, which float32 normalization misses
+            logp = (
+                np.log(np.maximum(probs.astype(np.float64), 1e-30))
+                / temperature
+            )
+            z = np.exp(logp - logp.max(-1, keepdims=True))
+            z /= z.sum(-1, keepdims=True)
+            nxt = np.array(
+                [rng.choice(z.shape[-1], p=z[b]) for b in range(batch)],
+                np.int32,
+            )
+        cur[:, t] = nxt
+    return cur[:, :end]

@@ -1592,6 +1592,14 @@ int flexflow_model_group_by(ff_handle* m, ff_handle* data, ff_handle* assign,
     capture_py_error();
     return -1;
   }
+  if (n != n_experts) {
+    // the caller sized outs[] to n_experts; never overrun it (version
+    // skew between this .so and the python package must error, not
+    // corrupt the heap)
+    Py_DECREF(r);
+    g_last_error = "group_by returned unexpected output count";
+    return -1;
+  }
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject* t = PySequence_GetItem(r, i);
     if (!t) {

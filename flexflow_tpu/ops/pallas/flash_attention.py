@@ -208,6 +208,20 @@ def _fwd_kernel_onepass(
     lse_ref[:] = jnp.broadcast_to(m + jnp.log(l_safe), lse_ref.shape)
 
 
+def _check_blocks(sq: int, sk: int, block_q: int, block_k: int) -> None:
+    """The tiled kernels compute ``n = s // block`` — a non-dividing
+    explicit block (default_blocks validates, explicit ones bypass it)
+    would silently leave the tail rows uninitialized.  Called on the
+    tiled forward and the (always-tiled) backward, NOT on the one-pass
+    forward, which never uses block_k."""
+    if sq % block_q != 0 or sk % block_k != 0:
+        raise ValueError(
+            f"sequence lengths ({sq}, {sk}) must be divisible by the "
+            f"tiled block sizes ({block_q}, {block_k}); use the sdpa "
+            f"path for ragged lengths"
+        )
+
+
 def _flash_fwd_onepass(q, k, v, seed, causal, dropout_rate, block_q):
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -256,9 +270,18 @@ def _flash_fwd_onepass(q, k, v, seed, causal, dropout_rate, block_q):
 # at import) for on-chip threshold sweeps; _flash_fwd shrinks block_q to
 # hold the score-tile VMEM budget when the override extends the range.
 _ONEPASS_DEFAULT_MAX_SK = 1024
-ONEPASS_MAX_SK = ONEPASS_MAX_SK_CAUSAL = int(
-    os.environ.get("FFTPU_ONEPASS_MAX_SK", _ONEPASS_DEFAULT_MAX_SK)
-)
+try:
+    ONEPASS_MAX_SK = ONEPASS_MAX_SK_CAUSAL = int(
+        os.environ.get("FFTPU_ONEPASS_MAX_SK", _ONEPASS_DEFAULT_MAX_SK)
+    )
+except ValueError:
+    import warnings
+
+    warnings.warn(
+        "FFTPU_ONEPASS_MAX_SK=%r is not an int; using default %d"
+        % (os.environ.get("FFTPU_ONEPASS_MAX_SK"), _ONEPASS_DEFAULT_MAX_SK)
+    )
+    ONEPASS_MAX_SK = ONEPASS_MAX_SK_CAUSAL = _ONEPASS_DEFAULT_MAX_SK
 # score-tile budget the default (256, 1024) config implies
 _ONEPASS_SCORE_BYTES = 256 * 1024 * 4
 
@@ -329,6 +352,7 @@ def _flash_fwd(q, k, v, seed, causal, dropout_rate, block_q, block_k,
         # score tile) goes tiled rather than dying in Mosaic VMEM alloc
         if sq % bq == 0 and bq * sk * 4 <= _ONEPASS_SCORE_BYTES:
             return _flash_fwd_onepass(q, k, v, seed, causal, dropout_rate, bq)
+    _check_blocks(sq, sk, block_q, block_k)
     sm_scale = 1.0 / math.sqrt(d)
     n_q = sq // block_q
     n_kb = sk // block_k
@@ -491,6 +515,7 @@ def _dkv_kernel(
 def _flash_bwd(q, k, v, out, lse, do, seed, causal, dropout_rate, block_q, block_k):
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    _check_blocks(sq, sk, block_q, block_k)
     sm_scale = 1.0 / math.sqrt(d)
     n_q = sq // block_q
     n_k = sk // block_k

@@ -472,7 +472,9 @@ def estimate_strategy_cost(
             dst = resolve_parallel_sharding(layer, src, mesh)
             total += reshard_cost(
                 t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m,
-                with_backward=True,
+                # graph inputs have no cotangent — same rule as dp.py, so the
+                # DP and this estimator optimize the same objective
+                with_backward=t.owner_layer is not None,
             )
             pop_out[layer.outputs[0].guid] = dst
             continue
@@ -522,13 +524,13 @@ def estimate_strategy_cost(
                 if c is None:
                     c = reshard_cost(
                         t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m,
-                        with_backward=True,
+                        with_backward=t.owner_layer is not None,
                     )
                     cost_cache[ek] = c
                 total += c
             else:
                 total += reshard_cost(
                     t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m,
-                    with_backward=True,
+                    with_backward=t.owner_layer is not None,
                 )
     return total

@@ -360,36 +360,45 @@ class MeasuredCostModel:
         self.profiler = profiler
         self.mesh = mesh
         self.machine = (machine or TPUMachineModel()).for_mesh(mesh)
+        # segments is IMMUTABLE after construction: members always price
+        # 0.0 and the anchor always carries the whole chain (fused when
+        # measurable, sum-of-isolated otherwise) — so node_time is a pure
+        # function of (layer, sharding) and costs the DP /
+        # estimate_strategy_cost memoize can never go stale (previously a
+        # segment could be disabled mid-search, leaving already-cached
+        # member prices at 0.0 under a dead scheme).
         self.segments = find_fusion_segments(layers) if layers else {}
         self._member_anchor = {
             int(m.layer_guid): a
             for a, ch in self.segments.items()
             for m in ch[1:]
         }
-        # anchors whose segment measurement has succeeded at least once;
-        # members price 0 only then (DP visits anchors first — topological)
-        self._segment_ok: set = set()
 
     def node_time(self, layer: Layer, sharding: Optional[OpSharding]) -> float:
         guid = int(layer.layer_guid)
         if guid in self.segments:
-            t = self.profiler.measure_segment(
-                self.segments[guid], sharding, self.mesh
-            )
+            chain = self.segments[guid]
+            t = self.profiler.measure_segment(chain, sharding, self.mesh)
             if t > 0:
-                self._segment_ok.add(guid)
                 return t
-            # a segment that fails under SOME sharding is disabled
-            # entirely: otherwise members keep pricing 0.0 (anchor ok
-            # under another sharding) while this sharding's anchor falls
-            # back to isolated per-op — dropping the followers' time from
-            # exactly the candidate whose fused measurement broke
-            members = self.segments.pop(guid)[1:]
-            self._segment_ok.discard(guid)
-            for m in members:
-                self._member_anchor.pop(int(m.layer_guid), None)
-        elif self._member_anchor.get(guid) in self._segment_ok:
+            # THIS sharding's fused measurement failed: charge the whole
+            # chain here (members still price 0 — consistent scheme, no
+            # dropped follower time).  Followers inherit the anchor's
+            # output layout, so time them under that sharding.
+            out0 = sharding.output[0] if sharding and sharding.output else None
+            follower_sh = (
+                OpSharding(inputs=[out0], output=[out0])
+                if out0 is not None
+                else None
+            )
+            return self._isolated(chain[0], sharding) + sum(
+                self._isolated(m, follower_sh) for m in chain[1:]
+            )
+        if guid in self._member_anchor:
             return 0.0  # charged at the segment anchor
+        return self._isolated(layer, sharding)
+
+    def _isolated(self, layer: Layer, sharding: Optional[OpSharding]) -> float:
         t = self.profiler.measure(layer, sharding, self.mesh)
         if t > 0:
             return t
@@ -569,7 +578,8 @@ def simulate_strategy(
             dst_sh = resolve_parallel_sharding(layer, src_sh, mesh)
             dur = reshard_cost(
                 t.shape, _dtype_nbytes(t.dtype), src_sh, dst_sh, mesh, m,
-                with_backward=True,
+                # graph inputs have no cotangent (same rule as dp.py)
+                with_backward=t.owner_layer is not None,
             )
             ct = collective(layer.name, dur, src_tasks)
             for o in layer.outputs:
@@ -597,7 +607,7 @@ def simulate_strategy(
             if src is not None and dst is not None and src.key() != dst.key():
                 dur = reshard_cost(
                     t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m,
-                    with_backward=True,
+                    with_backward=t.owner_layer is not None,
                 )
                 if dur > 0:
                     ct = collective(f"reshard:{t.name}->{layer.name}", dur, p)

@@ -19,6 +19,8 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include "flexflow_c.h"
+
 #include <cstdarg>
 #include <cstdint>
 #include <cstdio>
@@ -31,6 +33,8 @@ extern "C" {
 static std::string g_last_error;
 
 const char* flexflow_last_error() { return g_last_error.c_str(); }
+
+int flexflow_c_api_version() { return FLEXFLOW_C_API_VERSION; }
 
 }  // extern "C" (reopened below; helpers are C++)
 

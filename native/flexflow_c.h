@@ -10,6 +10,12 @@
 #ifndef FLEXFLOW_C_H
 #define FLEXFLOW_C_H
 
+/* ABI version.  Bumped to 2 when flexflow_model_eval{,_f32} changed their
+ * return value from "floats copied" to "full logits element count" —
+ * callers compiled against version 1 must be rebuilt.  Check at runtime
+ * with flexflow_c_api_version(). */
+#define FLEXFLOW_C_API_VERSION 2
+
 #include <stdint.h>
 
 #ifdef __cplusplus
@@ -22,6 +28,9 @@ typedef struct ff_handle ff_handle;
 int flexflow_init(void);
 void flexflow_finalize(void);
 const char* flexflow_last_error(void);
+/* returns FLEXFLOW_C_API_VERSION of the loaded library, so binaries can
+ * detect an ABI-semantics mismatch before calling eval */
+int flexflow_c_api_version(void);
 
 /* config (reference: flexflow_config_create / parse_args) */
 ff_handle* flexflow_config_create(int argc, char** argv);

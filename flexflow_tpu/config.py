@@ -49,6 +49,10 @@ class FFConfig:
     # TASO-style JSON substitution rules (reference substitution_loader.cc,
     # substitutions/graph_subst_3_v2.json); "default" loads the bundled set
     substitution_json_file: Optional[str] = None
+    # algebraic graph-rewrite tier of the search (reference GraphXfer
+    # structure rewrites, substitution.cc:1726-1868); --disable-graph-rewrites
+    # restricts the search to placements only
+    enable_graph_rewrites: bool = True
     # NOTE deliberately absent vs the reference FFConfig: perform_fusion /
     # enable_inplace_optimizations / search_overlap_backward_update (XLA
     # fuses, in-places, and overlaps inside the single jitted step program),
@@ -178,6 +182,8 @@ class FFConfig:
                 self.import_strategy_file = take()
             elif a == "--substitution-json":
                 self.substitution_json_file = take()
+            elif a == "--disable-graph-rewrites":
+                self.enable_graph_rewrites = False
             elif a == "--taskgraph":
                 self.taskgraph_file = take()
             elif a == "--compgraph":

@@ -698,6 +698,9 @@ class FFModel:
                     alpha=cfg.search_alpha,
                     machine=machine,
                     profiler=profiler,
+                    struct_xfers=(
+                        "default" if cfg.enable_graph_rewrites else None
+                    ),
                     mem_budget_bytes=(
                         cfg.device_memory_gb * (1 << 30)
                         if cfg.device_memory_gb > 0
@@ -717,6 +720,14 @@ class FFModel:
             else:
                 strategy = data_parallel_strategy(self.layers, mesh)
         self.strategy = strategy
+        if strategy.rewritten_layers is not None:
+            # the search's joint (rewrite x placement) winner changed the
+            # graph structure (reference Graph::graph_optimize returning
+            # best_graph, graph.cc:2046-2161) — adopt it: the rewritten
+            # list is what executes, and user-held output handles resolve
+            # through the remap
+            self.layers = strategy.rewritten_layers
+            logits = strategy.resolve_tensor(logits)
         # exports + profiling print only on process 0 (multi-host runs share
         # the filesystem/stdout; the reference's exports run in the
         # singleton GRAPH_OPTIMIZE task, mapper.cc:274)
@@ -828,6 +839,62 @@ class FFModel:
                             new_opt[key][lname][wname] = jax.device_put(
                                 np.asarray(arr, cur.dtype), cur.sharding
                             )
+
+    def optimize_for_inference(
+        self, budget: int = 32, alpha: float = 1.05
+    ) -> Tuple[str, ...]:
+        """Re-search the compiled model's graph with the full algebraic
+        rewrite set INCLUDING training-illegal rules (BatchNorm folding,
+        ``search.algebraic.FoldBNConv``), transporting the trained weights
+        across every applied rewrite, then rebuild the step program.
+
+        Reference: the TASO-heritage inference substitution classes in
+        ``substitutions/graph_subst_3_v2.json`` (conv+bn folding etc.),
+        applied by ``GraphXfer::create_new_graph``
+        (``src/runtime/substitution.cc:1726-1868``).
+
+        Returns the applied rule names (empty if nothing won on cost).
+        Training after this call is NOT meaningful when BN folding was
+        applied — the folded conv has no batch-statistics semantics.
+        """
+        assert self.executor is not None, "call compile() first"
+        from flexflow_tpu.search.algebraic import default_struct_xfers
+        from flexflow_tpu.search.substitution import base_optimize
+
+        st = self.strategy
+        res = base_optimize(
+            self.layers, st.mesh, dict(st.ops), budget=budget, alpha=alpha,
+            struct_xfers=default_struct_xfers(inference=True),
+            inference=True, return_joint=True,
+        )
+        if not res.applied:
+            return ()
+        # transport trained weights through the applied rewrite sequence
+        # (each weight_map reads the evolving {layer: {w: array}} dict)
+        weights = self.get_weights()
+        for wm in res.wmaps:
+            if wm is not None:
+                weights.update(wm(weights))
+        new_st = Strategy(st.mesh)
+        new_st.ops = res.assign
+        new_st.rewritten_layers = res.layers
+        new_st.output_remap = res.remap
+        new_st.applied_rewrites = st.applied_rewrites + res.applied
+        self._compile_call["strategy"] = new_st
+        self._compile_call["mesh"] = st.mesh
+        self.compile(**self._compile_call)
+        keep: Dict[str, Dict[str, np.ndarray]] = {}
+        ex = self.executor
+        for lname, ws in weights.items():
+            for wname, arr in ws.items():
+                bucket = self._weight_bucket(ex, lname, wname)
+                if bucket is not None and (
+                    bucket[lname][wname].shape == arr.shape
+                ):
+                    keep.setdefault(lname, {})[wname] = arr
+        if keep:
+            self.set_weights(keep)
+        return res.applied
 
     # ------------------------------------------------------------------- fit
     def fit(

@@ -185,9 +185,26 @@ class Strategy:
     def __init__(self, mesh: MachineMesh) -> None:
         self.mesh = mesh
         self.ops: Dict[int, OpSharding] = {}  # layer_guid -> OpSharding
+        # set by unity_search when the joint search applied algebraic
+        # graph rewrites (search.algebraic): the rewritten layer list the
+        # assignments refer to, the old-guid -> Tensor output remap, and
+        # the applied rule names (recorded in to_json for transparency —
+        # a rewritten strategy cannot be re-imported against the
+        # pre-rewrite graph)
+        self.rewritten_layers: Optional[List[Layer]] = None
+        self.output_remap: Dict = {}
+        self.applied_rewrites: Tuple[str, ...] = ()
 
     def op_sharding(self, layer: Layer) -> Optional[OpSharding]:
         return self.ops.get(int(layer.layer_guid))
+
+    def resolve_tensor(self, t):
+        """Chase a pre-rewrite tensor handle to its surviving replacement."""
+        seen = set()
+        while t.guid in self.output_remap and t.guid not in seen:
+            seen.add(t.guid)
+            t = self.output_remap[t.guid]
+        return t
 
     def weight_pspec(self, layer: Layer, wname: str, ndim: int) -> PartitionSpec:
         s = self.op_sharding(layer)
@@ -204,6 +221,7 @@ class Strategy:
         return json.dumps(
             {
                 "mesh": {"shape": list(self.mesh.shape), "axes": list(self.mesh.axis_names)},
+                "structural_rewrites": list(self.applied_rewrites),
                 "ops": {
                     str(guid): {
                         "output": [enc_ts(t) for t in s.output],
@@ -223,6 +241,17 @@ class Strategy:
         d = json.loads(text)
         mesh = MachineMesh(tuple(d["mesh"]["shape"]), tuple(d["mesh"]["axes"]))
         st = Strategy(mesh)
+        if d.get("structural_rewrites"):
+            import logging
+
+            logging.getLogger("flexflow_tpu").warning(
+                "imported strategy was searched WITH structural rewrites %s; "
+                "its op guids refer to the rewritten graph and will not "
+                "match a freshly built model — re-search instead of "
+                "importing, or export from a search run with graph "
+                "rewrites disabled",
+                d["structural_rewrites"],
+            )
 
         def dec_ts(e) -> TensorSharding:
             spec = tuple(

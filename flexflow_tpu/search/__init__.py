@@ -23,8 +23,14 @@ from flexflow_tpu.search.simulator import (
     profile_strategy,
     simulate_strategy,
 )
+from flexflow_tpu.search.algebraic import (
+    StructXfer,
+    apply_rewrite,
+    default_struct_xfers,
+)
 from flexflow_tpu.search.substitution import (
     GraphXfer,
+    JointResult,
     base_optimize,
     generate_all_pcg_xfers,
     graph_optimize,
@@ -32,6 +38,10 @@ from flexflow_tpu.search.substitution import (
 
 __all__ = [
     "GraphXfer",
+    "JointResult",
+    "StructXfer",
+    "apply_rewrite",
+    "default_struct_xfers",
     "MeasuredCostModel",
     "OpProfiler",
     "SearchHelper",

@@ -1,0 +1,747 @@
+"""Algebraic (structure-rewriting) substitutions — the TASO tier.
+
+Reference: the reference's substitution engine rewrites graph *structure*,
+not just placements: ``GraphXfer::run`` / ``create_new_graph`` build a new
+PCG from a matched pattern (``src/runtime/substitution.cc:1726-1868``),
+loading the TASO-heritage rule file
+``substitutions/graph_subst_3_v2.json`` through
+``include/flexflow/substitution_loader.h:1-50``.  Unity's search space is
+the *joint* product of these algebraic rewrites and placements.
+
+TPU-native design: a :class:`StructXfer` matches a subgraph and builds
+replacement :class:`~flexflow_tpu.tensor.Layer` records; application is
+FUNCTIONAL — downstream consumers are cloned with remapped inputs and a
+brand-new topologically sorted layer list is returned — so candidate
+rewrites explored by the search never mutate the user's graph.  Only the
+winning variant is adopted by ``FFModel.compile``.
+
+Each rewrite carries a ``weight_map`` so trained parameters can be
+transported across the rewrite (used by ``FFModel.optimize_for_inference``
+and the numerics-parity tests; compile-time search runs before parameter
+init, where mapping is unnecessary).
+
+The rule vocabulary (registered in :data:`STRUCT_BUILDERS`, referenced by
+``substitutions.json`` rules with ``"type": "structural"``) ports the
+TASO-rule classes that matter on TPU:
+
+  batch_siblings       two same-shape Linears/Convs sharing an input
+                       become ONE batched GEMM + split (the searchable
+                       form of fused QKV)
+  fuse_activation      Linear/Conv + trailing unary activation merge into
+                       the op's ``activation`` attr
+  fold_bn_conv         BatchNorm folds into the preceding Conv2D's
+                       kernel/bias (inference only)
+  fuse_experts         group_by -> N x (dense,dense) -> aggregate becomes
+                       the batched expert-parallel-capable Experts op
+  fuse_bias_add        Linear(use_bias=False) + add(weight) becomes
+                       Linear(use_bias=True)
+  cancel_transposes    transpose(transpose(x)) with identity composition
+  collapse_reshapes    reshape(reshape(x)) -> reshape(x)
+  merge_split_concat   concat(split(x)) -> x
+  eliminate_identity   identity(x) -> x
+  merge_duplicates     two identical weight-free pure ops on the same
+                       inputs collapse to one (CSE)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flexflow_tpu.fftype import ActiMode, OperatorType
+from flexflow_tpu.ops import get_op_def
+from flexflow_tpu.tensor import Layer, Tensor
+
+# {old layer name: {weight name: np.ndarray}} -> same for the new layers
+WeightMapFn = Callable[
+    [Dict[str, Dict[str, np.ndarray]]], Dict[str, Dict[str, np.ndarray]]
+]
+
+
+def build_layer(
+    op_type: OperatorType, name: str, inputs: Sequence[Tensor], attrs: Dict
+) -> Layer:
+    """Create a Layer + its inferred output tensors outside FFModel
+    (the engine's analog of ``FFModel._add_layer``)."""
+    layer = Layer(op_type, name, list(inputs), attrs)
+    for i, (shape, dtype) in enumerate(get_op_def(op_type).infer(layer)):
+        layer.outputs.append(
+            Tensor(shape, dtype, owner_layer=layer, owner_idx=i, name=f"{name}:{i}")
+        )
+    return layer
+
+
+@dataclasses.dataclass
+class Rewrite:
+    """Replacement subgraph for one match.
+
+    ``tensor_map`` sends an old tensor guid to its replacement — either an
+    output of a layer in ``new_layers`` or a pre-existing tensor that
+    survives the rewrite (op-elimination rules have empty ``new_layers``).
+
+    ``removed``: the matched layers deleted from the graph; None means the
+    whole match tuple (CSE keeps its surviving twin by listing only the
+    duplicate here)."""
+
+    new_layers: List[Layer]
+    tensor_map: Dict[int, Tensor]
+    weight_map: Optional[WeightMapFn] = None
+    removed: Optional[Tuple[Layer, ...]] = None
+
+
+class StructXfer:
+    """One structure-rewriting rule (reference ``GraphXfer`` in its full,
+    dst-graph-building form, ``substitution.cc:1726-1868``)."""
+
+    name: str = "struct"
+    inference_only: bool = False
+
+    def find_matches(self, layers: List[Layer]) -> List[Tuple[Layer, ...]]:
+        raise NotImplementedError
+
+    def build(self, match: Tuple[Layer, ...]) -> Optional[Rewrite]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------- application
+def _consumers(layers: List[Layer]) -> Dict[int, List[Layer]]:
+    out: Dict[int, List[Layer]] = {}
+    for l in layers:
+        for t in l.inputs:
+            out.setdefault(t.guid, []).append(l)
+    return out
+
+
+def _topo_stable(layers: List[Layer]) -> Optional[List[Layer]]:
+    """Stable topological order (original index breaks ties); None if the
+    list is not a DAG over its producer edges."""
+    pos = {id(l): i for i, l in enumerate(layers)}
+    producer = {o.guid: l for l in layers for o in l.outputs}
+    indeg: Dict[int, int] = {}
+    dependents: Dict[int, List[Layer]] = {}
+    for l in layers:
+        deps = {
+            id(producer[t.guid])
+            for t in l.inputs
+            if t.guid in producer and producer[t.guid] is not l
+        }
+        indeg[id(l)] = len(deps)
+        for d in deps:
+            dependents.setdefault(d, []).append(l)
+    import heapq
+
+    ready = [(pos[id(l)], l) for l in layers if indeg[id(l)] == 0]
+    heapq.heapify(ready)
+    out: List[Layer] = []
+    while ready:
+        _, l = heapq.heappop(ready)
+        out.append(l)
+        for c in dependents.get(id(l), []):
+            indeg[id(c)] -= 1
+            if indeg[id(c)] == 0:
+                heapq.heappush(ready, (pos[id(c)], c))
+    return out if len(out) == len(layers) else None
+
+
+def apply_rewrite(
+    layers: List[Layer], match: Tuple[Layer, ...], rw: Rewrite
+) -> Optional[Tuple[List[Layer], Dict[int, int], Dict[int, Tensor]]]:
+    """Functionally rebuild ``layers`` with ``match`` replaced by
+    ``rw.new_layers``.
+
+    Returns ``(new_list, guid_map, tensor_map)`` where ``guid_map`` sends a
+    cloned downstream layer's old guid to its clone's guid (so sharding
+    assignments carry over) and ``tensor_map`` is the full old-guid ->
+    new-tensor remap (so callers can chase the graph output).  None when
+    the rewrite is illegal here (an unmapped matched output has an outside
+    consumer, or the result is not a DAG)."""
+    matched_ids = {id(l) for l in (rw.removed if rw.removed is not None else match)}
+    tmap: Dict[int, Tensor] = dict(rw.tensor_map)
+    # legality: every externally visible output of a matched layer is mapped
+    for l in layers:
+        if id(l) in matched_ids:
+            continue
+        for t in l.inputs:
+            if t.owner_layer is not None and id(t.owner_layer) in matched_ids:
+                if t.guid not in tmap:
+                    return None
+    last = layers[-1]
+    if id(last) in matched_ids and last.outputs and (
+        last.outputs[0].guid not in tmap
+    ):
+        return None  # would orphan the graph output
+    first_idx = min(i for i, l in enumerate(layers) if id(l) in matched_ids)
+    guid_map: Dict[int, int] = {}
+    out: List[Layer] = []
+    for i, l in enumerate(layers):
+        if id(l) in matched_ids:
+            if i == first_idx:
+                out.extend(rw.new_layers)
+            continue
+        if any(t.guid in tmap for t in l.inputs):
+            nl = Layer(
+                l.op_type, l.name, [tmap.get(t.guid, t) for t in l.inputs],
+                l.attrs,
+            )
+            for o in l.outputs:
+                no = Tensor(
+                    o.shape, o.dtype, owner_layer=nl, owner_idx=o.owner_idx,
+                    name=o.name,
+                )
+                nl.outputs.append(no)
+                tmap[o.guid] = no
+            guid_map[int(l.layer_guid)] = int(nl.layer_guid)
+            out.append(nl)
+        else:
+            out.append(l)
+    sorted_out = _topo_stable(out)
+    if sorted_out is None:
+        return None
+    return sorted_out, guid_map, tmap
+
+
+def graph_signature(layers: List[Layer]) -> Tuple:
+    """Structural identity of a layer list, guid-free — two applications of
+    the same rule sequence produce equal signatures even though clone guids
+    differ (the search's dedup key)."""
+    return tuple((l.op_type.value, l.name) for l in layers)
+
+
+# ------------------------------------------------------------------ builders
+_ACT_OPS = {
+    OperatorType.RELU: ActiMode.RELU,
+    OperatorType.SIGMOID: ActiMode.SIGMOID,
+    OperatorType.TANH: ActiMode.TANH,
+    OperatorType.GELU: ActiMode.GELU,
+}
+
+# ops that are deterministic, weight-free, state-free — legal CSE targets
+_PURE_OPS = frozenset(
+    {
+        OperatorType.EW_ADD, OperatorType.EW_SUB, OperatorType.EW_MUL,
+        OperatorType.EW_DIV, OperatorType.EW_MAX, OperatorType.EW_MIN,
+        OperatorType.RELU, OperatorType.SIGMOID, OperatorType.TANH,
+        OperatorType.GELU, OperatorType.EXP, OperatorType.SIN,
+        OperatorType.COS, OperatorType.RSQRT, OperatorType.IDENTITY,
+        OperatorType.SCALAR_MULTIPLY, OperatorType.SCALAR_ADD,
+        OperatorType.SCALAR_SUB, OperatorType.SCALAR_TRUE_DIV,
+        OperatorType.SOFTMAX, OperatorType.RESHAPE, OperatorType.TRANSPOSE,
+        OperatorType.CONCAT, OperatorType.SPLIT, OperatorType.FLAT,
+        OperatorType.CAST, OperatorType.POOL2D, OperatorType.REVERSE,
+    }
+)
+
+
+class BatchSiblings(StructXfer):
+    """Two same-hyperparameter Linears (or Convs) consuming the SAME tensor
+    become one batched GEMM + split — TASO's merge-matmul class (the
+    reference JSON's two-matmul/two-conv merge rules) and the searchable
+    form of fused QKV.  On TPU this halves the activation HBM reads and
+    feeds the MXU one larger matmul."""
+
+    def __init__(self, op: OperatorType) -> None:
+        if op not in (OperatorType.LINEAR, OperatorType.CONV2D):
+            raise ValueError(f"batch_siblings supports linear/conv2d, not {op}")
+        self.op = op
+        self.name = f"batch_sibling_{op.value}s"
+
+    def _group_key(self, l: Layer):
+        a = l.attrs
+        if self.op is OperatorType.LINEAR:
+            return (
+                l.inputs[0].guid, str(a.get("activation", ActiMode.NONE)),
+                bool(a.get("use_bias", True)), l.inputs[0].dtype.value,
+            )
+        if a.get("groups", 1) != 1:
+            return None
+        return (
+            l.inputs[0].guid, str(a.get("activation", ActiMode.NONE)),
+            bool(a.get("use_bias", True)), l.inputs[0].dtype.value,
+            a["kernel_h"], a["kernel_w"], a["stride_h"], a["stride_w"],
+            a["padding_h"], a["padding_w"],
+        )
+
+    def find_matches(self, layers):
+        groups: Dict[Tuple, List[Layer]] = {}
+        for l in layers:
+            if l.op_type is self.op and l.inputs:
+                k = self._group_key(l)
+                if k is not None:
+                    groups.setdefault(k, []).append(l)
+        return [
+            (a, b) for g in groups.values() for a, b in zip(g, g[1:])
+        ]
+
+    def build(self, match):
+        l1, l2 = match
+        x = l1.inputs[0]
+        a1, a2 = l1.attrs, l2.attrs
+        base = f"batched({l1.name}+{l2.name})"
+        if self.op is OperatorType.LINEAR:
+            d1, d2 = a1["out_dim"], a2["out_dim"]
+            big = build_layer(
+                OperatorType.LINEAR, base, [x],
+                dict(a1, out_dim=d1 + d2),
+            )
+            axis, waxis = x.ndim - 1, 1
+        else:
+            d1, d2 = a1["out_channels"], a2["out_channels"]
+            big = build_layer(
+                OperatorType.CONV2D, base, [x],
+                dict(a1, out_channels=d1 + d2),
+            )
+            axis, waxis = 1, 3
+        sp = build_layer(
+            OperatorType.SPLIT, base + ".split", [big.outputs[0]],
+            dict(axis=axis, sizes=(d1, d2)),
+        )
+        use_bias = a1.get("use_bias", True)
+
+        def wmap(w, _n1=l1.name, _n2=l2.name, _base=base, _wx=waxis):
+            out = {
+                "kernel": np.concatenate(
+                    [w[_n1]["kernel"], w[_n2]["kernel"]], axis=_wx
+                )
+            }
+            if use_bias:
+                out["bias"] = np.concatenate(
+                    [w[_n1]["bias"], w[_n2]["bias"]], axis=0
+                )
+            return {_base: out}
+
+        return Rewrite(
+            new_layers=[big, sp],
+            tensor_map={
+                l1.outputs[0].guid: sp.outputs[0],
+                l2.outputs[0].guid: sp.outputs[1],
+            },
+            weight_map=wmap,
+        )
+
+
+class FuseActivation(StructXfer):
+    """Linear/Conv with ``activation=NONE`` followed by a unary activation
+    merges the activation into the op's attr (TASO's op+activation fusion
+    rules).  The layer KEEPS its name, so weights transfer by identity."""
+
+    def __init__(self, op: OperatorType, act_op: OperatorType) -> None:
+        self.op = op
+        self.act_op = act_op
+        self.name = f"fuse_{op.value}_{act_op.value}"
+
+    def find_matches(self, layers):
+        cons = _consumers(layers)
+        out = []
+        for l in layers:
+            if l.op_type is not self.op:
+                continue
+            if l.attrs.get("activation", ActiMode.NONE) is not ActiMode.NONE:
+                continue
+            cs = cons.get(l.outputs[0].guid, [])
+            if len(cs) == 1 and cs[0].op_type is self.act_op:
+                out.append((l, cs[0]))
+        return out
+
+    def build(self, match):
+        l, act = match
+        nl = build_layer(
+            l.op_type, l.name, l.inputs,
+            dict(l.attrs, activation=_ACT_OPS[self.act_op]),
+        )
+        return Rewrite(
+            new_layers=[nl],
+            tensor_map={act.outputs[0].guid: nl.outputs[0]},
+            weight_map=lambda w, _n=l.name: {_n: dict(w[_n])},
+        )
+
+
+class FoldBNConv(StructXfer):
+    """BatchNorm folds into the preceding Conv2D's kernel and bias — the
+    classic inference rewrite (the reference JSON's conv+bn fusion class).
+    Inference-only: training BN normalizes by batch statistics and updates
+    running stats, which a static fold cannot reproduce."""
+
+    name = "fold_bn_into_conv"
+    inference_only = True
+
+    def find_matches(self, layers):
+        cons = _consumers(layers)
+        out = []
+        for l in layers:
+            if l.op_type is not OperatorType.CONV2D:
+                continue
+            if l.attrs.get("activation", ActiMode.NONE) is not ActiMode.NONE:
+                continue
+            cs = cons.get(l.outputs[0].guid, [])
+            if len(cs) == 1 and cs[0].op_type is OperatorType.BATCHNORM:
+                out.append((l, cs[0]))
+        return out
+
+    def build(self, match):
+        conv, bn = match
+        relu = bn.attrs.get("relu", True)
+        nl = build_layer(
+            OperatorType.CONV2D, conv.name + ".bnfold", conv.inputs,
+            dict(
+                conv.attrs, use_bias=True,
+                activation=ActiMode.RELU if relu else ActiMode.NONE,
+            ),
+        )
+        eps = bn.attrs.get("eps", 1e-5)
+        had_bias = conv.attrs.get("use_bias", True)
+
+        def wmap(w, _c=conv.name, _b=bn.name, _n=nl.name, _e=eps):
+            k = np.asarray(w[_c]["kernel"], np.float32)
+            g = np.asarray(w[_b]["scale"], np.float32)
+            be = np.asarray(w[_b]["bias"], np.float32)
+            mu = np.asarray(w[_b]["running_mean"], np.float32)
+            var = np.asarray(w[_b]["running_var"], np.float32)
+            inv = g / np.sqrt(var + _e)
+            b0 = (
+                np.asarray(w[_c]["bias"], np.float32)
+                if had_bias and "bias" in w[_c]
+                else np.zeros_like(mu)
+            )
+            return {_n: {
+                "kernel": (k * inv).astype(k.dtype),
+                "bias": (be + (b0 - mu) * inv).astype(k.dtype),
+            }}
+
+        return Rewrite(
+            new_layers=[nl],
+            tensor_map={bn.outputs[0].guid: nl.outputs[0]},
+            weight_map=wmap,
+        )
+
+
+class FuseExperts(StructXfer):
+    """group_by -> N x (dense-relu, dense) -> aggregate becomes the single
+    batched :class:`~flexflow_tpu.ops.moe.Experts` op (weights stacked on a
+    leading expert dim), making expert parallelism a plain sharding
+    decision — the search-found form of ``FFModel.moe(fused=True)``
+    (reference composite ``src/ops/moe.cc:20-44``)."""
+
+    name = "fuse_parallel_experts"
+
+    def find_matches(self, layers):
+        cons = _consumers(layers)
+        out = []
+        for gb in layers:
+            if gb.op_type is not OperatorType.GROUP_BY:
+                continue
+            n = gb.attrs["n_experts"]
+            chain: List[Layer] = []
+            expert_outs = []
+            ok = True
+            h = d = None
+            for i in range(n):
+                c1 = cons.get(gb.outputs[i].guid, [])
+                if len(c1) != 1 or c1[0].op_type is not OperatorType.LINEAR:
+                    ok = False
+                    break
+                d1 = c1[0]
+                c2 = cons.get(d1.outputs[0].guid, [])
+                if len(c2) != 1 or c2[0].op_type is not OperatorType.LINEAR:
+                    ok = False
+                    break
+                d2 = c2[0]
+                if (
+                    d1.attrs.get("activation") is not ActiMode.RELU
+                    or d2.attrs.get("activation", ActiMode.NONE)
+                    is not ActiMode.NONE
+                    or not d1.attrs.get("use_bias", True)
+                    or not d2.attrs.get("use_bias", True)
+                ):
+                    ok = False
+                    break
+                if h is None:
+                    h, d = d1.attrs["out_dim"], d2.attrs["out_dim"]
+                elif d1.attrs["out_dim"] != h or d2.attrs["out_dim"] != d:
+                    ok = False
+                    break
+                chain += [d1, d2]
+                expert_outs.append(d2.outputs[0].guid)
+            if not ok:
+                continue
+            aggs = cons.get(expert_outs[0], [])
+            if len(aggs) != 1 or aggs[0].op_type is not OperatorType.AGGREGATE:
+                continue
+            agg = aggs[0]
+            if [t.guid for t in agg.inputs[4:]] != expert_outs:
+                continue
+            out.append(tuple([gb] + chain + [agg]))
+        return out
+
+    def build(self, match):
+        gb, agg = match[0], match[-1]
+        experts = match[1:-1]
+        n = gb.attrs["n_experts"]
+        h = experts[0].attrs["out_dim"]
+        nl = build_layer(
+            OperatorType.EXPERTS, f"experts({gb.name})",
+            # Experts inputs: data, assign, gate_preds, gate_full
+            [gb.inputs[0], gb.inputs[1], agg.inputs[0], agg.inputs[3]],
+            dict(
+                n_experts=n, hidden=h, alpha=gb.attrs.get("alpha", 2.0),
+                lambda_bal=agg.attrs.get("lambda_bal", 0.0),
+            ),
+        )
+        d1s = [experts[2 * i].name for i in range(n)]
+        d2s = [experts[2 * i + 1].name for i in range(n)]
+
+        def wmap(w, _d1=d1s, _d2=d2s, _n=nl.name):
+            return {_n: {
+                "w1": np.stack([w[x]["kernel"] for x in _d1]),
+                "b1": np.stack([w[x]["bias"] for x in _d1]),
+                "w2": np.stack([w[x]["kernel"] for x in _d2]),
+                "b2": np.stack([w[x]["bias"] for x in _d2]),
+            }}
+
+        return Rewrite(
+            new_layers=[nl],
+            tensor_map={agg.outputs[0].guid: nl.outputs[0]},
+            weight_map=wmap,
+        )
+
+
+class FuseBiasAdd(StructXfer):
+    """Linear(use_bias=False) + ew_add(weight) becomes
+    Linear(use_bias=True) — TASO's bias-add absorption."""
+
+    name = "fuse_bias_add_into_linear"
+
+    def find_matches(self, layers):
+        cons = _consumers(layers)
+        out = []
+        for l in layers:
+            if l.op_type is not OperatorType.LINEAR or l.attrs.get(
+                "use_bias", True
+            ):
+                continue
+            cs = cons.get(l.outputs[0].guid, [])
+            if len(cs) != 1 or cs[0].op_type is not OperatorType.EW_ADD:
+                continue
+            add = cs[0]
+            other = [t for t in add.inputs if t.guid != l.outputs[0].guid]
+            if len(other) != 1:
+                continue
+            w = other[0].owner_layer
+            if (
+                w is None or w.op_type is not OperatorType.WEIGHT or w.inputs
+                or other[0].shape != (l.attrs["out_dim"],)
+            ):
+                continue
+            out.append((l, add, w))
+        return out
+
+    def build(self, match):
+        l, add, w = match
+        nl = build_layer(
+            OperatorType.LINEAR, l.name, l.inputs, dict(l.attrs, use_bias=True)
+        )
+
+        def wmap(ws, _l=l.name, _w=w.name):
+            return {_l: {"kernel": ws[_l]["kernel"], "bias": ws[_w]["value"]}}
+
+        return Rewrite(
+            new_layers=[nl],
+            tensor_map={add.outputs[0].guid: nl.outputs[0]},
+            weight_map=wmap,
+        )
+
+
+class CancelTransposes(StructXfer):
+    """transpose(transpose(x)) with identity composition -> x."""
+
+    name = "cancel_transpose_pair"
+
+    def find_matches(self, layers):
+        cons = _consumers(layers)
+        out = []
+        for l in layers:
+            if l.op_type is not OperatorType.TRANSPOSE:
+                continue
+            cs = cons.get(l.outputs[0].guid, [])
+            if len(cs) == 1 and cs[0].op_type is OperatorType.TRANSPOSE:
+                p1, p2 = l.attrs["perm"], cs[0].attrs["perm"]
+                if all(p1[p2[i]] == i for i in range(len(p1))):
+                    out.append((l, cs[0]))
+        return out
+
+    def build(self, match):
+        t1, t2 = match
+        return Rewrite(
+            new_layers=[],
+            tensor_map={t2.outputs[0].guid: t1.inputs[0]},
+        )
+
+
+class CollapseReshapes(StructXfer):
+    """reshape(reshape(x)) -> reshape(x) to the final shape."""
+
+    name = "collapse_reshape_chain"
+
+    def find_matches(self, layers):
+        cons = _consumers(layers)
+        return [
+            (l, cs[0])
+            for l in layers
+            if l.op_type is OperatorType.RESHAPE
+            for cs in [cons.get(l.outputs[0].guid, [])]
+            if len(cs) == 1 and cs[0].op_type is OperatorType.RESHAPE
+        ]
+
+    def build(self, match):
+        r1, r2 = match
+        nl = build_layer(
+            OperatorType.RESHAPE, r2.name, r1.inputs, dict(r2.attrs)
+        )
+        return Rewrite(
+            new_layers=[nl], tensor_map={r2.outputs[0].guid: nl.outputs[0]}
+        )
+
+
+class MergeSplitConcat(StructXfer):
+    """concat(split(x)) over the same axis in order -> x."""
+
+    name = "merge_split_concat"
+
+    def find_matches(self, layers):
+        cons = _consumers(layers)
+        out = []
+        for l in layers:
+            if l.op_type is not OperatorType.SPLIT:
+                continue
+            first = cons.get(l.outputs[0].guid, [])
+            if len(first) != 1 or first[0].op_type is not OperatorType.CONCAT:
+                continue
+            cc = first[0]
+            if cc.attrs["axis"] % l.outputs[0].ndim != (
+                l.attrs["axis"] % l.inputs[0].ndim
+            ):
+                continue
+            if [t.guid for t in cc.inputs] != [o.guid for o in l.outputs]:
+                continue
+            out.append((l, cc))
+        return out
+
+    def build(self, match):
+        sp, cc = match
+        return Rewrite(
+            new_layers=[], tensor_map={cc.outputs[0].guid: sp.inputs[0]}
+        )
+
+
+class EliminateIdentity(StructXfer):
+    name = "eliminate_identity"
+
+    def find_matches(self, layers):
+        return [
+            (l,) for l in layers if l.op_type is OperatorType.IDENTITY
+        ]
+
+    def build(self, match):
+        (l,) = match
+        return Rewrite(
+            new_layers=[], tensor_map={l.outputs[0].guid: l.inputs[0]}
+        )
+
+
+class MergeDuplicates(StructXfer):
+    """Common-subexpression elimination: the later of two identical pure,
+    weight-free ops on identical inputs collapses onto the earlier."""
+
+    name = "merge_duplicate_ops"
+
+    def find_matches(self, layers):
+        seen: Dict[Tuple, Layer] = {}
+        out = []
+        for l in layers:
+            if l.op_type not in _PURE_OPS:
+                continue
+            key = (l.params_key(), tuple(t.guid for t in l.inputs))
+            if key in seen:
+                out.append((seen[key], l))
+            else:
+                seen[key] = l
+        return out
+
+    def build(self, match):
+        keep, drop = match
+        return Rewrite(
+            new_layers=[],
+            tensor_map={
+                o.guid: keep.outputs[i] for i, o in enumerate(drop.outputs)
+            },
+            removed=(drop,),  # the surviving twin stays in the graph
+        )
+
+
+# ----------------------------------------------------------------- registry
+# Builder factories the JSON loader resolves ``"builder"`` names against.
+# Each returns a StructXfer; ``params`` comes from the JSON rule.
+STRUCT_BUILDERS: Dict[str, Callable[..., StructXfer]] = {
+    "batch_siblings": lambda op: BatchSiblings(OperatorType(op)),
+    "fuse_activation": lambda op, act: FuseActivation(
+        OperatorType(op), OperatorType(act)
+    ),
+    "fold_bn_conv": FoldBNConv,
+    "fuse_experts": FuseExperts,
+    "fuse_bias_add": FuseBiasAdd,
+    "cancel_transposes": CancelTransposes,
+    "collapse_reshapes": CollapseReshapes,
+    "merge_split_concat": MergeSplitConcat,
+    "eliminate_identity": EliminateIdentity,
+    "merge_duplicates": MergeDuplicates,
+}
+
+
+def default_struct_xfers(inference: bool = False) -> List[StructXfer]:
+    """The built-in generator set (reference ``generate_all_pcg_xfers``'s
+    algebraic half).  ``inference=True`` adds training-illegal rules
+    (BN folding)."""
+    xs: List[StructXfer] = [
+        BatchSiblings(OperatorType.LINEAR),
+        BatchSiblings(OperatorType.CONV2D),
+        FuseActivation(OperatorType.LINEAR, OperatorType.RELU),
+        FuseActivation(OperatorType.LINEAR, OperatorType.GELU),
+        FuseActivation(OperatorType.LINEAR, OperatorType.SIGMOID),
+        FuseActivation(OperatorType.LINEAR, OperatorType.TANH),
+        FuseActivation(OperatorType.CONV2D, OperatorType.RELU),
+        FuseActivation(OperatorType.CONV2D, OperatorType.SIGMOID),
+        FuseActivation(OperatorType.CONV2D, OperatorType.TANH),
+        FuseExperts(),
+        FuseBiasAdd(),
+        CancelTransposes(),
+        CollapseReshapes(),
+        MergeSplitConcat(),
+        EliminateIdentity(),
+        MergeDuplicates(),
+    ]
+    if inference:
+        xs.append(FoldBNConv())
+    return xs
+
+
+class _MatchedRewrite:
+    __slots__ = ("xfer", "match")
+
+    def __init__(self, xfer: StructXfer, match: Tuple[Layer, ...]) -> None:
+        self.xfer = xfer
+        self.match = match
+
+
+def enumerate_rewrites(
+    layers: List[Layer],
+    xfers: Sequence[StructXfer],
+    inference: bool = False,
+) -> List[_MatchedRewrite]:
+    out = []
+    for x in xfers:
+        if x.inference_only and not inference:
+            continue
+        for m in x.find_matches(layers):
+            out.append(_MatchedRewrite(x, m))
+    return out

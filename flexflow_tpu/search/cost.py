@@ -16,7 +16,7 @@ from __future__ import annotations
 import math
 from typing import Dict, List, Optional, Tuple
 
-from flexflow_tpu.fftype import DataType
+from flexflow_tpu.fftype import DataType, OperatorType
 from flexflow_tpu.ops.base import get_op_def
 from flexflow_tpu.parallel.machine import MachineMesh
 from flexflow_tpu.parallel.strategy import OpSharding, Strategy
@@ -189,11 +189,24 @@ class TPUMachineModel:
         return self._lat(axis) + nbytes * (n - 1) / (n * self._bw(axis))
 
 
+# Zero-flop ops XLA compiles to views or fuses into their consumers'
+# loads (a slice feeds each consumer directly; reshape/flat are bitcasts):
+# charging them a full HBM round trip would bias the search against
+# structural rewrites that introduce them (batched-GEMM + split).
+_VIEW_OPS = frozenset({
+    OperatorType.SPLIT, OperatorType.RESHAPE, OperatorType.FLAT,
+    OperatorType.IDENTITY, OperatorType.NOOP, OperatorType.INPUT,
+    OperatorType.WEIGHT,
+})
+
+
 def op_compute_time(
     layer: Layer, degree: int, machine: TPUMachineModel, mxu_util: float = 0.5
 ) -> float:
     """Roofline: max(flops-bound, bandwidth-bound), fwd+bwd (bwd ≈ 2×fwd
     flops for matmul-type ops — the reference measures both separately)."""
+    if layer.op_type in _VIEW_OPS:
+        return 0.0
     opdef = get_op_def(layer.op_type)
     flops = 3.0 * opdef.flops(layer) / max(1, degree)
     mem = 3.0 * opdef.mem_bytes(layer) / max(1, degree)

@@ -9,6 +9,7 @@ search λ until the chosen strategy fits the per-device budget.
 
 from __future__ import annotations
 
+import dataclasses
 import math
 from typing import Dict, List, Optional, Tuple
 
@@ -62,10 +63,14 @@ def optimize_with_memory_budget(
     mem_budget_bytes: float,
     iters: int = 8,
     machine=None,
-) -> Tuple[float, Dict[int, OpSharding]]:
+):
     """λ binary search (reference ``graph_optimize_task`` λ loop,
-    ``graph.cc:2056-2131``): ``optimize_fn(lambda_mem)`` must return
-    (cost, assignment); λ in seconds/byte trades step time for memory.
+    ``graph.cc:2056-2131``): ``optimize_fn(lambda_mem)`` returns either
+    ``(cost, assignment)`` or a :class:`~flexflow_tpu.search.substitution.
+    JointResult` (when the run explores structural rewrites — memory and
+    time are then estimated against *that variant's* layer list); λ in
+    seconds/byte trades step time for memory.  The return shape mirrors
+    the input shape.
 
     The returned cost is always re-estimated at λ=0 (pure step time) so
     callers comparing across meshes compare like with like.  If no tried λ
@@ -73,29 +78,48 @@ def optimize_with_memory_budget(
     (the reference errors out of ``try_one_lambda`` similarly).
     """
     from flexflow_tpu.search.cost import estimate_strategy_cost
+    from flexflow_tpu.search.substitution import JointResult
 
-    def mem_of(a: Dict[int, OpSharding]) -> float:
+    def norm(res) -> JointResult:
+        if isinstance(res, JointResult):
+            return res
+        cost, assign = res
+        return JointResult(cost, assign, layers, {}, ())
+
+    joint_mode = False
+
+    def mem_of(r: JointResult) -> float:
         st = Strategy(mesh)
-        st.ops = a
-        return strategy_memory_per_device(layers, st)
+        st.ops = r.assign
+        return strategy_memory_per_device(r.layers, st)
 
-    def time_of(a: Dict[int, OpSharding]) -> float:
+    def time_of(r: JointResult) -> float:
         st = Strategy(mesh)
-        st.ops = a
-        return estimate_strategy_cost(layers, st, machine)
+        st.ops = r.assign
+        return estimate_strategy_cost(r.layers, st, machine)
 
-    _, assign = optimize_fn(0.0)
-    if mem_of(assign) <= mem_budget_bytes:
-        return time_of(assign), assign
+    def run(lam: float) -> JointResult:
+        nonlocal joint_mode
+        res = optimize_fn(lam)
+        joint_mode = joint_mode or isinstance(res, JointResult)
+        return norm(res)
 
-    tried: List[Tuple[float, Dict[int, OpSharding]]] = [(mem_of(assign), assign)]
+    def finish(r: JointResult):
+        r = dataclasses.replace(r, cost=time_of(r))
+        return r if joint_mode else (r.cost, r.assign)
+
+    r0 = run(0.0)
+    if mem_of(r0) <= mem_budget_bytes:
+        return finish(r0)
+
+    tried: List[Tuple[float, JointResult]] = [(mem_of(r0), r0)]
     # phase 1: escalate λ geometrically until something fits
     fit_lam: Optional[float] = None
     lam = 1e-9
     for _ in range(iters):
-        _, a = optimize_fn(lam)
-        m = mem_of(a)
-        tried.append((m, a))
+        r = run(lam)
+        m = mem_of(r)
+        tried.append((m, r))
         if m <= mem_budget_bytes:
             fit_lam = lam
             break
@@ -103,20 +127,20 @@ def optimize_with_memory_budget(
     if fit_lam is None:
         import logging
 
-        m_min, a_min = min(tried, key=lambda t: t[0])
+        m_min, r_min = min(tried, key=lambda t: t[0])
         logging.getLogger("flexflow_tpu").warning(
             "memory search: no λ fits budget %.2f GB (min reachable %.2f GB)",
             mem_budget_bytes / (1 << 30), m_min / (1 << 30),
         )
-        return time_of(a_min), a_min
+        return finish(r_min)
     # phase 2: binary search λ in (fit_lam/100, fit_lam] for the cheapest fit
     lo, hi = fit_lam / 100.0, fit_lam
-    best = next(a for m, a in tried if m <= mem_budget_bytes)
+    best = next(r for m, r in tried if m <= mem_budget_bytes)
     for _ in range(iters):
         mid = (lo + hi) / 2
-        _, a = optimize_fn(mid)
-        if mem_of(a) <= mem_budget_bytes:
-            best, hi = a, mid
+        r = run(mid)
+        if mem_of(r) <= mem_budget_bytes:
+            best, hi = r, mid
         else:
             lo = mid
-    return time_of(best), best
+    return finish(best)

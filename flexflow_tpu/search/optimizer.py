@@ -40,6 +40,8 @@ def unity_search(
     options=None,
     mem_search_iters: int = 8,
     extra_xfers=None,
+    struct_xfers="default",
+    inference: bool = False,
 ) -> Strategy:
     """Pick the cheapest (mesh factorization, per-op sharding) pair.
 
@@ -60,21 +62,35 @@ def unity_search(
     ``--enable-parameter-parallel``/``--enable-attribute-parallel``);
     ``mem_search_iters`` bounds the λ binary search
     (``--memory-search-budget``, ``graph.cc:2075``).
+
+    ``struct_xfers``: algebraic graph-rewrite rules searched jointly with
+    placements (reference ``GraphXfer::create_new_graph``,
+    ``substitution.cc:1726-1868``).  ``"default"`` uses
+    :func:`~flexflow_tpu.search.algebraic.default_struct_xfers`; None/()
+    disables the tier; ``inference=True`` additionally admits
+    training-illegal rules (BN folding).  When the winner applied
+    rewrites, the returned Strategy carries ``rewritten_layers`` /
+    ``output_remap`` — callers must execute that layer list.
     """
     from flexflow_tpu.search.candidates import SearchOptions, search_options
+
+    if struct_xfers == "default":
+        from flexflow_tpu.search.algebraic import default_struct_xfers
+
+        struct_xfers = default_struct_xfers(inference=inference)
 
     with search_options(options if options is not None else SearchOptions()):
         return _unity_search_impl(
             layers, mesh, graph_inputs, budget, alpha, machine,
             mem_budget_bytes, explore_meshes, beam, profiler, mem_search_iters,
-            extra_xfers,
+            extra_xfers, struct_xfers, inference,
         )
 
 
 def _unity_search_impl(
     layers, mesh, graph_inputs, budget, alpha, machine,
     mem_budget_bytes, explore_meshes, beam, profiler, mem_search_iters,
-    extra_xfers,
+    extra_xfers, struct_xfers, inference,
 ) -> Strategy:
     if graph_inputs is None:
         seen = set()
@@ -125,25 +141,31 @@ def _unity_search_impl(
                 layers, graph_inputs, _mv, machine,
                 budget=budget, alpha=alpha, beam=beam, lambda_mem=lam,
                 node_time_fn=_ntf, extra_xfers=extra_xfers,
+                struct_xfers=struct_xfers, inference=inference,
+                return_joint=True,
             )
 
         try:
             if mem_budget_bytes is not None:
-                cost, assign = optimize_with_memory_budget(
+                res = optimize_with_memory_budget(
                     run, layers, mv, mem_budget_bytes,
                     iters=mem_search_iters, machine=machine,
                 )
             else:
-                cost, assign = run(0.0)
+                res = run(0.0)
         except ShardingError:
             # mesh factorization incompatible with the model's explicit
             # parallel-op attrs (fixed degree/axis) — skip, like the
             # reference skips invalid MachineViews
             continue
-        if cost < best_cost:
-            best_cost = cost
+        if res.cost < best_cost:
+            best_cost = res.cost
             st = Strategy(mv)
-            st.ops = assign
+            st.ops = res.assign
+            if res.layers is not layers:
+                st.rewritten_layers = res.layers
+                st.output_remap = res.remap
+                st.applied_rewrites = tuple(res.applied)
             best = st
     assert best is not None, "no feasible mesh factorization"
     if profiler is not None:

@@ -30,6 +30,7 @@ import itertools
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from flexflow_tpu.fftype import OperatorType
+from flexflow_tpu.ops.base import get_op_def
 from flexflow_tpu.parallel.machine import MachineMesh
 from flexflow_tpu.parallel.strategy import OpSharding, Strategy
 from flexflow_tpu.search.candidates import op_candidates
@@ -189,33 +190,62 @@ SELECTORS: Dict[str, Callable[[List[OpSharding]], Optional[OpSharding]]] = {
 }
 
 
-def load_xfers_from_json(text_or_path: str) -> List[GraphXfer]:
+def load_xfers_from_json(text_or_path: str) -> List:
     """TASO-style JSON rule loader (reference ``substitution_loader.cc`` +
-    ``substitutions/graph_subst_3_v2.json``), adapted to the TPU IR: a rule
-    is a DAG pattern over op types (``deps`` wiring = the reference's
-    ``srcOp``/``TensorX`` input maps) plus a named target-sharding selector
-    per node (the TPU form of the reference's ``dstOp`` rewrite — sharding
-    transitions instead of inserted parallel-op nodes).
+    ``substitutions/graph_subst_3_v2.json``), adapted to the TPU IR.
 
-    Schema::
+    Two rule kinds:
 
-        {"rules": [{
-            "name": "...",
-            "pattern": [{"op": "linear", "deps": []},
-                        {"op": "ew_add", "deps": [0]}],
-            "select": ["channel_sharded", "channel_sharded" | null]
-        }]}
+    * sharding rules (default): a DAG pattern over op types (``deps``
+      wiring = the reference's ``srcOp``/``TensorX`` input maps) plus a
+      named target-sharding selector per node (the TPU form of the
+      reference's placement rewrites — sharding transitions instead of
+      inserted parallel-op nodes)::
+
+        {"name": "...",
+         "pattern": [{"op": "linear", "deps": []},
+                     {"op": "ew_add", "deps": [0]}],
+         "select": ["channel_sharded", "channel_sharded" | null]}
+
+    * structural rules (``"type": "structural"``): reference a registered
+      :data:`~flexflow_tpu.search.algebraic.STRUCT_BUILDERS` factory (the
+      TASO dst-graph classes — merge-matmuls, fold-bn, fuse-experts, …)
+      with its parameters — the TPU port of the reference's
+      ``dstOp``-building ``GraphXfer``s (``substitution.cc:1726-1868``)::
+
+        {"name": "batch_two_matmuls", "type": "structural",
+         "builder": "batch_siblings", "params": {"op": "linear"}}
+
+    Returns a mixed list of :class:`GraphXfer` and
+    :class:`~flexflow_tpu.search.algebraic.StructXfer`; ``base_optimize``
+    partitions by type.
     """
     import json
+
+    from flexflow_tpu.search.algebraic import STRUCT_BUILDERS
 
     if text_or_path.lstrip().startswith("{"):
         doc = json.loads(text_or_path)
     else:
         with open(text_or_path) as f:  # mistyped paths -> FileNotFoundError
             doc = json.load(f)
-    xfers: List[GraphXfer] = []
+    xfers: List = []
     for rule in doc["rules"]:
         name = rule["name"]
+        if rule.get("type") == "structural":
+            builder = rule.get("builder")
+            if builder not in STRUCT_BUILDERS:
+                raise ValueError(
+                    f"rule {name!r}: unknown structural builder {builder!r}; "
+                    f"known: {sorted(STRUCT_BUILDERS)}"
+                )
+            try:
+                x = STRUCT_BUILDERS[builder](**rule.get("params", {}))
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"rule {name!r}: bad params: {e}") from e
+            x.name = name
+            xfers.append(x)
+            continue
         pattern = []
         for i, p in enumerate(rule["pattern"]):
             deps = tuple(p["deps"]) if "deps" in p else None
@@ -303,6 +333,40 @@ def generate_all_pcg_xfers(mesh: MachineMesh) -> List[GraphXfer]:
 
 
 # ---------------------------------------------------------- best-first
+@dataclasses.dataclass
+class JointResult:
+    """Winner of the joint (graph structure x placement) search."""
+
+    cost: float
+    assign: Dict[int, OpSharding]
+    layers: List[Layer]
+    # old tensor guid -> surviving Tensor (compose of every applied
+    # rewrite's tensor_map); callers chase their output handles through it
+    remap: Dict
+    applied: Tuple[str, ...] = ()
+    # per applied rewrite, its weight_map (None for weight-free rules), in
+    # application order — lets FFModel.optimize_for_inference transport
+    # trained weights across the winning rewrite sequence
+    wmaps: Tuple = ()
+
+
+def _compose_remap(parent: Dict, tmap: Dict) -> Dict:
+    out = {g: tmap.get(t.guid, t) for g, t in parent.items()}
+    for g, t in tmap.items():
+        out.setdefault(g, t)
+    return out
+
+
+def _struct_rule_key(x) -> Tuple:
+    """Semantic identity of one structural rule — dedups a JSON rule that
+    re-lists a default builder under a different name."""
+    return (
+        type(x).__name__,
+        getattr(x, "op", None),
+        getattr(x, "act_op", None),
+    )
+
+
 def base_optimize(
     layers: List[Layer],
     mesh: MachineMesh,
@@ -312,59 +376,169 @@ def base_optimize(
     alpha: float = 1.05,
     lambda_mem: float = 0.0,
     node_time_fn=None,
-    extra_xfers: Optional[Sequence[GraphXfer]] = None,
-) -> Tuple[float, Dict[int, OpSharding]]:
+    extra_xfers: Optional[Sequence] = None,
+    struct_xfers: Optional[Sequence] = None,
+    inference: bool = False,
+    return_joint: bool = False,
+):
     """Best-first backtracking over xfer applications (reference
     ``base_optimize``, ``substitution.cc:2229-2311``): pop the cheapest
-    assignment, try every xfer at every match, keep candidates under
+    state, try every xfer at every match, keep candidates under
     ``alpha * best``; ``budget`` bounds pops.  ``node_time_fn`` plugs the
     measured cost tier into every candidate evaluation (the reference's
     defining feature: search driven by on-device kernel timing,
     ``src/runtime/simulator.cc:537-577``).  ``extra_xfers`` appends
-    JSON-loaded rules to the generator set (``substitution_loader.cc``)."""
+    JSON-loaded rules to the generator set (``substitution_loader.cc``).
+
+    A state is a *(layer list, sharding assignment)* pair: sharding xfers
+    move within a graph variant, :class:`~flexflow_tpu.search.algebraic.
+    StructXfer` rules (``struct_xfers``; structural entries of
+    ``extra_xfers`` are folded in) rewrite the graph itself — the joint
+    rewrite x placement space of the reference's ``GraphXfer::run``
+    (``substitution.cc:1726-1868``).  Structural candidates are built
+    functionally (:func:`~flexflow_tpu.search.algebraic.apply_rewrite`),
+    so the caller's graph is never mutated; the winning variant is
+    returned via ``return_joint=True`` as a :class:`JointResult`.
+    """
+    from flexflow_tpu.search.algebraic import (
+        StructXfer,
+        apply_rewrite,
+        enumerate_rewrites,
+        graph_signature,
+    )
+
     m = machine or TPUMachineModel()
-    # per-run price memo: valid for this (mesh, machine, node_time_fn)
+    # per-run price memo: valid for this (mesh, machine, node_time_fn);
+    # keys embed layer/tensor guids, which stay unique across variants
     cost_cache: Dict = {}
 
-    def cost_of(assign: Dict[int, OpSharding]) -> float:
+    def cost_of(lyrs: List[Layer], assign: Dict[int, OpSharding]) -> float:
         st = Strategy(mesh)
         st.ops = assign
         return estimate_strategy_cost(
-            layers, st, m, lambda_mem=lambda_mem, node_time_fn=node_time_fn,
+            lyrs, st, m, lambda_mem=lambda_mem, node_time_fn=node_time_fn,
             cost_cache=cost_cache,
         )
 
-    xfers = generate_all_pcg_xfers(mesh) + list(extra_xfers or ())
-    matches = [(x, mt) for x in xfers for mt in x.find_matches(layers)]
-    cand_cache: Dict[int, List[OpSharding]] = {}
-
-    best_cost = cost_of(start)
-    best = start
-    counter = itertools.count()
-    heap: List[Tuple[float, int, Dict[int, OpSharding]]] = [
-        (best_cost, next(counter), start)
+    shard_xfers = generate_all_pcg_xfers(mesh) + [
+        x for x in (extra_xfers or ()) if isinstance(x, GraphXfer)
     ]
-    seen = {_assign_key(start)}
+    # structural entries of extra_xfers (JSON-loaded rules) join the tier
+    # ONLY when the caller enabled it (struct_xfers is not None) — so
+    # --disable-graph-rewrites, the recursive-split halves, and the
+    # sharding-only polish pass all truly exclude structure changes.
+    # Dedup against struct_xfers: with --substitution-json default the
+    # bundled JSON re-lists the default builder set.
+    if struct_xfers is None:
+        sxs: List = []
+    else:
+        sxs = list(struct_xfers)
+        seen_rules = {_struct_rule_key(x) for x in sxs}
+        for x in extra_xfers or ():
+            if isinstance(x, StructXfer) and (
+                _struct_rule_key(x) not in seen_rules
+            ):
+                seen_rules.add(_struct_rule_key(x))
+                sxs.append(x)
+    cand_cache: Dict[int, List[OpSharding]] = {}
+    # sharding-pattern matches per graph variant.  Keyed by the GUID
+    # tuple, not the name signature: two rewrite orders can produce
+    # equal-signature variants whose layers are different clone objects,
+    # and stale matches would silently no-op on the other variant.
+    shard_match_cache: Dict[Tuple, List] = {}
+
+    def shard_matches(lyrs: List[Layer]) -> List:
+        key = tuple(int(l.layer_guid) for l in lyrs)
+        if key not in shard_match_cache:
+            shard_match_cache[key] = [
+                (x, mt) for x in shard_xfers for mt in x.find_matches(lyrs)
+            ]
+        return shard_match_cache[key]
+
+    def state_key(sig: Tuple, lyrs: List[Layer], assign) -> Tuple:
+        idx = {int(l.layer_guid): i for i, l in enumerate(lyrs)}
+        return (
+            sig,
+            tuple(sorted(
+                (idx[g], assign[g].key()) for g in assign if g in idx
+            )),
+        )
+
+    start_sig = graph_signature(layers)
+    best_cost = cost_of(layers, start)
+    best = JointResult(best_cost, start, layers, {}, ())
+    counter = itertools.count()
+    # heap entries: (cost, tiebreak, layers, assign, remap, applied, wmaps)
+    heap: List[Tuple] = [(best_cost, next(counter), layers, start, {}, (), ())]
+    seen = {state_key(start_sig, layers, start)}
     pops = 0
     while heap and pops < budget:
-        cost, _, assign = heapq.heappop(heap)
+        cost, _, lyrs, assign, remap, applied, wmaps = heapq.heappop(heap)
         pops += 1
         if cost > alpha * best_cost:
             continue
-        for xfer, mt in matches:
-            new = xfer.apply(assign, mt, mesh, cand_cache)
-            if new is None:
-                continue
-            key = _assign_key(new)
+
+        def consider(n_lyrs, n_assign, n_remap, n_applied, n_wmaps):
+            nonlocal best_cost, best
+            key = state_key(graph_signature(n_lyrs), n_lyrs, n_assign)
             if key in seen:
-                continue
+                return
             seen.add(key)
-            c = cost_of(new)
+            c = cost_of(n_lyrs, n_assign)
             if c < best_cost:
-                best_cost, best = c, new
+                best_cost = c
+                best = JointResult(
+                    c, n_assign, n_lyrs, n_remap, n_applied, n_wmaps
+                )
             if c < alpha * best_cost:
-                heapq.heappush(heap, (c, next(counter), new))
-    return best_cost, best
+                heapq.heappush(
+                    heap, (c, next(counter), n_lyrs, n_assign, n_remap,
+                           n_applied, n_wmaps)
+                )
+
+        for xfer, mt in shard_matches(lyrs):
+            new = xfer.apply(assign, mt, mesh, cand_cache)
+            if new is not None:
+                consider(lyrs, new, remap, applied, wmaps)
+        for mr in enumerate_rewrites(lyrs, sxs, inference=inference):
+            rw = mr.xfer.build(mr.match)
+            if rw is None:
+                continue
+            res = apply_rewrite(lyrs, mr.match, rw)
+            if res is None:
+                continue
+            n_lyrs, guid_map, tmap = res
+            alive = {int(l.layer_guid) for l in n_lyrs}
+            n_assign = {
+                guid_map.get(g, g): s
+                for g, s in assign.items()
+                if guid_map.get(g, g) in alive
+            }
+            n_remap = _compose_remap(remap, tmap)
+            n_applied = applied + (mr.xfer.name,)
+            n_wmaps = wmaps + (rw.weight_map,)
+            consider(n_lyrs, n_assign, n_remap, n_applied, n_wmaps)
+            # the bare variant leaves the rewrite's new ops unsharded —
+            # usually pricier than the removed (already-sharded) ops, so
+            # it would die to alpha pruning before a sharding xfer could
+            # touch it.  Seed the anchor new op's candidates directly
+            # (the reference's dst patterns carry placements for the
+            # same reason, substitution.cc OpX machine-view binding).
+            anchor = next(
+                (
+                    l for l in rw.new_layers
+                    if get_op_def(l.op_type).weights(l)
+                ),
+                None,
+            )
+            if anchor is not None:
+                for cand in op_candidates(anchor, mesh):
+                    a2 = dict(n_assign)
+                    a2[int(anchor.layer_guid)] = cand
+                    consider(n_lyrs, a2, n_remap, n_applied, n_wmaps)
+    if return_joint:
+        return best
+    return best.cost, best.assign
 
 
 def op_sharding_key(s: OpSharding) -> Tuple:
@@ -407,14 +581,47 @@ def graph_optimize(
     beam: int = 16,
     lambda_mem: float = 0.0,
     node_time_fn=None,
-    extra_xfers: Optional[Sequence[GraphXfer]] = None,
+    extra_xfers: Optional[Sequence] = None,
+    struct_xfers: Optional[Sequence] = None,
+    inference: bool = False,
+    return_joint: bool = False,
     _depth: int = 0,
-) -> Tuple[float, Dict[int, OpSharding]]:
+):
     """Recursive optimize (reference ``GraphSearchHelper::graph_optimize``,
     ``substitution.cc:1898-1945``): split at a bottleneck node when the
     graph is large, optimize halves independently, then refine the whole
-    assignment with a budgeted best-first xfer pass."""
+    assignment with a budgeted best-first xfer pass.  Structural rewrites
+    (``struct_xfers``) run only in the top-level whole-graph refinement —
+    a rewrite inside a half would dangle the other half's tensor handles."""
     from flexflow_tpu.search.dp import SearchHelper
+
+    def finish(start_assign):
+        res = base_optimize(
+            layers, mesh, start_assign, machine, budget, alpha, lambda_mem,
+            node_time_fn, extra_xfers,
+            struct_xfers=struct_xfers if _depth == 0 else None,
+            inference=inference, return_joint=True,
+        )
+        if res.applied:
+            # the joint winner changed the graph: its carried assignment
+            # may leave rewrite-born ops implicit (replicated).  Re-solve
+            # the DP on the WINNING graph for a complete assignment, then
+            # polish with sharding xfers only (reference: graph_optimize
+            # re-runs the DP on each candidate graph, graph.cc:1898-1945)
+            h2 = SearchHelper(
+                res.layers, graph_inputs, mesh, machine, beam=beam,
+                lambda_mem=lambda_mem, node_time_fn=node_time_fn,
+            )
+            _, a2 = h2.solve()
+            res2 = base_optimize(
+                res.layers, mesh, a2, machine, budget, alpha, lambda_mem,
+                node_time_fn, extra_xfers, return_joint=True,
+            )
+            res = dataclasses.replace(
+                res2, layers=res.layers, remap=res.remap,
+                applied=res.applied, wmaps=res.wmaps,
+            )
+        return res if return_joint else (res.cost, res.assign)
 
     if len(layers) > 24 and _depth < 3:
         split = find_split_node(layers)
@@ -422,26 +629,21 @@ def graph_optimize(
             pre, post = layers[: split + 1], layers[split + 1 :]
             _, a1 = graph_optimize(
                 pre, graph_inputs, mesh, machine, budget // 2 or 1, alpha,
-                beam, lambda_mem, node_time_fn, extra_xfers, _depth + 1,
+                beam, lambda_mem, node_time_fn, extra_xfers,
+                _depth=_depth + 1,
             )
             post_inputs = [t for l in post for t in l.inputs
                            if t.owner_layer is None or t.owner_layer in pre]
             _, a2 = graph_optimize(
                 post, post_inputs, mesh, machine, budget // 2 or 1, alpha,
-                beam, lambda_mem, node_time_fn, extra_xfers, _depth + 1,
+                beam, lambda_mem, node_time_fn, extra_xfers,
+                _depth=_depth + 1,
             )
-            merged = {**a1, **a2}
-            return base_optimize(
-                layers, mesh, merged, machine, budget, alpha, lambda_mem,
-                node_time_fn, extra_xfers,
-            )
+            return finish({**a1, **a2})
 
     helper = SearchHelper(
         layers, graph_inputs, mesh, machine, beam=beam, lambda_mem=lambda_mem,
         node_time_fn=node_time_fn,
     )
     _, assign = helper.solve()
-    return base_optimize(
-        layers, mesh, assign, machine, budget, alpha, lambda_mem, node_time_fn,
-        extra_xfers,
-    )
+    return finish(assign)

@@ -25,6 +25,16 @@ def rules_to_dot(doc: dict) -> str:
         name = rule["name"]
         lines.append(f"  subgraph cluster_{r} {{")
         lines.append(f'    label="{name}";')
+        if rule.get("type") == "structural":
+            # structural rules carry a registered builder, not a pattern
+            params = rule.get("params", {})
+            ptxt = ", ".join(f"{k}={v}" for k, v in params.items())
+            lines.append(
+                f'    r{r}n0 [label="builder: {rule["builder"]}'
+                f'\\n({ptxt})", style=dashed];'
+            )
+            lines.append("  }")
+            continue
         for i, (p, sel) in enumerate(zip(rule["pattern"], rule["select"])):
             sel_txt = sel if sel is not None else "(keep)"
             lines.append(f'    r{r}n{i} [label="{p["op"]}\\n-> {sel_txt}"];')

@@ -769,16 +769,16 @@ class FFModel:
         if cfg.taskgraph_file:
             from flexflow_tpu.utils import export_taskgraph
 
-            node_time_fn = None
+            cost_model = None
             if profiler is not None:
                 from flexflow_tpu.search.simulator import MeasuredCostModel
 
-                node_time_fn = MeasuredCostModel(
-                    profiler, strategy.mesh, machine
-                ).node_time
+                cost_model = MeasuredCostModel(
+                    profiler, strategy.mesh, machine, layers=self.layers
+                )
             export_taskgraph(
                 self.layers, strategy, cfg.taskgraph_file,
-                machine=machine, node_time_fn=node_time_fn,
+                machine=machine, cost_model=cost_model,
             )
         if cfg.profiling:
             from flexflow_tpu.utils import format_profiling_table, profiling_rows
@@ -812,15 +812,8 @@ class FFModel:
             self.executor._step_count = old_step
         if snapshot is None:
             return
+        self._restore_matching_weights(snapshot)
         ex = self.executor
-        keep: Dict[str, Dict[str, np.ndarray]] = {}
-        for lname, ws in snapshot.items():
-            for wname, arr in ws.items():
-                bucket = self._weight_bucket(ex, lname, wname)
-                if bucket is not None and bucket[lname][wname].shape == arr.shape:
-                    keep.setdefault(lname, {})[wname] = arr
-        if keep:
-            self.set_weights(keep)
         # carry optimizer state (Adam moments / SGD momentum / step count)
         # for surviving weights — a mid-training recompile must not reset
         # the trajectory of unaltered layers
@@ -883,8 +876,17 @@ class FFModel:
         self._compile_call["strategy"] = new_st
         self._compile_call["mesh"] = st.mesh
         self.compile(**self._compile_call)
-        keep: Dict[str, Dict[str, np.ndarray]] = {}
+        self._restore_matching_weights(weights)
+        return res.applied
+
+    def _restore_matching_weights(
+        self, weights: Dict[str, Dict[str, np.ndarray]]
+    ) -> None:
+        """set_weights restricted to entries whose (layer, name, shape)
+        exists in the freshly compiled executor — shared by recompile()
+        and optimize_for_inference()."""
         ex = self.executor
+        keep: Dict[str, Dict[str, np.ndarray]] = {}
         for lname, ws in weights.items():
             for wname, arr in ws.items():
                 bucket = self._weight_bucket(ex, lname, wname)
@@ -894,7 +896,6 @@ class FFModel:
                     keep.setdefault(lname, {})[wname] = arr
         if keep:
             self.set_weights(keep)
-        return res.applied
 
     # ------------------------------------------------------------------- fit
     def fit(

@@ -103,7 +103,37 @@ class SearchHelper:
         )
 
     def solve(self) -> Tuple[float, Dict[int, OpSharding]]:
-        """Returns (estimated step time, guid -> OpSharding)."""
+        """Returns (estimated step time, guid -> OpSharding).
+
+        ``beam`` is the STARTING frontier width: the sweep re-runs with a
+        doubled beam until the winner's cost stops improving (two
+        consecutive non-improving doublings, capped at 256).  Wide
+        fan-out graphs (CANDLE-Uno's seven feature towers) carry a
+        cross-product of live tower shardings in the frontier; pruning
+        that by current-cost alone at a fixed width drops Pareto-relevant
+        combinations — the reference's exact DP had no such knob to get
+        wrong (``graph.cc:1803``, horizontal splits), so the TPU build
+        must not expose one that silently degrades quality."""
+        best_cost, best_assign, hit = self._sweep(self.beam)
+        b, stall = self.beam, 0
+        # widening can only change the result when the beam bound
+        # actually pruned something — skip the re-sweeps otherwise
+        # (solve() is the inner loop of every lambda probe per mesh)
+        while hit and b < 256 and stall < 2:
+            b *= 2
+            c, a, hit = self._sweep(b)
+            if c < best_cost * (1.0 - 1e-9):
+                best_cost, best_assign, stall = c, a, 0
+            else:
+                stall += 1
+        return best_cost, best_assign
+
+    def _sweep(
+        self, beam: int
+    ) -> Tuple[float, Dict[int, OpSharding], bool]:
+        """One frontier-DP pass at a fixed beam width; the returned flag
+        reports whether the beam bound ever pruned the state set."""
+        hit_bound = False
         # state: frontier signature -> (cost, assignment dict)
         init_front = {
             t.guid: self._input_sharding(t) for t in self.graph_inputs
@@ -168,15 +198,16 @@ class SearchHelper:
                     if cur is None or tot < cur[0]:
                         new_states[key] = (tot, na, nf)
             # beam bound (the horizontal-split analog)
-            if len(new_states) > self.beam:
+            if len(new_states) > beam:
+                hit_bound = True
                 kept = heapq.nsmallest(
-                    self.beam, new_states.items(), key=lambda kv: kv[1][0]
+                    beam, new_states.items(), key=lambda kv: kv[1][0]
                 )
                 new_states = dict(kept)
             states = new_states
 
         best_cost, best_assign, _ = min(states.values(), key=lambda v: v[0])
-        return best_cost, best_assign
+        return best_cost, best_assign, hit_bound
 
     def _transition_cost_parallel(
         self, layer: Layer, src: TensorSharding, dst: TensorSharding

@@ -127,14 +127,15 @@ def _unity_search_impl(
 
     best: Optional[Strategy] = None
     best_cost = float("inf")
+    mcms = []  # per-mesh measured-cost models, for the coverage report
     for mv in cands:
         node_time_fn = None
         if profiler is not None:
             from flexflow_tpu.search.simulator import MeasuredCostModel
 
-            node_time_fn = MeasuredCostModel(
-                profiler, mv, machine, layers=layers
-            ).node_time
+            mcm = MeasuredCostModel(profiler, mv, machine, layers=layers)
+            mcms.append(mcm)
+            node_time_fn = mcm.node_time
 
         def run(lam: float, _mv=mv, _ntf=node_time_fn):
             return graph_optimize(
@@ -170,4 +171,24 @@ def _unity_search_impl(
     assert best is not None, "no feasible mesh factorization"
     if profiler is not None:
         profiler.save()  # persist the cost cache across sessions
+    if mcms:
+        import jax
+
+        # measured-vs-fallback coverage (VERDICT r4 #4): aggregate the
+        # query stats over every explored mesh and state it plainly —
+        # the reference never silently falls back (simulator.cc:537-577),
+        # so when this build does, the search run must say so
+        agg = {"segment": 0, "measured": 0, "fallback": 0}
+        for m_ in mcms:
+            for k in agg:
+                agg[k] += m_.query_stats[k]
+        served = agg["segment"] + agg["measured"]
+        total_q = served + agg["fallback"]
+        if jax.process_index() == 0 and total_q:
+            print(
+                f"[unity_search] measured-cost coverage: {served}/{total_q} "
+                f"leaf costs measured ({agg['segment']} fused-segment, "
+                f"{agg['measured']} isolated, {agg['fallback']} "
+                f"roofline-fallback)"
+            )
     return best

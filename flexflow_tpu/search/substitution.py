@@ -446,6 +446,7 @@ def base_optimize(
     # equal-signature variants whose layers are different clone objects,
     # and stale matches would silently no-op on the other variant.
     shard_match_cache: Dict[Tuple, List] = {}
+    struct_match_cache: Dict[Tuple, List] = {}
 
     def shard_matches(lyrs: List[Layer]) -> List:
         key = tuple(int(l.layer_guid) for l in lyrs)
@@ -454,6 +455,14 @@ def base_optimize(
                 (x, mt) for x in shard_xfers for mt in x.find_matches(lyrs)
             ]
         return shard_match_cache[key]
+
+    def struct_matches(lyrs: List[Layer]) -> List:
+        key = tuple(int(l.layer_guid) for l in lyrs)
+        if key not in struct_match_cache:
+            struct_match_cache[key] = enumerate_rewrites(
+                lyrs, sxs, inference=inference
+            )
+        return struct_match_cache[key]
 
     def state_key(sig: Tuple, lyrs: List[Layer], assign) -> Tuple:
         idx = {int(l.layer_guid): i for i, l in enumerate(lyrs)}
@@ -500,7 +509,7 @@ def base_optimize(
             new = xfer.apply(assign, mt, mesh, cand_cache)
             if new is not None:
                 consider(lyrs, new, remap, applied, wmaps)
-        for mr in enumerate_rewrites(lyrs, sxs, inference=inference):
+        for mr in struct_matches(lyrs):
             rw = mr.xfer.build(mr.match)
             if rw is None:
                 continue
@@ -605,17 +614,21 @@ def graph_optimize(
         if res.applied:
             # the joint winner changed the graph: its carried assignment
             # may leave rewrite-born ops implicit (replicated).  Re-solve
-            # the DP on the WINNING graph for a complete assignment, then
-            # polish with sharding xfers only (reference: graph_optimize
-            # re-runs the DP on each candidate graph, graph.cc:1898-1945)
+            # the DP on the WINNING graph, then polish with sharding
+            # xfers only (reference: graph_optimize re-runs the DP on
+            # each candidate graph, graph.cc:1898-1945).  The polish
+            # STARTS from the DP solution overlaid with the joint
+            # winner's own choices, so it can never land in a worse
+            # basin than the assignment the search already found.
             h2 = SearchHelper(
                 res.layers, graph_inputs, mesh, machine, beam=beam,
                 lambda_mem=lambda_mem, node_time_fn=node_time_fn,
             )
             _, a2 = h2.solve()
             res2 = base_optimize(
-                res.layers, mesh, a2, machine, budget, alpha, lambda_mem,
-                node_time_fn, extra_xfers, return_joint=True,
+                res.layers, mesh, {**a2, **res.assign}, machine, budget,
+                alpha, lambda_mem, node_time_fn, extra_xfers,
+                return_joint=True,
             )
             res = dataclasses.replace(
                 res2, layers=res.layers, remap=res.remap,

@@ -22,14 +22,14 @@ from flexflow_tpu.search import TPUMachineModel, unity_search
 BUDGET = 10
 
 
-def _v5e_search(model, budget=BUDGET):
+def _v5e_search(model, budget=BUDGET, beam=16):
     """Shared v5e-tray search setup for every non-torus golden."""
     mach = TPUMachineModel.for_chip(
         "TPU v5 lite", topology=PhysicalTopology((4, 2))
     )
     return unity_search(
         model.layers, MachineMesh((8, 1), ("data", "model")),
-        budget=budget, machine=mach,
+        budget=budget, machine=mach, beam=beam,
     )
 
 
@@ -147,6 +147,56 @@ def test_xdl_golden_vocab_sharded_embeddings():
     assert len(emb) == 4, w
     for k in emb:
         assert w[k]["kernel"][0] == ["model"], (k, w[k])
+
+
+def _all_ae_apps():
+    """(name, build_fn) for all seven OSDI'22 AE apps at golden configs."""
+    from flexflow_tpu.models.candle_uno import candle_uno
+    from flexflow_tpu.models.cnn import inception_v3, resnext50
+    from flexflow_tpu.models.dlrm import xdl
+
+    def bert(model):
+        transformer_encoder(
+            model, batch=8, seq=512, hidden=1024, heads=16, ff_dim=4096,
+            num_layers=4, vocab=32000, num_classes=16, use_flash=False,
+        )
+
+    def mlp(model):
+        t = model.create_tensor((8192, 1024))
+        t = model.dense(t, 1024, ActiMode.RELU, name="h0")
+        t = model.dense(t, 1024, ActiMode.RELU, name="h1")
+        t = model.dense(t, 8, name="out")
+        model.softmax(t)
+
+    return [
+        ("bert", 8, bert),
+        ("dlrm", 2048, lambda m: dlrm(m, batch=2048)),
+        ("mlp", 8192, mlp),
+        ("resnext50", 64, lambda m: resnext50(m, 64)),
+        ("inception_v3", 64, lambda m: inception_v3(m, 64)),
+        ("xdl", 256, lambda m: xdl(m, 256)),
+        ("candle_uno", 64, lambda m: candle_uno(m, 64)),
+    ]
+
+
+def test_beam_robustness_all_ae_goldens():
+    """VERDICT r4 #5: the frontier DP prunes to ``beam`` between
+    dominators (``search/dp.py``) — a knob the reference's exact DP did
+    not have (``graph.cc:1803``).  Sweep beam over {4, 16, 64} for ALL
+    seven AE apps and assert the winner's STRUCTURE (mesh + sharded-weight
+    map, per :func:`_winner`) is beam-invariant — the goldens above pin
+    shapes at the default beam only."""
+    for name, batch, build in _all_ae_apps():
+        winners = {}
+        for beam in (4, 16, 64):
+            model = FFModel(FFConfig(batch_size=batch))
+            build(model)
+            st = _v5e_search(model, beam=beam)
+            winners[beam] = _winner(model, st)
+        assert winners[4] == winners[16] == winners[64], (
+            name,
+            {b: w for b, w in winners.items()},
+        )
 
 
 def test_candle_uno_golden_tp_feature_towers():

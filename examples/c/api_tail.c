@@ -1,0 +1,95 @@
+/* Round-5 C API tail driver: argv-driven config (parse_args), constant
+ * tensors, the clock, per-type destroys, and graph introspection
+ * (model_get_layer_by_id / op_get_* / tensor_get_owner_op).
+ *
+ * Reference analog: every reference C++ app's FFConfig::parse_args entry
+ * (src/runtime/model.cc:3566+) plus the op/tensor handle walkers of
+ * include/flexflow/flexflow_c.h.  Exits non-zero on ANY misbehavior.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "flexflow_c.h"
+
+#define CHECK(cond, msg)                                         \
+  do {                                                           \
+    if (!(cond)) {                                               \
+      fprintf(stderr, "FAIL %s: %s\n", msg, flexflow_last_error()); \
+      return 1;                                                  \
+    }                                                            \
+  } while (0)
+
+int main(void) {
+  CHECK(flexflow_init() == 0, "init");
+  CHECK(flexflow_c_api_version() == 2, "abi version");
+
+  double t0 = flexflow_get_current_time();
+  double t1 = flexflow_get_current_time();
+  CHECK(t1 >= t0 && t0 > 0, "clock");
+
+  /* parse_args consumes flags in place, keeps the rest in order */
+  ff_handle* cfg = flexflow_config_create(0, NULL);
+  CHECK(cfg != NULL, "config_create");
+  char* argv[] = {"prog", "-b", "32", "--epochs", "2", "extra"};
+  int argc = 6;
+  CHECK(flexflow_config_parse_args(cfg, &argc, argv) == 0, "parse_args");
+  CHECK(argc == 2, "parse_args argc");
+  CHECK(strcmp(argv[0], "prog") == 0 && strcmp(argv[1], "extra") == 0,
+        "parse_args leftovers");
+  CHECK(flexflow_config_get_num_nodes(cfg) == 1, "num_nodes");
+  CHECK(flexflow_config_get_workers_per_node(cfg) >= 1, "workers_per_node");
+  CHECK(flexflow_config_get_enable_control_replication(cfg) == 1,
+        "control_replication");
+
+  /* build a small graph, then walk it */
+  ff_handle* model = flexflow_model_create(cfg);
+  CHECK(model != NULL, "model_create");
+  int64_t dims[2] = {8, 16};
+  ff_handle* x = flexflow_model_create_tensor(model, 2, dims, 0, "x");
+  CHECK(x != NULL, "create_tensor");
+  ff_handle* h = flexflow_model_dense(model, x, 4, 1 /* relu */);
+  CHECK(h != NULL, "dense");
+  int64_t cdims[1] = {4};
+  ff_handle* c = flexflow_constant_create(model, 1, cdims, 0.5, 0);
+  CHECK(c != NULL, "constant_create");
+
+  ff_handle* last = flexflow_model_get_last_layer(model);
+  CHECK(last != NULL, "get_last_layer"); /* the constant's Weight source */
+  ff_handle* dense_l = flexflow_model_get_layer_by_id(model, 0);
+  CHECK(dense_l != NULL, "get_layer_by_id");
+  CHECK(flexflow_op_get_num_inputs(dense_l) == 1, "op_num_inputs");
+  CHECK(flexflow_op_get_num_outputs(dense_l) == 1, "op_num_outputs");
+  CHECK(flexflow_op_get_num_parameters(dense_l) == 2, "op_num_parameters");
+  ff_handle* out0 = flexflow_op_get_output_by_id(dense_l, 0);
+  CHECK(out0 != NULL, "op_get_output");
+  CHECK(flexflow_tensor_get_ndim(out0) == 2, "output ndim");
+  ff_handle* owner = flexflow_tensor_get_owner_op(out0);
+  CHECK(owner != NULL, "tensor_get_owner_op");
+  CHECK(flexflow_tensor_get_owner_op(x) == NULL, "input has no owner");
+  ff_handle* in0 = flexflow_op_get_input_by_id(dense_l, 0);
+  CHECK(in0 != NULL, "op_get_input");
+  ff_handle* param = flexflow_op_get_parameter_by_id(dense_l, 0);
+  CHECK(param != NULL, "op_get_parameter_by_id");
+  CHECK(flexflow_parameter_num_elements(model, param) == 16 * 4,
+        "kernel elements");
+
+  /* null-initializer sentinel + per-type destroys */
+  ff_handle* null_init = flexflow_initializer_create_null();
+  CHECK(null_init != NULL, "initializer_create_null");
+  flexflow_initializer_destroy(null_init);
+  flexflow_handle_destroy(param);
+  flexflow_tensor_destroy(in0);
+  flexflow_handle_destroy(owner);
+  flexflow_tensor_destroy(out0);
+  flexflow_handle_destroy(dense_l);
+  flexflow_handle_destroy(last);
+  flexflow_tensor_destroy(c);
+  flexflow_tensor_destroy(h);
+  flexflow_tensor_destroy(x);
+  flexflow_model_destroy(model);
+  flexflow_config_destroy(cfg);
+
+  printf("api tail ok\n");
+  return 0;
+}

@@ -434,9 +434,7 @@ class MeasuredCostModel:
     def coverage_summary(self, layers: Optional[List[Layer]] = None) -> str:
         """One line for search logs: query counts + per-layer coverage
         ('N/M leaf costs measured')."""
-        q = self.query_stats
-        served = q["segment"] + q["measured"]
-        total_q = served + q["fallback"]
+        out = format_coverage(self.query_stats)
         if layers is not None:
             guids = [
                 int(l.layer_guid) for l in layers
@@ -445,14 +443,21 @@ class MeasuredCostModel:
             hit = sum(
                 1 for g in guids if self.coverage.get(g) in ("segment", "measured")
             )
-            per_layer = f"; {hit}/{len(guids)} layers measured"
-        else:
-            per_layer = ""
-        return (
-            f"{served}/{total_q} leaf costs measured "
-            f"({q['segment']} fused-segment, {q['measured']} isolated, "
-            f"{q['fallback']} roofline-fallback){per_layer}"
-        )
+            out += f"; {hit}/{len(guids)} layers measured"
+        return out
+
+
+def format_coverage(stats: Dict[str, int]) -> str:
+    """The ONE formatter for measured-vs-fallback query stats — used by
+    coverage_summary, unity_search's end-of-search line, and the
+    --profiling table so the three reports can never drift."""
+    served = stats["segment"] + stats["measured"]
+    total = served + stats["fallback"]
+    return (
+        f"{served}/{total} leaf costs measured "
+        f"({stats['segment']} fused-segment, {stats['measured']} isolated, "
+        f"{stats['fallback']} roofline-fallback)"
+    )
 
 
 # ----------------------------------------------------- event-driven sim

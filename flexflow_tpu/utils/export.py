@@ -213,13 +213,16 @@ def format_profiling_table(rows: List[Dict]) -> str:
             f"{r['time_s'] * 1e6:>8.1f}us {pct:>5.1f}%  {r['source']}"
         )
     out.append(f"{'TOTAL':<28} {'':<20} {total * 1e6:>8.1f}us")
-    measured = sum(
-        1 for r in rows if r["source"] in ("measured", "segment", "segment-member")
-    )
     if any(r["source"] != "analytic" for r in rows):
-        out.append(
-            f"measured-cost coverage: {measured}/{len(rows)} leaf costs "
-            f"measured, {sum(1 for r in rows if r['source'] == 'fallback')} "
-            f"roofline-fallback"
-        )
+        from flexflow_tpu.search.simulator import format_coverage
+
+        stats = {"segment": 0, "measured": 0, "fallback": 0}
+        for r in rows:
+            if r["source"] in ("segment", "segment-member"):
+                stats["segment"] += 1
+            elif r["source"] == "measured":
+                stats["measured"] += 1
+            else:
+                stats["fallback"] += 1
+        out.append("measured-cost coverage: " + format_coverage(stats))
     return "\n".join(out)

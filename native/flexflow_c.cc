@@ -21,11 +21,14 @@
 
 #include "flexflow_c.h"
 
+#include <chrono>
 #include <cstdarg>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
+#include <vector>
 
 extern "C" {
 
@@ -181,8 +184,16 @@ ff_handle* flexflow_config_create(int argc, char** argv) {
   if (!cfg) return wrap(nullptr);
   if (argc > 0) {
     PyObject* args = PyList_New(argc);
-    for (int i = 0; i < argc; ++i)
-      PyList_SET_ITEM(args, i, PyUnicode_FromString(argv[i]));
+    for (int i = 0; i < argc; ++i) {
+      PyObject* s = PyUnicode_DecodeFSDefault(argv[i]);
+      if (!s) {
+        capture_py_error();
+        Py_DECREF(args);
+        Py_DECREF(cfg);
+        return wrap(nullptr);
+      }
+      PyList_SET_ITEM(args, i, s);
+    }
     PyObject* rest = PyObject_CallMethod(cfg, "parse_args", "O", args);
     Py_DECREF(args);
     if (!rest) {
@@ -1477,6 +1488,22 @@ ff_handle* flexflow_model_scalar_multiply(ff_handle* m, ff_handle* x,
       PyObject_CallMethod(m->obj, "scalar_multiply", "Od", x->obj, scalar));
 }
 
+ff_handle* flexflow_model_scalar_add(ff_handle* m, ff_handle* x,
+                                     double scalar) {
+  return wrap(PyObject_CallMethod(m->obj, "scalar_add", "Od", x->obj, scalar));
+}
+
+ff_handle* flexflow_model_scalar_sub(ff_handle* m, ff_handle* x,
+                                     double scalar) {
+  return wrap(PyObject_CallMethod(m->obj, "scalar_sub", "Od", x->obj, scalar));
+}
+
+ff_handle* flexflow_model_scalar_truediv(ff_handle* m, ff_handle* x,
+                                         double scalar) {
+  return wrap(PyObject_CallMethod(m->obj, "scalar_true_divide", "Od", x->obj,
+                                  scalar));
+}
+
 ff_handle* flexflow_model_pow(ff_handle* m, ff_handle* x, double exponent) {
   return wrap(PyObject_CallMethod(m->obj, "pow", "Od", x->obj, exponent));
 }
@@ -1634,6 +1661,313 @@ ff_handle* flexflow_model_aggregate(ff_handle* m, ff_handle** ins, int n_ins,
       PyObject_CallMethod(m->obj, "aggregate", "Oid", lst, n, lambda_bal);
   Py_DECREF(lst);
   return wrap(t);
+}
+
+// -------------------------------------------------- C API tail (round 5)
+// Reference parity: flexflow_config_parse_args + helpers the name-diff
+// test (tests/test_c_api_surface.py) checks against
+// include/flexflow/flexflow_c.h; everything still absent is listed with
+// a reason in native/c_api_exclusions.json.
+
+// Reference: flexflow_config_parse_args (argv-driven config from C; every
+// reference C++ app configures itself this way).  Consumed flags are
+// REMOVED from argv and *argc updated, mirroring Legion's parse behavior.
+int flexflow_config_parse_args(ff_handle* cfg, int* argc, char** argv) {
+  if (!cfg || !argc) {
+    g_last_error = "null config/argc";
+    return -1;
+  }
+  PyObject* args = PyList_New(*argc);
+  for (int i = 0; i < *argc; ++i) {
+    // FSDefault: argv bytes may be non-UTF-8 under other locales; a NULL
+    // slot in the list would crash parse_args instead of erroring
+    PyObject* s = PyUnicode_DecodeFSDefault(argv[i]);
+    if (!s) {
+      capture_py_error();
+      Py_DECREF(args);
+      return -1;
+    }
+    PyList_SET_ITEM(args, i, s);
+  }
+  PyObject* rest = PyObject_CallMethod(cfg->obj, "parse_args", "O", args);
+  Py_DECREF(args);
+  if (!rest) {
+    capture_py_error();
+    return -1;
+  }
+  // keep only argv entries surviving in `rest`, in order (two-pointer
+  // walk; parse_args preserves the relative order of unconsumed args)
+  Py_ssize_t nrest = PySequence_Length(rest);
+  int w = 0;
+  Py_ssize_t r = 0;
+  for (int i = 0; i < *argc && r < nrest; ++i) {
+    PyObject* s = PySequence_GetItem(rest, r);
+    const char* sv = s ? PyUnicode_AsUTF8(s) : nullptr;
+    if (sv && std::strcmp(argv[i], sv) == 0) {
+      argv[w++] = argv[i];
+      ++r;
+    }
+    Py_XDECREF(s);
+  }
+  *argc = w;
+  Py_DECREF(rest);
+  return 0;
+}
+
+// Reference: flexflow_config_parse_args_default (parse the runtime's own
+// command line).  Embedded interpreters have no Legion command line; the
+// documented source is the FLEXFLOW_ARGS environment variable
+// (space-separated flags).
+int flexflow_config_parse_args_default(ff_handle* cfg) {
+  const char* env = std::getenv("FLEXFLOW_ARGS");
+  if (env == nullptr || *env == '\0') return 0;  // nothing to parse
+  std::string all(env);
+  std::vector<char*> ptrs;
+  std::vector<std::string> toks;
+  size_t pos = 0;
+  while (pos < all.size()) {
+    size_t sp = all.find(' ', pos);
+    if (sp == std::string::npos) sp = all.size();
+    if (sp > pos) toks.push_back(all.substr(pos, sp - pos));
+    pos = sp + 1;
+  }
+  for (auto& t : toks) ptrs.push_back(const_cast<char*>(t.c_str()));
+  int argc = (int)ptrs.size();
+  return flexflow_config_parse_args(cfg, &argc, ptrs.data());
+}
+
+// Reference config getters (flexflow_config_get_*).  num_nodes /
+// workers_per_node map to the JAX process/device topology; control
+// replication is ALWAYS on — every process runs the same jitted program
+// (multi-controller SPMD), which is exactly what Legion's control
+// replication emulates.
+static long jax_topology_int(const char* attr) {
+  PyObject* jax = PyImport_ImportModule("jax");
+  if (!jax) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* v = PyObject_CallMethod(jax, attr, nullptr);
+  Py_DECREF(jax);
+  if (!v) {
+    capture_py_error();
+    return -1;
+  }
+  long out = PyLong_AsLong(v);
+  Py_DECREF(v);
+  return out;
+}
+
+int flexflow_config_get_num_nodes(ff_handle* cfg) {
+  (void)cfg;
+  return (int)jax_topology_int("process_count");
+}
+
+int flexflow_config_get_workers_per_node(ff_handle* cfg) {
+  (void)cfg;
+  return (int)jax_topology_int("local_device_count");
+}
+
+int flexflow_config_get_enable_control_replication(ff_handle* cfg) {
+  (void)cfg;
+  return 1;
+}
+
+// Reference: flexflow_constant_create — a constant (non-trainable) tensor
+// (src/runtime/model.cc create_constant).  Graph form: a Weight source op
+// with a ConstantInitializer.
+ff_handle* flexflow_constant_create(ff_handle* model, int ndim,
+                                    const int64_t* dims, double value,
+                                    int dtype) {
+  PyObject* mod = ff_module();
+  if (!mod) return nullptr;
+  PyObject* init_cls = getattr_checked(mod, "ConstantInitializer");
+  if (!init_cls) return nullptr;
+  PyObject* init = PyObject_CallFunction(init_cls, "d", value);
+  Py_DECREF(init_cls);
+  if (!init) {
+    capture_py_error();
+    return nullptr;
+  }
+  PyObject* shape = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shape, i, PyLong_FromLongLong(dims[i]));
+  PyObject* dt = datatype_from_code(dtype);
+  if (!dt) {
+    Py_DECREF(init);
+    Py_DECREF(shape);
+    return nullptr;
+  }
+  PyObject* t = PyObject_CallMethod(model->obj, "parameter", "OOOi", shape,
+                                    dt, init, 0 /* trainable=False */);
+  Py_DECREF(dt);
+  Py_DECREF(shape);
+  Py_DECREF(init);
+  return wrap(t);
+}
+
+// Reference: flexflow_initializer_create_null (the "use the op's default
+// initializer" sentinel passed where no explicit initializer is wanted).
+ff_handle* flexflow_initializer_create_null(void) {
+  Py_INCREF(Py_None);
+  return wrap(Py_None);
+}
+
+// Reference: flexflow_get_current_time (Legion Realm clock) — seconds.
+double flexflow_get_current_time(void) {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Reference per-type *_destroy pairs — all handles here are owned
+// PyObject wrappers, so each is an alias of flexflow_handle_destroy (the
+// reference needed distinct destructors for distinct C++ types).
+void flexflow_config_destroy(ff_handle* h) { flexflow_handle_destroy(h); }
+void flexflow_model_destroy(ff_handle* h) { flexflow_handle_destroy(h); }
+void flexflow_tensor_destroy(ff_handle* h) { flexflow_handle_destroy(h); }
+void flexflow_glorot_uniform_initializer_destroy(ff_handle* h) {
+  flexflow_handle_destroy(h);
+}
+void flexflow_uniform_initializer_destroy(ff_handle* h) {
+  flexflow_handle_destroy(h);
+}
+void flexflow_zero_initializer_destroy(ff_handle* h) {
+  flexflow_handle_destroy(h);
+}
+void flexflow_norm_initializer_destroy(ff_handle* h) {
+  flexflow_handle_destroy(h);
+}
+
+// ------------------------------------------- graph introspection (op_*)
+// Reference: flexflow_model_get_layer_by_id / flexflow_op_get_* — walk
+// the built graph from C.  An op handle wraps the Layer record; tensor
+// handles returned here interoperate with flexflow_tensor_get_*.
+ff_handle* flexflow_model_get_layer_by_id(ff_handle* model, int id) {
+  PyObject* layers = getattr_checked(model->obj, "layers");
+  if (!layers) return nullptr;
+  PyObject* l = PySequence_GetItem(layers, id);
+  Py_DECREF(layers);
+  if (!l) capture_py_error();
+  return wrap(l);
+}
+
+ff_handle* flexflow_model_get_last_layer(ff_handle* model) {
+  PyObject* layers = getattr_checked(model->obj, "layers");
+  if (!layers) return nullptr;
+  Py_ssize_t n = PySequence_Length(layers);
+  PyObject* l = n > 0 ? PySequence_GetItem(layers, n - 1) : nullptr;
+  Py_DECREF(layers);
+  if (!l) {
+    g_last_error = "model has no layers";
+    return nullptr;
+  }
+  return wrap(l);
+}
+
+static Py_ssize_t seq_attr_len(ff_handle* op, const char* attr) {
+  PyObject* s = getattr_checked(op->obj, attr);
+  if (!s) return -1;
+  Py_ssize_t n = PySequence_Length(s);
+  Py_DECREF(s);
+  return n;
+}
+
+int flexflow_op_get_num_inputs(ff_handle* op) {
+  return (int)seq_attr_len(op, "inputs");
+}
+
+int flexflow_op_get_num_outputs(ff_handle* op) {
+  return (int)seq_attr_len(op, "outputs");
+}
+
+static ff_handle* seq_attr_item(ff_handle* op, const char* attr, int i) {
+  PyObject* s = getattr_checked(op->obj, attr);
+  if (!s) return nullptr;
+  PyObject* v = PySequence_GetItem(s, i);
+  Py_DECREF(s);
+  if (!v) capture_py_error();
+  return wrap(v);
+}
+
+ff_handle* flexflow_op_get_input_by_id(ff_handle* op, int i) {
+  return seq_attr_item(op, "inputs", i);
+}
+
+ff_handle* flexflow_op_get_output_by_id(ff_handle* op, int i) {
+  return seq_attr_item(op, "outputs", i);
+}
+
+// the op's declared WeightSpecs, via the registry
+static PyObject* op_weight_specs(ff_handle* op) {
+  PyObject* base = PyImport_ImportModule("flexflow_tpu.ops.base");
+  if (!base) {
+    capture_py_error();
+    return nullptr;
+  }
+  PyObject* get_def = getattr_checked(base, "get_op_def");
+  Py_DECREF(base);
+  if (!get_def) return nullptr;
+  PyObject* op_type = getattr_checked(op->obj, "op_type");
+  if (!op_type) {
+    Py_DECREF(get_def);
+    return nullptr;
+  }
+  PyObject* opdef = PyObject_CallFunctionObjArgs(get_def, op_type, nullptr);
+  Py_DECREF(get_def);
+  Py_DECREF(op_type);
+  if (!opdef) {
+    capture_py_error();
+    return nullptr;
+  }
+  PyObject* ws = PyObject_CallMethod(opdef, "weights", "O", op->obj);
+  Py_DECREF(opdef);
+  if (!ws) capture_py_error();
+  return ws;
+}
+
+int flexflow_op_get_num_parameters(ff_handle* op) {
+  PyObject* ws = op_weight_specs(op);
+  if (!ws) return -1;
+  Py_ssize_t n = PySequence_Length(ws);
+  Py_DECREF(ws);
+  return (int)n;
+}
+
+// returns a parameter handle ((layer name, weight name) pair) compatible
+// with the flexflow_parameter_* family
+ff_handle* flexflow_op_get_parameter_by_id(ff_handle* op, int i) {
+  PyObject* ws = op_weight_specs(op);
+  if (!ws) return nullptr;
+  PyObject* spec = PySequence_GetItem(ws, i);
+  Py_DECREF(ws);
+  if (!spec) {
+    capture_py_error();
+    return nullptr;
+  }
+  PyObject* wname = getattr_checked(spec, "name");
+  Py_DECREF(spec);
+  if (!wname) return nullptr;
+  PyObject* lname = getattr_checked(op->obj, "name");
+  if (!lname) {
+    Py_DECREF(wname);
+    return nullptr;
+  }
+  PyObject* pair = PyTuple_Pack(2, lname, wname);
+  Py_DECREF(lname);
+  Py_DECREF(wname);
+  return wrap(pair);
+}
+
+ff_handle* flexflow_tensor_get_owner_op(ff_handle* t) {
+  PyObject* owner = getattr_checked(t->obj, "owner_layer");
+  if (!owner) return nullptr;
+  if (owner == Py_None) {
+    Py_DECREF(owner);
+    g_last_error = "tensor is a graph input (no owner op)";
+    return nullptr;
+  }
+  return wrap(owner);
 }
 
 }  // extern "C"

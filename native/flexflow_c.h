@@ -260,6 +260,10 @@ ff_handle* flexflow_model_max(ff_handle* m, ff_handle* a, ff_handle* b);
 ff_handle* flexflow_model_min(ff_handle* m, ff_handle* a, ff_handle* b);
 ff_handle* flexflow_model_reverse(ff_handle* m, ff_handle* x, int axis);
 ff_handle* flexflow_model_cast(ff_handle* m, ff_handle* x, int dtype);
+ff_handle* flexflow_model_scalar_add(ff_handle* m, ff_handle* x, double scalar);
+ff_handle* flexflow_model_scalar_sub(ff_handle* m, ff_handle* x, double scalar);
+ff_handle* flexflow_model_scalar_truediv(ff_handle* m, ff_handle* x,
+                                         double scalar);
 
 /* MoE piece ops (the reference exposes top_k / group_by / aggregate
  * individually; flexflow_model_moe remains the composite one-call form).
@@ -273,6 +277,54 @@ int flexflow_model_group_by(ff_handle* m, ff_handle* data, ff_handle* assign,
                             int n_experts, double alpha, ff_handle** outs);
 ff_handle* flexflow_model_aggregate(ff_handle* m, ff_handle** ins, int n_ins,
                                     int n, double lambda_bal);
+
+/* -------- reference-parity tail (see native/c_api_exclusions.json for
+ * every reference entry point deliberately absent, with reasons) ------ */
+
+/* argv-driven config from C (reference flexflow_config_parse_args: how
+ * every reference C++ app configures itself).  Consumed flags are removed
+ * from argv and *argc updated.  parse_args_default reads the
+ * FLEXFLOW_ARGS environment variable (the embedded interpreter has no
+ * Legion command line). */
+int flexflow_config_parse_args(ff_handle* cfg, int* argc, char** argv);
+int flexflow_config_parse_args_default(ff_handle* cfg);
+
+/* topology getters: nodes = JAX processes, workers = local devices;
+ * control replication is inherent to multi-controller SPMD (always 1) */
+int flexflow_config_get_num_nodes(ff_handle* cfg);
+int flexflow_config_get_workers_per_node(ff_handle* cfg);
+int flexflow_config_get_enable_control_replication(ff_handle* cfg);
+
+/* constant (non-trainable) tensor; dtype codes as elsewhere */
+ff_handle* flexflow_constant_create(ff_handle* model, int ndim,
+                                    const int64_t* dims, double value,
+                                    int dtype);
+/* "use the op's default initializer" sentinel */
+ff_handle* flexflow_initializer_create_null(void);
+/* monotonic clock, seconds (reference Realm clock) */
+double flexflow_get_current_time(void);
+
+/* per-type destroy aliases (every handle is the same owned wrapper) */
+void flexflow_config_destroy(ff_handle* h);
+void flexflow_model_destroy(ff_handle* h);
+void flexflow_tensor_destroy(ff_handle* h);
+void flexflow_glorot_uniform_initializer_destroy(ff_handle* h);
+void flexflow_uniform_initializer_destroy(ff_handle* h);
+void flexflow_zero_initializer_destroy(ff_handle* h);
+void flexflow_norm_initializer_destroy(ff_handle* h);
+
+/* graph introspection: op handles wrap Layer records; tensors returned
+ * here work with flexflow_tensor_get_*; parameters with
+ * flexflow_parameter_* */
+ff_handle* flexflow_model_get_layer_by_id(ff_handle* model, int id);
+ff_handle* flexflow_model_get_last_layer(ff_handle* model);
+int flexflow_op_get_num_inputs(ff_handle* op);
+int flexflow_op_get_num_outputs(ff_handle* op);
+int flexflow_op_get_num_parameters(ff_handle* op);
+ff_handle* flexflow_op_get_input_by_id(ff_handle* op, int i);
+ff_handle* flexflow_op_get_output_by_id(ff_handle* op, int i);
+ff_handle* flexflow_op_get_parameter_by_id(ff_handle* op, int i);
+ff_handle* flexflow_tensor_get_owner_op(ff_handle* t);
 
 #ifdef __cplusplus
 }

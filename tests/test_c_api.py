@@ -126,3 +126,22 @@ def test_c_driver_moe_from_piece_ops(libflexflow_c, tmp_path_factory):
     assert r.returncode == 0, f"rc={r.returncode}\nstdout:{r.stdout}\nstderr:{r.stderr}"
     loss = float(r.stdout.split("final loss:")[1].split()[0])
     assert loss < 1.0, r.stdout
+
+
+def test_c_api_tail_driver(libflexflow_c, tmp_path_factory):
+    """Round-5 tail (VERDICT r4 #6): parse_args consumes flags in place,
+    constant_create makes a non-trainable constant source, the clock
+    ticks, per-type destroys work, and the op introspection family walks
+    a C-built graph (examples/c/api_tail.c exits non-zero on any
+    misbehavior)."""
+    tmp = tmp_path_factory.mktemp("capi_tail")
+    exe = str(tmp / "api_tail_c")
+    _build_example("api_tail.c", os.path.dirname(libflexflow_c), exe)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [exe], env=env, capture_output=True, text=True, timeout=420
+    )
+    assert r.returncode == 0, f"rc={r.returncode}\nstdout:{r.stdout}\nstderr:{r.stderr}"
+    assert "api tail ok" in r.stdout

@@ -1,11 +1,18 @@
 """DLRM training example (reference ``examples/cpp/DLRM/dlrm.cc``) on
-synthetic click data, with optional vocab-sharded embedding tables
-(parameter parallelism).
+synthetic click data or a real Criteo-format dataset file, with optional
+vocab-sharded embedding tables (parameter parallelism).
 
 Run:
   python examples/dlrm/dlrm.py -b 64 -e 2
   python examples/dlrm/dlrm.py --mesh-shape 2x4       # dp x tp (vocab-sharded)
   python examples/dlrm/dlrm.py --arch xdl             # reference xdl.cc
+  python examples/dlrm/dlrm.py --data day_0.h5        # reference --dataset
+  python examples/dlrm/dlrm.py --data train.tsv       # raw Criteo Kaggle
+
+``--data`` accepts the reference pipeline's .h5/.hdf5 (X_int/X_cat/y),
+its .npz input, or raw Criteo TSV (see flexflow_tpu/models/dlrm_data.py);
+batches stream through the native C++ prefetcher (native/ffdl.cc) inside
+FFModel.fit.
 """
 
 import argparse
@@ -14,6 +21,7 @@ import numpy as np
 
 from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
 from flexflow_tpu.models.dlrm import dlrm, dlrm_strategy, xdl
+from flexflow_tpu.models.dlrm_data import load_criteo
 
 
 def main():
@@ -26,14 +34,46 @@ def main():
     ap.add_argument("--bag-size", type=int, default=1)
     ap.add_argument("--arch", choices=("dlrm", "xdl"), default="dlrm",
                     help="xdl = embeddings->concat->MLP (reference xdl.cc)")
+    ap.add_argument("--data", default=None, metavar="FILE",
+                    help="Criteo-format dataset (.h5/.hdf5/.npz/.tsv); "
+                         "table count and dense width come from the file")
+    ap.add_argument("--max-samples", type=int, default=None)
     args = ap.parse_args(rest)
+
+    data = None
+    if args.data is not None:
+        xs, y = load_criteo(
+            args.data, vocab_sizes=args.embedding_size,
+            max_samples=args.max_samples,
+        )
+        args.num_tables = len(xs) - 1
+        args.bag_size = xs[0].shape[1]
+        n_dense = xs[-1].shape[1]
+        data = (xs, y)
+        print(
+            f"loaded {args.data}: {len(y)} samples, "
+            f"{args.num_tables} tables, {n_dense} dense features"
+        )
 
     vocabs = tuple([args.embedding_size] * args.num_tables)
     model = FFModel(cfg)
     build = dlrm if args.arch == "dlrm" else xdl
+    extra = {}
+    if data is not None and args.arch == "dlrm":
+        # dense width and output head follow the file (reference kaggle
+        # config: mlp_bot 13-512-256-64-..., mlp_top ...-1 + MSE loss)
+        sfs = args.sparse_feature_size
+        extra = dict(
+            mlp_bot=(data[0][-1].shape[1], 64, sfs),
+            mlp_top=(sfs * (args.num_tables + 1), 32, 1),
+        )
+    elif data is not None:
+        data = (data[0][:-1], data[1])  # xdl has no dense input
+        extra = dict(mlp=(64, 32, 1))  # 1-wide head to match file labels
     build(
         model, cfg.batch_size, embedding_sizes=vocabs,
         sparse_feature_size=args.sparse_feature_size, bag_size=args.bag_size,
+        **extra,
     )
 
     mesh = cfg.build_mesh()
@@ -47,14 +87,18 @@ def main():
     )
     print(f"compiled: {model.num_parameters} parameters, mesh={model.strategy.mesh}")
 
-    rng = np.random.default_rng(0)
-    n = 32 * cfg.batch_size
-    xs = [
-        rng.integers(0, v, size=(n, args.bag_size)).astype(np.int32) for v in vocabs
-    ]
-    if args.arch == "dlrm":
-        xs.append(rng.normal(size=(n, 4)).astype(np.float32))
-    y = rng.uniform(size=(n, 2)).astype(np.float32)
+    if data is not None:
+        xs, y = data
+    else:
+        rng = np.random.default_rng(0)
+        n = 32 * cfg.batch_size
+        xs = [
+            rng.integers(0, v, size=(n, args.bag_size)).astype(np.int32)
+            for v in vocabs
+        ]
+        if args.arch == "dlrm":
+            xs.append(rng.normal(size=(n, 4)).astype(np.float32))
+        y = rng.uniform(size=(n, 2)).astype(np.float32)
     pm = model.fit(xs, y)
     print(f"throughput: {pm.throughput():.1f} samples/s")
 

@@ -1,0 +1,139 @@
+"""Criteo-format dataset reader for the DLRM/XDL apps.
+
+Reference: the DLRM app's dataset pipeline
+(``examples/cpp/DLRM/dlrm.cc:315-420`` loading HDF5 ``X_int``/``X_cat``/
+``y`` datasets, produced from a raw ``.npz`` by
+``examples/cpp/DLRM/preprocess_hdf.py``, itself derived from the Criteo
+Kaggle TSV).  This module reads all three stages of that pipeline:
+
+* ``.h5`` / ``.hdf5`` — the reference's preprocessed layout: ``X_int``
+  float (N, n_dense) already log-transformed, ``X_cat`` int (N, n_tables),
+  ``y`` float (N,) or (N, 1).
+* ``.npz`` — the preprocess INPUT: same keys, raw counts; dense features
+  get the reference's ``log(x + 1)`` transform here.
+* ``.tsv`` / ``.txt`` (optionally ``.gz``) — raw Criteo Kaggle rows:
+  ``label \\t 13 int features \\t 26 hex-string categoricals``.  Missing
+  ints are 0; categorical hex strings hash into the table vocabulary.
+
+Output matches ``flexflow_tpu.models.dlrm.dlrm``'s input order: one
+``(N, bag_size)`` int32 array per table followed by the ``(N, n_dense)``
+float32 dense array, plus ``(N, 1)`` float32 labels — feed straight to
+``FFModel.fit``, which batches through the native C++ prefetcher
+(``native/ffdl.cc``) when built.
+"""
+
+from __future__ import annotations
+
+import gzip
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["load_criteo", "CRITEO_NUM_DENSE", "CRITEO_NUM_TABLES"]
+
+CRITEO_NUM_DENSE = 13
+CRITEO_NUM_TABLES = 26
+
+
+def _from_arrays(
+    x_int: np.ndarray,
+    x_cat: np.ndarray,
+    y: np.ndarray,
+    vocab_sizes,
+    log_transform: bool,
+    max_samples: Optional[int],
+) -> Tuple[List[np.ndarray], np.ndarray]:
+    if max_samples is not None:
+        x_int, x_cat, y = x_int[:max_samples], x_cat[:max_samples], y[:max_samples]
+    n, n_tables = x_cat.shape
+    if np.isscalar(vocab_sizes) or isinstance(vocab_sizes, int):
+        vocab_sizes = [int(vocab_sizes)] * n_tables
+    assert len(vocab_sizes) == n_tables, (len(vocab_sizes), n_tables)
+    dense = x_int.astype(np.float32)
+    if log_transform:
+        dense = np.log(np.maximum(dense, 0.0) + 1.0)  # preprocess_hdf.py
+    xs = [
+        (x_cat[:, i].astype(np.int64) % vocab_sizes[i])
+        .astype(np.int32)
+        .reshape(n, 1)
+        for i in range(n_tables)
+    ]
+    xs.append(dense)
+    return xs, y.astype(np.float32).reshape(n, 1)
+
+
+def _load_tsv(path: str, vocab_sizes, max_samples):
+    opener = gzip.open if path.lower().endswith(".gz") else open
+    labels: List[float] = []
+    ints: List[List[float]] = []
+    cats: List[List[int]] = []
+    with opener(path, "rt") as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) < 1 + CRITEO_NUM_DENSE + CRITEO_NUM_TABLES:
+                continue  # ragged tail line
+            labels.append(float(parts[0]))
+            ints.append(
+                [float(v) if v else 0.0 for v in parts[1 : 1 + CRITEO_NUM_DENSE]]
+            )
+            # hex-string categoricals hash to stable int ids
+            cats.append(
+                [
+                    int(v, 16) if v else 0
+                    for v in parts[
+                        1 + CRITEO_NUM_DENSE : 1 + CRITEO_NUM_DENSE + CRITEO_NUM_TABLES
+                    ]
+                ]
+            )
+            if max_samples is not None and len(labels) >= max_samples:
+                break
+    return _from_arrays(
+        np.asarray(ints, np.float32),
+        np.asarray(cats, np.int64),
+        np.asarray(labels, np.float32),
+        vocab_sizes,
+        log_transform=True,
+        max_samples=None,
+    )
+
+
+def load_criteo(
+    path: str,
+    vocab_sizes=65536,
+    max_samples: Optional[int] = None,
+) -> Tuple[List[np.ndarray], np.ndarray]:
+    """Read a Criteo-format dataset file; see module docstring.
+
+    ``vocab_sizes``: one int (shared) or a per-table sequence — categorical
+    ids are reduced mod the table's vocabulary (the reference preprocesses
+    ids into range offline; mod keeps arbitrary files loadable).
+    Returns ``(xs, y)`` ready for ``FFModel.fit``.
+    """
+    lower = path.lower()
+    # slice BEFORE materializing: a real Criteo day file is tens of GB,
+    # and h5py/npz both support partial reads
+    sl = slice(None) if max_samples is None else slice(max_samples)
+    if lower.endswith((".h5", ".hdf5")):
+        import h5py  # present in this image; gate the import anyway
+
+        with h5py.File(path, "r") as f:
+            return _from_arrays(
+                np.asarray(f["X_int"][sl]),
+                np.asarray(f["X_cat"][sl]),
+                np.asarray(f["y"][sl]),
+                vocab_sizes,
+                log_transform=False,  # preprocess_hdf already transformed
+                max_samples=None,
+            )
+    if lower.endswith(".npz"):
+        with np.load(path) as f:
+            return _from_arrays(
+                f["X_int"][sl], f["X_cat"][sl], f["y"][sl], vocab_sizes,
+                log_transform=True, max_samples=None,
+            )
+    if lower.endswith((".tsv", ".txt", ".tsv.gz", ".txt.gz")):
+        return _load_tsv(path, vocab_sizes, max_samples)
+    raise ValueError(
+        f"unrecognized Criteo dataset extension: {path!r} "
+        f"(expected .h5/.hdf5, .npz, or .tsv/.txt[.gz])"
+    )

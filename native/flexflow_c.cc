@@ -182,27 +182,18 @@ ff_handle* flexflow_config_create(int argc, char** argv) {
   if (!mod) return nullptr;
   PyObject* cfg = PyObject_CallMethod(mod, "FFConfig", nullptr);
   if (!cfg) return wrap(nullptr);
-  if (argc > 0) {
-    PyObject* args = PyList_New(argc);
-    for (int i = 0; i < argc; ++i) {
-      PyObject* s = PyUnicode_DecodeFSDefault(argv[i]);
-      if (!s) {
-        capture_py_error();
-        Py_DECREF(args);
-        Py_DECREF(cfg);
-        return wrap(nullptr);
-      }
-      PyList_SET_ITEM(args, i, s);
+  ff_handle* h = wrap(cfg);
+  if (h != nullptr && argc > 0) {
+    // delegate to the one decode+parse implementation; the caller's argv
+    // is left untouched (scratch copy absorbs the compaction)
+    std::vector<char*> scratch(argv, argv + argc);
+    int n = argc;
+    if (flexflow_config_parse_args(h, &n, scratch.data()) != 0) {
+      flexflow_handle_destroy(h);
+      return nullptr;
     }
-    PyObject* rest = PyObject_CallMethod(cfg, "parse_args", "O", args);
-    Py_DECREF(args);
-    if (!rest) {
-      Py_DECREF(cfg);
-      return wrap(nullptr);
-    }
-    Py_DECREF(rest);
   }
-  return wrap(cfg);
+  return h;
 }
 
 int flexflow_config_set_batch_size(ff_handle* cfg, int bs) {
@@ -1696,18 +1687,32 @@ int flexflow_config_parse_args(ff_handle* cfg, int* argc, char** argv) {
     return -1;
   }
   // keep only argv entries surviving in `rest`, in order (two-pointer
-  // walk; parse_args preserves the relative order of unconsumed args)
+  // walk; parse_args preserves the relative order of unconsumed args).
+  // Compare at the BYTE level via FSDefault re-encoding: AsUTF8 fails on
+  // surrogateescape-decoded non-UTF-8 args, which would silently drop
+  // the arg and leave a pending exception.
   Py_ssize_t nrest = PySequence_Length(rest);
   int w = 0;
   Py_ssize_t r = 0;
   for (int i = 0; i < *argc && r < nrest; ++i) {
     PyObject* s = PySequence_GetItem(rest, r);
-    const char* sv = s ? PyUnicode_AsUTF8(s) : nullptr;
-    if (sv && std::strcmp(argv[i], sv) == 0) {
+    PyObject* enc = s ? PyUnicode_EncodeFSDefault(s) : nullptr;
+    Py_XDECREF(s);
+    if (!enc) {
+      capture_py_error();
+      Py_DECREF(rest);
+      return -1;
+    }
+    char* bytes = nullptr;
+    Py_ssize_t blen = 0;
+    if (PyBytes_AsStringAndSize(enc, &bytes, &blen) == 0 &&
+        std::strlen(argv[i]) == (size_t)blen &&
+        std::memcmp(argv[i], bytes, blen) == 0) {
       argv[w++] = argv[i];
       ++r;
     }
-    Py_XDECREF(s);
+    PyErr_Clear();
+    Py_DECREF(enc);
   }
   *argc = w;
   Py_DECREF(rest);
@@ -1772,6 +1777,7 @@ int flexflow_config_get_enable_control_replication(ff_handle* cfg) {
   (void)cfg;
   return 1;
 }
+
 
 // Reference: flexflow_constant_create — a constant (non-trainable) tensor
 // (src/runtime/model.cc create_constant).  Graph form: a Weight source op

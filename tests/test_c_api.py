@@ -10,6 +10,8 @@ import os
 import subprocess
 import sys
 
+import numpy as np
+
 import pytest
 
 from flexflow_tpu.runtime.capi import build_capi
@@ -145,3 +147,64 @@ def test_c_api_tail_driver(libflexflow_c, tmp_path_factory):
     )
     assert r.returncode == 0, f"rc={r.returncode}\nstdout:{r.stdout}\nstderr:{r.stderr}"
     assert "api tail ok" in r.stdout
+
+
+def _write_idx(tmp, x, y):
+    """Write MNIST idx-format files (big-endian headers + ubyte data)."""
+    import struct
+
+    n, d = x.shape
+    side = int(d ** 0.5)
+    assert side * side == d
+    imgs = tmp / "images-idx3-ubyte"
+    with open(imgs, "wb") as f:
+        f.write(struct.pack(">IIII", 0x803, n, side, side))
+        f.write((x * 255).clip(0, 255).astype(np.uint8).tobytes())
+    labs = tmp / "labels-idx1-ubyte"
+    with open(labs, "wb") as f:
+        f.write(struct.pack(">II", 0x801, n))
+        f.write(y.astype(np.uint8).tobytes())
+    return str(imgs), str(labs)
+
+
+def test_c_driver_trains_from_idx_files(libflexflow_c, tmp_path_factory):
+    """Real-data ingest in C (VERDICT r4 #7): examples/c/mnist_idx.c
+    parses MNIST idx-format files from disk and trains through the C API
+    (exit 1 on malformed files, 3 below 0.5 accuracy)."""
+    tmp = tmp_path_factory.mktemp("capi_idx")
+    rng = np.random.default_rng(0)
+    n, side, classes = 512, 8, 10
+    y = rng.integers(0, classes, n)
+    centers = rng.normal(0.5, 0.2, size=(classes, side * side))
+    x = np.clip(centers[y] + rng.normal(0, 0.05, (n, side * side)), 0, 0.999)
+    imgs, labs = _write_idx(tmp, x, y)
+    exe = str(tmp / "mnist_idx_c")
+    _build_example("mnist_idx.c", os.path.dirname(libflexflow_c), exe)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [exe, imgs, labs, "-e", "4"], env=env, capture_output=True,
+        text=True, timeout=420,
+    )
+    assert r.returncode == 0, f"rc={r.returncode}\nstdout:{r.stdout}\nstderr:{r.stderr}"
+    assert "loaded 512 samples x 64 pixels" in r.stdout
+    acc = float(r.stdout.split("final accuracy:")[1].split()[0])
+    assert acc > 0.5, r.stdout
+    # malformed file -> clean error, not a crash
+    bad = tmp / "bad"
+    bad.write_bytes(b"\x00\x00\x00\x00garbage")
+    r2 = subprocess.run(
+        [exe, str(bad), labs], env=env, capture_output=True, text=True,
+        timeout=120,
+    )
+    assert r2.returncode == 1 and "bad idx3 header" in r2.stderr
+    # plausible magic but absurd dims -> clean error, not an OOM/segfault
+    import struct
+    huge = tmp / "huge"
+    huge.write_bytes(struct.pack(">IIII", 0x803, 0xFFFFFFFF, 0xFFFF, 0xFFFF))
+    r3 = subprocess.run(
+        [exe, str(huge), labs], env=env, capture_output=True, text=True,
+        timeout=120,
+    )
+    assert r3.returncode == 1 and "implausible idx3 dims" in r3.stderr

@@ -136,6 +136,10 @@ int main(int argc, char** argv) {
     return 1;
   }
   ff_handle* cfg = flexflow_config_create(0, NULL);
+  if (!cfg) {
+    fprintf(stderr, "config failed: %s\n", flexflow_last_error());
+    return 1;
+  }
   int rest_argc = argc - 3;
   if (rest_argc > 0 &&
       flexflow_config_parse_args(cfg, &rest_argc, argv + 3) != 0) {
@@ -144,6 +148,10 @@ int main(int argc, char** argv) {
   }
   flexflow_config_set_batch_size(cfg, 64);
   ff_handle* model = flexflow_model_create(cfg);
+  if (!model) {
+    fprintf(stderr, "model create failed: %s\n", flexflow_last_error());
+    return 1;
+  }
   int64_t dims[2] = {64, d};
   ff_handle* t = flexflow_model_create_tensor(model, 2, dims, 0, "pixels");
   if (t) t = flexflow_model_dense(model, t, 128, 1 /*relu*/);

@@ -87,6 +87,13 @@ def _load_tsv(path: str, vocab_sizes, max_samples):
             )
             if max_samples is not None and len(labels) >= max_samples:
                 break
+    if not labels:
+        raise ValueError(
+            f"no parseable Criteo rows in {path!r} — expected "
+            f"'label\\t13 ints\\t26 hex cats' per line "
+            f"({1 + CRITEO_NUM_DENSE + CRITEO_NUM_TABLES} tab-separated "
+            f"fields)"
+        )
     return _from_arrays(
         np.asarray(ints, np.float32),
         np.asarray(cats, np.int64),
@@ -110,8 +117,9 @@ def load_criteo(
     Returns ``(xs, y)`` ready for ``FFModel.fit``.
     """
     lower = path.lower()
-    # slice BEFORE materializing: a real Criteo day file is tens of GB,
-    # and h5py/npz both support partial reads
+    # h5py slices BEFORE materializing (a real Criteo day file is tens of
+    # GB); npz cannot — the zip member decompresses fully on access, so
+    # max_samples only trims the result there (use .h5 for day-scale data)
     sl = slice(None) if max_samples is None else slice(max_samples)
     if lower.endswith((".h5", ".hdf5")):
         import h5py  # present in this image; gate the import anyway

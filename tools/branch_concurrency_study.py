@@ -1,0 +1,198 @@
+"""SPMD-vs-branch-concurrency study (VERDICT r4 #8).
+
+The reference's DP splits ``MachineResource`` at nonsequence nodes so
+independent branches run CONCURRENTLY on disjoint GPU subsets
+(``src/runtime/graph.cc:267``, ``MachineView::start_device_id``).  This
+build deliberately runs every op SPMD over the full mesh
+(``flexflow_tpu/search/dp.py`` module docstring) — a TPU core executes
+one XLA computation at a time, so within the single jitted step the
+branches of an Inception block serialize (XLA may overlap *async
+collectives* with compute, but not two dense convs).
+
+This tool QUANTIFIES what that choice costs for Inception-v3 on 8
+devices using the event-sim machine model:
+
+  * SPMD: every op over all 8 devices; branch ops execute sequentially.
+    Per-device time for op i = t(op_i, degree=8) + h (h = per-op
+    dispatch/pipeline-fill overhead, the term that stops tiny Inception
+    convs from scaling to 8 chips).
+  * Branch-concurrent: each Inception block's branches are placed on
+    disjoint submeshes sized proportionally to branch FLOPs (greedy
+    integer split, every branch >= 1 device).  Branch i's time =
+    sum_j t(op_ij, degree=n_i) + h, all branches overlap; the block
+    costs max_i(...) plus a join all-gather (each submesh holds only
+    its branch's channels, and the consumer needs all of them — priced
+    with the machine model's all_gather over the full mesh).
+    Trunk (non-branch) ops still run at degree 8.
+
+With zero overhead the two are equal by work conservation
+(max_i W_i/n_i >= sum_i W_i/8, equality at the proportional split) —
+the interesting regime is h > 0, where SPMD pays h x (ops in ALL
+branches) serially but branch placement pays h x (ops in the LONGEST
+branch).  Against that win stands the join all-gather SPMD does not
+need.  Run:  python tools/branch_concurrency_study.py
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Tuple
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from flexflow_tpu import FFConfig, FFModel  # noqa: E402
+from flexflow_tpu.fftype import OperatorType  # noqa: E402
+from flexflow_tpu.models.cnn import inception_v3  # noqa: E402
+from flexflow_tpu.ops.base import get_op_def  # noqa: E402
+from flexflow_tpu.search.cost import (  # noqa: E402
+    TPUMachineModel,
+    _dtype_nbytes,
+    op_compute_time,
+)
+
+N_DEV = 8
+
+
+def _branch_components(layers) -> Tuple[Dict[int, int], List[List]]:
+    """Assign each layer to a branch group: for every CONCAT, walk each
+    input's single-consumer producer chain upward until a tensor consumed
+    by more than one layer (the fork point).  Returns (guid -> branch id,
+    list of branches as layer lists)."""
+    consumers: Dict[int, List] = {}
+    for l in layers:
+        for t in l.inputs:
+            consumers.setdefault(t.guid, []).append(l)
+    branch_of: Dict[int, int] = {}
+    branches: List[List] = []
+    for l in layers:
+        if l.op_type is not OperatorType.CONCAT or len(l.inputs) < 3:
+            continue
+        for t in l.inputs:
+            chain = []
+            cur = t
+            while (
+                cur.owner_layer is not None
+                and len(consumers.get(cur.guid, [])) == 1
+                and int(cur.owner_layer.layer_guid) not in branch_of
+            ):
+                chain.append(cur.owner_layer)
+                ins = cur.owner_layer.inputs
+                if len(ins) != 1:
+                    break
+                cur = ins[0]
+            if len(chain) >= 1:
+                bid = len(branches)
+                branches.append(chain)
+                for cl in chain:
+                    branch_of[int(cl.layer_guid)] = bid
+    return branch_of, branches
+
+
+def _join_groups(layers, branch_of, branches):
+    """Group branches by their consuming concat (one Inception block's
+    branch set overlaps in time; different blocks are sequential)."""
+    groups: Dict[int, List[int]] = {}
+    for l in layers:
+        if l.op_type is not OperatorType.CONCAT:
+            continue
+        bids = set()
+        for t in l.inputs:
+            if t.owner_layer is not None:
+                b = branch_of.get(int(t.owner_layer.layer_guid))
+                if b is not None:
+                    bids.add(b)
+        if len(bids) >= 2:
+            groups[int(l.layer_guid)] = sorted(bids)
+    return groups
+
+
+def study(batch: int = 64, overhead_us: float = 2.0) -> Dict[str, float]:
+    cfg = FFConfig(batch_size=batch)
+    model = FFModel(cfg)
+    inception_v3(model, batch)
+    layers = [l for l in model.layers if not l.op_type.is_parallel_op]
+    machine = TPUMachineModel.for_chip("TPU v5 lite")
+    h = overhead_us * 1e-6
+
+    branch_of, branches = _branch_components(layers)
+    groups = _join_groups(layers, branch_of, branches)
+    grouped_bids = {b for bids in groups.values() for b in bids}
+
+    def t_op(layer, degree):
+        return op_compute_time(layer, degree, machine) + h
+
+    # ---- SPMD baseline: all ops sequential at degree 8
+    spmd = sum(t_op(l, N_DEV) for l in layers)
+
+    # ---- branch-concurrent: per concat group, split devices by FLOPs
+    concurrent = 0.0
+    for l in layers:
+        bid = branch_of.get(int(l.layer_guid))
+        if bid is None or bid not in grouped_bids:
+            if l.op_type is OperatorType.CONCAT and int(l.layer_guid) in groups:
+                # the join: overlapped branch work + the gather SPMD skips
+                bids = groups[int(l.layer_guid)]
+                # allocate by degree-1 TIME, not FLOPs: Inception's
+                # pool+1x1 branches are memory-bound (big activations,
+                # tiny FLOPs) and a FLOPs split starves them
+                works = [
+                    sum(op_compute_time(c, 1, machine) for c in branches[b])
+                    for b in bids
+                ]
+                total_w = sum(works) or 1.0
+                # proportional integer split, >= 1 device each
+                alloc = [max(1, int(N_DEV * w / total_w)) for w in works]
+                while sum(alloc) > N_DEV:
+                    alloc[alloc.index(max(alloc))] -= 1
+                while sum(alloc) < N_DEV:
+                    # give spare devices to the heaviest per-device branch
+                    per_dev = [w / a for w, a in zip(works, alloc)]
+                    alloc[per_dev.index(max(per_dev))] += 1
+                concurrent += max(
+                    sum(t_op(c, a) for c in branches[b])
+                    for b, a in zip(bids, alloc)
+                )
+                # join redistribution: branch i's output is batch-sharded
+                # over its OWN n_i devices; the next block needs every
+                # device to hold batch/8 of ALL channels — an all-to-all
+                # whose per-device send volume is ~one shard of the
+                # concat output (SPMD needs no such transfer)
+                out_bytes = 1
+                for s in l.outputs[0].shape:
+                    out_bytes *= s
+                out_bytes *= _dtype_nbytes(l.outputs[0].dtype)
+                concurrent += machine.all_to_all(
+                    out_bytes / N_DEV, N_DEV
+                ) + t_op(l, N_DEV)
+            continue
+        # branch members are charged inside their group's max() above;
+        # ungrouped ops fall through to the trunk term below
+    for l in layers:
+        bid = branch_of.get(int(l.layer_guid))
+        if (bid is None or bid not in grouped_bids) and not (
+            l.op_type is OperatorType.CONCAT and int(l.layer_guid) in groups
+        ):
+            concurrent += t_op(l, N_DEV)
+
+    return {
+        "batch": batch,
+        "overhead_us": overhead_us,
+        "n_ops": len(layers),
+        "n_branch_groups": len(groups),
+        "spmd_s": spmd,
+        "branch_concurrent_s": concurrent,
+        "gap_pct": 100.0 * (spmd - concurrent) / spmd,
+    }
+
+
+if __name__ == "__main__":
+    print(f"{'batch':>6} {'overhead':>9} {'SPMD ms':>9} {'branch ms':>10} {'gap %':>7}")
+    for batch in (8, 64, 256):
+        for ov in (0.0, 1.0, 2.0, 5.0):
+            r = study(batch, ov)
+            print(
+                f"{r['batch']:>6} {r['overhead_us']:>7.1f}us "
+                f"{r['spmd_s'] * 1e3:>9.3f} "
+                f"{r['branch_concurrent_s'] * 1e3:>10.3f} "
+                f"{r['gap_pct']:>6.1f}%"
+            )

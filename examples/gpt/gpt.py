@@ -78,7 +78,17 @@ def main() -> int:
     prompt = ((np.arange(4)[None, :] * 3) % vocab).repeat(batch, axis=0)
     out = gpt_generate(model, prompt.astype(np.int32), max_new_tokens=8)
     print(f"prompt {prompt[0].tolist()} -> generated {out[0, 4:].tolist()}")
-    return 0 if ok else 1
+
+    # KV-cache decode (beyond the reference): O(S_max) per step instead
+    # of a full-prefix forward — must produce the same greedy tokens
+    from flexflow_tpu.models.gpt_decode import gpt_generate_cached
+
+    out_c, _ = gpt_generate_cached(
+        model, prompt.astype(np.int32), max_new_tokens=8
+    )
+    match = bool((out_c == out).all())
+    print(f"kv-cache decode matches full-prefix path: {match}")
+    return 0 if (ok and match) else 1
 
 
 if __name__ == "__main__":

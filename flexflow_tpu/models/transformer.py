@@ -155,6 +155,25 @@ GPT2_SMALL = dict(hidden=768, heads=12, ff_dim=3072, num_layers=12)
 GPT2_MEDIUM = dict(hidden=1024, heads=16, ff_dim=4096, num_layers=24)
 
 
+def sample_next(probs, temperature: float, rng):
+    """Next-token selection shared by :func:`gpt_generate` and the
+    KV-cache path (``models.gpt_decode``): greedy at temperature 0, else
+    temperature-scaled softmax sampling."""
+    import numpy as np
+
+    if temperature <= 0.0:
+        return probs.argmax(-1).astype(np.int32)
+    # float64 throughout: rng.choice re-checks sum(p) == 1 at ~1e-8
+    # tolerance, which float32 normalization misses
+    logp = np.log(np.maximum(probs.astype(np.float64), 1e-30)) / temperature
+    z = np.exp(logp - logp.max(-1, keepdims=True))
+    z /= z.sum(-1, keepdims=True)
+    return np.array(
+        [rng.choice(z.shape[-1], p=z[b]) for b in range(z.shape[0])],
+        np.int32,
+    )
+
+
 def gpt_generate(
     model,
     prompt_ids,
@@ -191,21 +210,7 @@ def gpt_generate(
     rng = np.random.default_rng(seed)
     for t in range(start, end):
         probs = np.asarray(model.eval_batch([cur]))
-        probs = probs.reshape(batch, seq, -1)[:, t - 1]
-        if temperature <= 0.0:
-            nxt = probs.argmax(-1)
-        else:
-            # float64 throughout: rng.choice re-checks sum(p) == 1 at
-            # ~1e-8 tolerance, which float32 normalization misses
-            logp = (
-                np.log(np.maximum(probs.astype(np.float64), 1e-30))
-                / temperature
-            )
-            z = np.exp(logp - logp.max(-1, keepdims=True))
-            z /= z.sum(-1, keepdims=True)
-            nxt = np.array(
-                [rng.choice(z.shape[-1], p=z[b]) for b in range(batch)],
-                np.int32,
-            )
-        cur[:, t] = nxt
+        cur[:, t] = sample_next(
+            probs.reshape(batch, seq, -1)[:, t - 1], temperature, rng
+        )
     return cur[:, :end]

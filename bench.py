@@ -233,11 +233,78 @@ def _bench_bert_large(on_tpu: bool) -> dict:
     return out
 
 
+def _bench_gpt_decode(on_tpu: bool) -> dict:
+    """KV-cache decode vs the reference-style full-prefix path (round-5
+    verdict #9): tokens/s for each, at a prefix long enough that the
+    full-prefix forward's O(S^2) re-computation shows."""
+    import time
+
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.gpt_decode import GPTDecodeSession
+    from flexflow_tpu.models.transformer import gpt_decoder
+
+    batch = 8 if on_tpu else 2
+    seq = 512 if on_tpu else 64
+    shape = (
+        dict(hidden=768, heads=12, ff_dim=3072, num_layers=12)
+        if on_tpu
+        else dict(hidden=64, heads=4, ff_dim=128, num_layers=2)
+    )
+    vocab = 32000 if on_tpu else 256
+    cfg = FFConfig(
+        batch_size=batch,
+        compute_dtype="bfloat16" if on_tpu else "float32",
+    )
+    model = FFModel(cfg)
+    gpt_decoder(model, batch, seq, vocab=vocab, **shape)
+    model.compile(seed=0)
+    rng = np.random.default_rng(0)
+    prompt_len = seq // 2
+    toks = rng.integers(0, vocab, size=(batch, seq)).astype(np.int32)
+
+    sess = GPTDecodeSession(model)  # warms up / compiles the step
+    n_steps = 32 if on_tpu else 8
+    # cached decode: steps at positions prompt_len..prompt_len+n
+    for t in range(3):  # extra warmup at the measured positions
+        sess.step(toks[:, t], t)
+    sess.reset()
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        p = sess.step(toks[:, prompt_len + i], prompt_len + i)
+    float(np.asarray(p)[0, 0])  # value-force (tunnel acks before exec)
+    cached_s = (time.perf_counter() - t0) / n_steps
+
+    # full-prefix path: one masked forward per token (what gpt_generate
+    # does); same positions
+    cur = toks.copy()
+    _ = model.eval_batch([cur])  # compile
+    t0 = time.perf_counter()
+    reps = max(2, n_steps // 8)
+    for _i in range(reps):
+        out = model.eval_batch([cur])
+    float(np.asarray(out).ravel()[0])
+    full_s = (time.perf_counter() - t0) / reps
+
+    return {
+        "config": f"{'GPT2-small' if on_tpu else 'tiny'} b={batch} s={seq} "
+                  f"prefix={prompt_len}",
+        "cached_tok_per_s": round(batch / cached_s, 2),
+        "full_prefix_tok_per_s": round(batch / full_s, 2),
+        "speedup": round(full_s / cached_s, 2),
+    }
+
+
 def _bench_secondary(on_tpu: bool) -> dict:
     """The BASELINE.json north-star secondary configs; each failure is
     contained so it can never sink the headline metric."""
     out = {}
-    for name, fn in (("dlrm", _bench_dlrm), ("bert_large", _bench_bert_large)):
+    for name, fn in (
+        ("dlrm", _bench_dlrm),
+        ("bert_large", _bench_bert_large),
+        ("gpt_decode", _bench_gpt_decode),
+    ):
         try:
             out[name] = fn(on_tpu)
         except Exception as e:  # noqa: BLE001

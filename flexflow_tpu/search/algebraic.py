@@ -264,58 +264,60 @@ class BatchSiblings(StructXfer):
         )
 
     def find_matches(self, layers):
+        """One match per sibling GROUP (all same-hyperparameter consumers
+        of one tensor, size >= 2) — N siblings batch in a single step
+        (e.g. Q/K/V in one rewrite), avoiding the nested split chains a
+        pairwise rule would build."""
         groups: Dict[Tuple, List[Layer]] = {}
         for l in layers:
             if l.op_type is self.op and l.inputs:
                 k = self._group_key(l)
                 if k is not None:
                     groups.setdefault(k, []).append(l)
-        return [
-            (a, b) for g in groups.values() for a, b in zip(g, g[1:])
-        ]
+        return [tuple(g) for g in groups.values() if len(g) >= 2]
 
     def build(self, match):
-        l1, l2 = match
-        x = l1.inputs[0]
-        a1, a2 = l1.attrs, l2.attrs
-        base = f"batched({l1.name}+{l2.name})"
+        x = match[0].inputs[0]
+        a1 = match[0].attrs
+        base = "batched(" + "+".join(l.name for l in match) + ")"
         if self.op is OperatorType.LINEAR:
-            d1, d2 = a1["out_dim"], a2["out_dim"]
+            dims = [l.attrs["out_dim"] for l in match]
             big = build_layer(
                 OperatorType.LINEAR, base, [x],
-                dict(a1, out_dim=d1 + d2),
+                dict(a1, out_dim=sum(dims)),
             )
             axis, waxis = x.ndim - 1, 1
         else:
-            d1, d2 = a1["out_channels"], a2["out_channels"]
+            dims = [l.attrs["out_channels"] for l in match]
             big = build_layer(
                 OperatorType.CONV2D, base, [x],
-                dict(a1, out_channels=d1 + d2),
+                dict(a1, out_channels=sum(dims)),
             )
             axis, waxis = 1, 3
         sp = build_layer(
             OperatorType.SPLIT, base + ".split", [big.outputs[0]],
-            dict(axis=axis, sizes=(d1, d2)),
+            dict(axis=axis, sizes=tuple(dims)),
         )
         use_bias = a1.get("use_bias", True)
+        names = [l.name for l in match]
 
-        def wmap(w, _n1=l1.name, _n2=l2.name, _base=base, _wx=waxis):
+        def wmap(w, _ns=names, _base=base, _wx=waxis):
             out = {
                 "kernel": np.concatenate(
-                    [w[_n1]["kernel"], w[_n2]["kernel"]], axis=_wx
+                    [w[n]["kernel"] for n in _ns], axis=_wx
                 )
             }
             if use_bias:
                 out["bias"] = np.concatenate(
-                    [w[_n1]["bias"], w[_n2]["bias"]], axis=0
+                    [w[n]["bias"] for n in _ns], axis=0
                 )
             return {_base: out}
 
         return Rewrite(
             new_layers=[big, sp],
             tensor_map={
-                l1.outputs[0].guid: sp.outputs[0],
-                l2.outputs[0].guid: sp.outputs[1],
+                l.outputs[0].guid: sp.outputs[i]
+                for i, l in enumerate(match)
             },
             weight_map=wmap,
         )
@@ -506,6 +508,59 @@ class FuseExperts(StructXfer):
         )
 
 
+class ComposeLinears(StructXfer):
+    """linear(linear(x)) with no inner activation composes into ONE
+    linear with kernel W1·W2 — TASO's matmul-composition class.  Wins
+    when the middle dim exceeds in·out/(in+out) (the cost model decides).
+    Inference-only: the composed kernel has rank <= min(in, mid, out),
+    so training it is a DIFFERENT hypothesis class than training the
+    factored pair."""
+
+    name = "compose_consecutive_linears"
+    inference_only = True
+
+    def find_matches(self, layers):
+        cons = _consumers(layers)
+        out = []
+        for l in layers:
+            if l.op_type is not OperatorType.LINEAR:
+                continue
+            if l.attrs.get("activation", ActiMode.NONE) is not ActiMode.NONE:
+                continue
+            cs = cons.get(l.outputs[0].guid, [])
+            if len(cs) == 1 and cs[0].op_type is OperatorType.LINEAR:
+                out.append((l, cs[0]))
+        return out
+
+    def build(self, match):
+        l1, l2 = match
+        nl = build_layer(
+            OperatorType.LINEAR, f"composed({l1.name}*{l2.name})",
+            l1.inputs, dict(l2.attrs, use_bias=True),
+        )
+        b1 = l1.attrs.get("use_bias", True)
+        b2 = l2.attrs.get("use_bias", True)
+
+        def wmap(w, _n1=l1.name, _n2=l2.name, _n=nl.name):
+            src_dtype = np.asarray(w[_n1]["kernel"]).dtype
+            k1 = np.asarray(w[_n1]["kernel"], np.float32)
+            k2 = np.asarray(w[_n2]["kernel"], np.float32)
+            bias = np.zeros(k2.shape[1], np.float32)
+            if b1:
+                bias = np.asarray(w[_n1]["bias"], np.float32) @ k2
+            if b2:
+                bias = bias + np.asarray(w[_n2]["bias"], np.float32)
+            # compose in f32 for accuracy, store at the source dtype
+            return {_n: {"kernel": (k1 @ k2).astype(src_dtype),
+                         "bias": bias.astype(src_dtype)}}
+
+        return Rewrite(
+            new_layers=[nl],
+            tensor_map={l2.outputs[0].guid: nl.outputs[0]},
+            weight_map=wmap,
+        )
+
+
 class FuseBiasAdd(StructXfer):
     """Linear(use_bias=False) + ew_add(weight) becomes
     Linear(use_bias=True) — TASO's bias-add absorption."""
@@ -688,6 +743,7 @@ STRUCT_BUILDERS: Dict[str, Callable[..., StructXfer]] = {
         OperatorType(op), OperatorType(act)
     ),
     "fold_bn_conv": FoldBNConv,
+    "compose_linears": ComposeLinears,
     "fuse_experts": FuseExperts,
     "fuse_bias_add": FuseBiasAdd,
     "cancel_transposes": CancelTransposes,
@@ -722,6 +778,7 @@ def default_struct_xfers(inference: bool = False) -> List[StructXfer]:
     ]
     if inference:
         xs.append(FoldBNConv())
+        xs.append(ComposeLinears())
     return xs
 
 

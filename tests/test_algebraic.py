@@ -399,3 +399,50 @@ def test_structural_json_rejects_bad_params():
             '{"rules": [{"name": "x", "type": "structural", '
             '"builder": "batch_siblings", "params": {"op": "softmax"}}]}'
         )
+
+
+def test_batch_three_siblings_single_rewrite():
+    """Q/K/V-style: THREE same-shape siblings batch in ONE rewrite into a
+    single GEMM + 3-way split (no nested split chains)."""
+
+    def build(m):
+        x = m.create_tensor((16, 32))
+        q = m.dense(x, 24, name="q")
+        k = m.dense(x, 24, name="k")
+        v = m.dense(x, 24, name="v")
+        s = m.add(m.add(q, k), v)
+        m.dense(s, 8, name="head")
+
+    x = np.random.default_rng(10).normal(size=(16, 32)).astype(np.float32)
+    m2 = _parity(build, "batch_sibling_linears", x)
+    assert sum(l.op_type is OperatorType.LINEAR for l in m2.layers) == 2
+    sp = next(l for l in m2.layers if l.op_type is OperatorType.SPLIT)
+    assert tuple(sp.attrs["sizes"]) == (24, 24, 24)
+
+
+def test_compose_consecutive_linears_parity():
+    """Inference-only matmul composition: kernel W1@W2, bias b1@W2+b2."""
+
+    def build(m):
+        x = m.create_tensor((16, 32))
+        a = m.dense(x, 48, name="a")  # no activation
+        b = m.dense(a, 24, name="b")
+        m.dense(b, 8, name="head")
+
+    x = np.random.default_rng(11).normal(size=(16, 32)).astype(np.float32)
+    m2 = _parity(build, "compose_consecutive_linears", x, atol=1e-4)
+    names = [l.name for l in m2.layers]
+    assert any(n.startswith("composed(") for n in names), names
+
+
+def test_compose_linears_not_matched_for_training():
+    m = _mk()
+    x = m.create_tensor((16, 32))
+    a = m.dense(x, 48, name="a")
+    m.dense(a, 24, name="b")
+    rws = enumerate_rewrites(
+        m.layers, default_struct_xfers(inference=False), inference=False
+    )
+    assert not any(
+        r.xfer.name == "compose_consecutive_linears" for r in rws
+    )

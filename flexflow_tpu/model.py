@@ -53,6 +53,25 @@ from flexflow_tpu.runtime.recompile import RecompileState
 from flexflow_tpu.tensor import Layer, Tensor
 
 
+def _load_substitution_xfers(cfg: FFConfig):
+    """Resolve --substitution-json ('default' = the bundled rule set) and
+    load its mixed GraphXfer/StructXfer list; None when the flag is
+    unset.  The ONE resolution used by both compile's search branch and
+    its import-replay branch."""
+    if not cfg.substitution_json_file:
+        return None
+    import os as _os
+
+    from flexflow_tpu.search.substitution import load_xfers_from_json
+
+    rules_path = cfg.substitution_json_file
+    if rules_path == "default":
+        rules_path = _os.path.join(
+            _os.path.dirname(__file__), "search", "substitutions.json"
+        )
+    return load_xfers_from_json(rules_path)
+
+
 class FFModel:
     def __init__(self, config: Optional[FFConfig] = None) -> None:
         self.config = config or FFConfig()
@@ -671,24 +690,26 @@ class FFModel:
             if cfg.import_strategy_file:
                 with open(cfg.import_strategy_file) as f:
                     strategy = Strategy.from_json(f.read())
+                # replay any recorded structural rewrites and re-key the
+                # assignments by layer NAME (guids are process-local) —
+                # sets rewritten_layers/output_remap so the adoption
+                # branch below applies them like a fresh search would
+                from flexflow_tpu.search.algebraic import (
+                    StructXfer,
+                    default_struct_xfers,
+                )
+
+                rules = list(default_struct_xfers(inference=True)) + [
+                    x
+                    for x in (_load_substitution_xfers(cfg) or ())
+                    if isinstance(x, StructXfer)
+                ]
+                strategy.rebind(self.layers, rules)
             elif cfg.search_budget > 0 and not cfg.only_data_parallel:
                 from flexflow_tpu.search import unity_search
                 from flexflow_tpu.search.candidates import SearchOptions
 
-                extra_xfers = None
-                if cfg.substitution_json_file:
-                    import os as _os
-
-                    from flexflow_tpu.search.substitution import (
-                        load_xfers_from_json,
-                    )
-
-                    rules_path = cfg.substitution_json_file
-                    if rules_path == "default":
-                        rules_path = _os.path.join(
-                            _os.path.dirname(__file__), "search", "substitutions.json"
-                        )
-                    extra_xfers = load_xfers_from_json(rules_path)
+                extra_xfers = _load_substitution_xfers(cfg)
 
                 strategy = unity_search(
                     self.layers,
@@ -756,7 +777,10 @@ class FFModel:
         Called on process 0 only."""
         if cfg.export_strategy_file:
             with open(cfg.export_strategy_file, "w") as f:
-                f.write(strategy.to_json())
+                # self.layers is the (possibly rewritten) list the
+                # assignments refer to; per-op names make the export
+                # importable across processes (Strategy.rebind)
+                f.write(strategy.to_json(layers=self.layers))
         if cfg.export_strategy_computation_graph_file:
             from flexflow_tpu.utils import export_dot
 
@@ -873,6 +897,9 @@ class FFModel:
         new_st.rewritten_layers = res.layers
         new_st.output_remap = res.remap
         new_st.applied_rewrites = st.applied_rewrites + res.applied
+        # keep the replay detail: an export after optimize_for_inference
+        # must stay importable (Strategy.rebind)
+        new_st.applied_detail = st.applied_detail + res.applied_detail
         self._compile_call["strategy"] = new_st
         self._compile_call["mesh"] = st.mesh
         self.compile(**self._compile_call)

@@ -187,13 +187,17 @@ class Strategy:
         self.ops: Dict[int, OpSharding] = {}  # layer_guid -> OpSharding
         # set by unity_search when the joint search applied algebraic
         # graph rewrites (search.algebraic): the rewritten layer list the
-        # assignments refer to, the old-guid -> Tensor output remap, and
-        # the applied rule names (recorded in to_json for transparency —
-        # a rewritten strategy cannot be re-imported against the
-        # pre-rewrite graph)
+        # assignments refer to, the old-guid -> Tensor output remap, the
+        # applied rule names, and per-rewrite (rule, matched layer names)
+        # detail — to_json records the detail so --import-strategy can
+        # REPLAY the rewrite sequence on a freshly built graph (rebind)
         self.rewritten_layers: Optional[List[Layer]] = None
         self.output_remap: Dict = {}
         self.applied_rewrites: Tuple[str, ...] = ()
+        self.applied_detail: Tuple = ()
+        # populated by from_json: exported per-op layer names (guid ->
+        # name at export time), consumed by rebind()
+        self._op_names: Dict[int, str] = {}
 
     def op_sharding(self, layer: Layer) -> Optional[OpSharding]:
         return self.ops.get(int(layer.layer_guid))
@@ -213,17 +217,30 @@ class Strategy:
         return s.weights[wname].partition_spec()
 
     # --- serialization (--export-strategy parity) -------------------------
-    def to_json(self) -> str:
+    def to_json(self, layers: Optional[List[Layer]] = None) -> str:
+        """``layers`` (the list the assignments refer to — the REWRITTEN
+        list when rewrites were applied) adds a per-op ``name`` field, the
+        process-stable identity :meth:`rebind` uses; guids are only
+        reproducible when the importing process builds the graph in the
+        exact same global order."""
+
         def enc_ts(ts: TensorSharding):
             return {"spec": [list(ts.axes_of(i)) for i in range(len(ts.spec))],
                     "partial": list(ts.partial_axes)}
 
+        names: Dict[int, str] = {}
+        if layers is not None:
+            names = {int(l.layer_guid): l.name for l in layers}
         return json.dumps(
             {
                 "mesh": {"shape": list(self.mesh.shape), "axes": list(self.mesh.axis_names)},
-                "structural_rewrites": list(self.applied_rewrites),
+                "structural_rewrites": [
+                    {"rule": r, "layers": list(ls)}
+                    for r, ls in self.applied_detail
+                ] or list(self.applied_rewrites),
                 "ops": {
                     str(guid): {
+                        **({"name": names[guid]} if guid in names else {}),
                         "output": [enc_ts(t) for t in s.output],
                         "weights": {k: enc_ts(v) for k, v in s.weights.items()},
                         "inputs": [None if t is None else enc_ts(t) for t in s.inputs],
@@ -241,16 +258,22 @@ class Strategy:
         d = json.loads(text)
         mesh = MachineMesh(tuple(d["mesh"]["shape"]), tuple(d["mesh"]["axes"]))
         st = Strategy(mesh)
-        if d.get("structural_rewrites"):
+        rw = d.get("structural_rewrites") or []
+        if rw and isinstance(rw[0], dict):
+            st.applied_detail = tuple(
+                (e["rule"], tuple(e["layers"])) for e in rw
+            )
+            st.applied_rewrites = tuple(e["rule"] for e in rw)
+        elif rw:  # legacy names-only export: cannot replay
+            st.applied_rewrites = tuple(rw)
             import logging
 
             logging.getLogger("flexflow_tpu").warning(
-                "imported strategy was searched WITH structural rewrites %s; "
-                "its op guids refer to the rewritten graph and will not "
-                "match a freshly built model — re-search instead of "
-                "importing, or export from a search run with graph "
-                "rewrites disabled",
-                d["structural_rewrites"],
+                "imported strategy was searched WITH structural rewrites "
+                "%s but records no match detail (legacy export) — its op "
+                "guids refer to the rewritten graph and cannot rebind; "
+                "re-search instead of importing",
+                rw,
             )
 
         def dec_ts(e) -> TensorSharding:
@@ -268,7 +291,83 @@ class Strategy:
                 extras=dict(s.get("extras", {})),
                 stage=int(s.get("stage", 0)),
             )
+            if "name" in s:
+                st._op_names[int(guid)] = s["name"]
         return st
+
+    def rebind(self, layers: List[Layer], struct_xfers=()) -> None:
+        """Attach an imported strategy to a freshly built graph.
+
+        Replays the recorded structural-rewrite sequence (matching each
+        rule by the RECORDED layer names — deterministic, since rewrites
+        name their products from their inputs) and re-keys ``ops`` by the
+        exported per-op names.  After this, ``rewritten_layers`` /
+        ``output_remap`` are set exactly as a fresh search would set them,
+        so ``FFModel.compile`` adopts the graph through its normal path.
+        No-op when the export carried no rewrites and every name (or
+        guid) already matches."""
+        from flexflow_tpu.search.algebraic import apply_rewrite
+        from flexflow_tpu.search.substitution import _compose_remap
+
+        cur = list(layers)
+        remap: Dict = {}
+        if self.applied_detail:
+            by_name = {x.name: x for x in struct_xfers}
+            for rule, lnames in self.applied_detail:
+                x = by_name.get(rule)
+                if x is None:
+                    raise ValueError(
+                        f"imported strategy applied rule {rule!r} which is "
+                        f"not in the active rule set — pass the same "
+                        f"--substitution-json used at export"
+                    )
+                match = next(
+                    (
+                        m for m in x.find_matches(cur)
+                        if tuple(l.name for l in m) == tuple(lnames)
+                    ),
+                    None,
+                )
+                if match is None:
+                    raise ValueError(
+                        f"imported strategy applied {rule!r} to layers "
+                        f"{list(lnames)}, which do not form a match in "
+                        f"this graph — the model differs from the one "
+                        f"exported"
+                    )
+                rw = x.build(match)
+                res = rw and apply_rewrite(cur, match, rw)
+                if not res:
+                    raise ValueError(
+                        f"replaying {rule!r} on {list(lnames)} is illegal "
+                        f"in this graph"
+                    )
+                cur, _, tmap = res
+                remap = _compose_remap(remap, tmap)
+            self.rewritten_layers = cur
+            self.output_remap = remap
+        # re-key ops: exported names -> this process's guids.  A recorded
+        # name absent from this graph is a model mismatch — erroring here
+        # beats silently binding to whatever layer happens to carry the
+        # stale export-time guid (guids are a process-local counter, so a
+        # collision is likely, not rare)
+        if self._op_names:
+            by_layer_name = {l.name: int(l.layer_guid) for l in cur}
+            new_ops: Dict[int, OpSharding] = {}
+            for guid, s in self.ops.items():
+                name = self._op_names.get(guid)
+                if name is None:
+                    new_ops[guid] = s  # pre-name export entry: keep guid
+                    continue
+                tgt = by_layer_name.get(name)
+                if tgt is None:
+                    raise ValueError(
+                        f"imported strategy assigns layer {name!r}, which "
+                        f"does not exist in this graph — the model "
+                        f"differs from the one exported"
+                    )
+                new_ops[tgt] = s
+            self.ops = new_ops
 
 
 def data_parallel_strategy(layers: List[Layer], mesh: MachineMesh) -> Strategy:

@@ -167,6 +167,7 @@ def _unity_search_impl(
                 st.rewritten_layers = res.layers
                 st.output_remap = res.remap
                 st.applied_rewrites = tuple(res.applied)
+                st.applied_detail = tuple(res.applied_detail)
             best = st
     assert best is not None, "no feasible mesh factorization"
     if profiler is not None:

@@ -344,6 +344,10 @@ class JointResult:
     # rewrite's tensor_map); callers chase their output handles through it
     remap: Dict
     applied: Tuple[str, ...] = ()
+    # per applied rewrite, (rule name, matched layer names) in application
+    # order — recorded in the exported strategy so --import-strategy can
+    # deterministically REPLAY the rewrite sequence on a fresh graph
+    applied_detail: Tuple = ()
     # per applied rewrite, its weight_map (None for weight-free rules), in
     # application order — lets FFModel.optimize_for_inference transport
     # trained weights across the winning rewrite sequence
@@ -477,17 +481,18 @@ def base_optimize(
     best_cost = cost_of(layers, start)
     best = JointResult(best_cost, start, layers, {}, ())
     counter = itertools.count()
-    # heap entries: (cost, tiebreak, layers, assign, remap, applied, wmaps)
+    # heap entries: (cost, tiebreak, layers, assign, remap, detail, wmaps)
+    # where detail = ((rule name, matched layer names), ...)
     heap: List[Tuple] = [(best_cost, next(counter), layers, start, {}, (), ())]
     seen = {state_key(start_sig, layers, start)}
     pops = 0
     while heap and pops < budget:
-        cost, _, lyrs, assign, remap, applied, wmaps = heapq.heappop(heap)
+        cost, _, lyrs, assign, remap, detail, wmaps = heapq.heappop(heap)
         pops += 1
         if cost > alpha * best_cost:
             continue
 
-        def consider(n_lyrs, n_assign, n_remap, n_applied, n_wmaps):
+        def consider(n_lyrs, n_assign, n_remap, n_detail, n_wmaps):
             nonlocal best_cost, best
             key = state_key(graph_signature(n_lyrs), n_lyrs, n_assign)
             if key in seen:
@@ -497,18 +502,19 @@ def base_optimize(
             if c < best_cost:
                 best_cost = c
                 best = JointResult(
-                    c, n_assign, n_lyrs, n_remap, n_applied, n_wmaps
+                    c, n_assign, n_lyrs, n_remap,
+                    tuple(d[0] for d in n_detail), n_detail, n_wmaps,
                 )
             if c < alpha * best_cost:
                 heapq.heappush(
                     heap, (c, next(counter), n_lyrs, n_assign, n_remap,
-                           n_applied, n_wmaps)
+                           n_detail, n_wmaps)
                 )
 
         for xfer, mt in shard_matches(lyrs):
             new = xfer.apply(assign, mt, mesh, cand_cache)
             if new is not None:
-                consider(lyrs, new, remap, applied, wmaps)
+                consider(lyrs, new, remap, detail, wmaps)
         for mr in struct_matches(lyrs):
             rw = mr.xfer.build(mr.match)
             if rw is None:
@@ -524,9 +530,11 @@ def base_optimize(
                 if guid_map.get(g, g) in alive
             }
             n_remap = _compose_remap(remap, tmap)
-            n_applied = applied + (mr.xfer.name,)
+            n_detail = detail + (
+                (mr.xfer.name, tuple(l.name for l in mr.match)),
+            )
             n_wmaps = wmaps + (rw.weight_map,)
-            consider(n_lyrs, n_assign, n_remap, n_applied, n_wmaps)
+            consider(n_lyrs, n_assign, n_remap, n_detail, n_wmaps)
             # the bare variant leaves the rewrite's new ops unsharded —
             # usually pricier than the removed (already-sharded) ops, so
             # it would die to alpha pruning before a sharding xfer could
@@ -544,7 +552,7 @@ def base_optimize(
                 for cand in op_candidates(anchor, mesh):
                     a2 = dict(n_assign)
                     a2[int(anchor.layer_guid)] = cand
-                    consider(n_lyrs, a2, n_remap, n_applied, n_wmaps)
+                    consider(n_lyrs, a2, n_remap, n_detail, n_wmaps)
     if return_joint:
         return best
     return best.cost, best.assign
@@ -632,7 +640,8 @@ def graph_optimize(
             )
             res = dataclasses.replace(
                 res2, layers=res.layers, remap=res.remap,
-                applied=res.applied, wmaps=res.wmaps,
+                applied=res.applied, applied_detail=res.applied_detail,
+                wmaps=res.wmaps,
             )
         return res if return_joint else (res.cost, res.assign)
 

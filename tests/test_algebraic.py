@@ -446,3 +446,79 @@ def test_compose_linears_not_matched_for_training():
     assert not any(
         r.xfer.name == "compose_consecutive_linears" for r in rws
     )
+
+
+def test_strategy_roundtrip_with_structural_rewrites(tmp_path):
+    """--export-strategy / --import-strategy round-trips a search that
+    applied structural rewrites: the export records (rule, matched layer
+    names) + per-op names; import REPLAYS the rewrite sequence on the
+    freshly built graph and re-keys assignments by name — guids differ
+    across builds, so name identity is the contract."""
+
+    def build():
+        cfg = FFConfig(batch_size=64)
+        cfg.mesh_shape = (2, 2, 2)
+        cfg.mesh_axis_names = ("data", "expert", "model")
+        m = FFModel(cfg)
+        x = m.create_tensor((64, 32))
+        t = m.moe(x, num_exp=4, num_select=2, expert_hidden_size=64,
+                  fused=False)
+        m.dense(t, 10, name="head")
+        return m
+
+    path = str(tmp_path / "strategy.json")
+    m1 = build()
+    m1.config.search_budget = 24
+    m1.config.export_strategy_file = path
+    m1.compile(seed=0)
+    assert m1.strategy.applied_rewrites, "search must have rewritten"
+    layers1 = [(l.name, l.op_type.value) for l in m1.layers]
+
+    m2 = build()  # fresh guids
+    m2.config.import_strategy_file = path
+    m2.compile(seed=0)
+    assert [(l.name, l.op_type.value) for l in m2.layers] == layers1
+    # assignments carried over onto the replayed graph by name
+    name_to_l2 = {l.name: l for l in m2.layers}
+    for l1 in m1.layers:
+        s1 = m1.strategy.op_sharding(l1)
+        s2 = m2.strategy.op_sharding(name_to_l2[l1.name])
+        if s1 is None:
+            assert s2 is None, l1.name
+        else:
+            assert s2 is not None and s1.key() == s2.key(), l1.name
+    # and the imported model trains
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(64, 32)).astype(np.float32)
+    ys = rng.integers(0, 10, size=(64, 1)).astype(np.int32)
+    loss, _ = m2.executor.train_step([xs], ys)
+    assert np.isfinite(float(loss))
+
+
+def test_rebind_rejects_mismatched_graph(tmp_path):
+    """Importing a rewritten strategy into a DIFFERENT model must error
+    clearly, not silently misbind."""
+
+    def build(fused):
+        cfg = FFConfig(batch_size=64)
+        cfg.mesh_shape = (2, 2, 2)
+        cfg.mesh_axis_names = ("data", "expert", "model")
+        m = FFModel(cfg)
+        x = m.create_tensor((64, 32))
+        t = m.moe(x, num_exp=4, num_select=2, expert_hidden_size=64,
+                  fused=fused)
+        m.dense(t, 10, name="head")
+        return m
+
+    path = str(tmp_path / "s.json")
+    m1 = build(fused=False)
+    m1.config.search_budget = 24
+    m1.config.export_strategy_file = path
+    m1.compile(seed=0)
+    assert "fuse_parallel_experts" in m1.strategy.applied_rewrites
+    # the importing model is ALREADY fused: the recorded group_by/dense
+    # match layers do not exist
+    m2 = build(fused=True)
+    m2.config.import_strategy_file = path
+    with pytest.raises(ValueError, match="do not form a match"):
+        m2.compile(seed=0)

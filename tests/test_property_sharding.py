@@ -1,5 +1,6 @@
 """Property-based invariants of the sharding algebra, reshard pricing,
-and physical-topology embedding (hypothesis): the generative counterpart
+physical-topology embedding, and the algebraic rewrite engine
+(hypothesis): the generative counterpart
 of the golden tests — the reference has nothing equivalent (SURVEY §4.7
 notes its transfer estimates are never unit-tested at all).
 """
@@ -131,3 +132,94 @@ def test_opsharding_key_tracks_all_mutation_paths(s1, s2):
     assert cp.key() == op.key()
     cp.extras["other"] = 2
     assert cp.key() != op.key()
+
+
+# --------------------------------------------- algebraic rewrite engine
+@st_.composite
+def random_graphs(draw):
+    """Random small FFModel graphs mixing the shapes the structural
+    rules target: sibling denses, activation chains, transpose/reshape
+    pairs, duplicate pure ops."""
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.model import FFModel
+
+    m = FFModel(FFConfig(batch_size=8))
+    x = m.create_tensor((8, 16))
+    frontier = [x]
+    n_ops = draw(st_.integers(2, 8))
+    for i in range(n_ops):
+        src = frontier[draw(st_.integers(0, len(frontier) - 1))]
+        kind = draw(st_.sampled_from(
+            ["dense", "dense", "relu", "gelu", "add", "reshape", "transpose"]
+        ))
+        if kind == "dense" and src.ndim == 2:
+            t = m.dense(src, draw(st_.sampled_from([8, 16])), name=f"d{i}")
+        elif kind == "relu":
+            t = m.relu(src, name=f"r{i}")
+        elif kind == "gelu":
+            t = m.gelu(src, name=f"g{i}")
+        elif kind == "add":
+            other = frontier[draw(st_.integers(0, len(frontier) - 1))]
+            if other.shape != src.shape:
+                continue
+            t = m.add(src, other, name=f"a{i}")
+        elif kind == "reshape" and src.ndim == 2:
+            t = m.reshape(src, (src.shape[0], src.shape[1] // 2, 2),
+                          name=f"rs{i}")
+        elif kind == "transpose" and src.ndim == 3:
+            t = m.transpose(src, (0, 2, 1), name=f"t{i}")
+        else:
+            continue
+        frontier.append(t)
+    # single terminal so rewrites of the tail stay legal
+    last = frontier[-1]
+    if last.ndim != 2:
+        last = m.flat(last)
+    m.dense(last, 4, name="head")
+    return m
+
+
+@given(random_graphs())
+@settings(max_examples=30, deadline=None)
+def test_apply_rewrite_structural_invariants(model):
+    """For EVERY enumerable rewrite on a random graph: the functionally
+    rebuilt layer list is a topologically ordered DAG whose every input
+    is a graph input or an earlier layer's output, removed layers are
+    gone, and the result is itself rewritable without error — the
+    generative guard for future rule additions."""
+    from flexflow_tpu.search.algebraic import (
+        apply_rewrite,
+        default_struct_xfers,
+        enumerate_rewrites,
+    )
+
+    rws = enumerate_rewrites(
+        model.layers, default_struct_xfers(inference=True), inference=True
+    )
+    for mr in rws:  # every match, not a sample — the loop is ~free
+        rw = mr.xfer.build(mr.match)
+        if rw is None:
+            continue
+        res = apply_rewrite(model.layers, mr.match, rw)
+        if res is None:  # legality veto (outside consumer) is valid
+            continue
+        new_layers, guid_map, tmap = res
+        removed = rw.removed if rw.removed is not None else mr.match
+        removed_ids = {id(l) for l in removed}
+        assert not any(id(l) in removed_ids for l in new_layers)
+        available = {t.guid for t in model.graph_inputs}
+        for l in new_layers:
+            for t in l.inputs:
+                assert t.guid in available, (
+                    f"{l.name} consumes {t.name} before production "
+                    f"({mr.xfer.name})"
+                )
+            for o in l.outputs:
+                available.add(o.guid)
+        # the remap's surviving tensors all exist in the graph or inputs
+        for g, t in tmap.items():
+            assert t.guid in available, (mr.xfer.name, g)
+        # result is re-enumerable (rules tolerate rewritten graphs)
+        enumerate_rewrites(
+            new_layers, default_struct_xfers(inference=True), inference=True
+        )

@@ -24,6 +24,7 @@ def strategy_memory_per_device(
     layers: List[Layer],
     strategy: Strategy,
     optimizer_state_factor: float = 3.0,
+    profiler=None,
 ) -> float:
     """Peak per-device HBM estimate in bytes.
 
@@ -31,6 +32,14 @@ def strategy_memory_per_device(
     + activations (training saves every op output for backward) / degree.
     Pure function — the reference's ``MemoryUsage`` accounting made
     deterministic/unit-testable.
+
+    ``profiler`` (an ``OpProfiler``) upgrades the per-op activation term
+    to MEASURED temp+output bytes from XLA's actual buffer assignment
+    (``CompiledMemoryStats``) — the reference's ``CostMetrics`` records
+    memory next to time the same way (``simulator.h:54-88``); the
+    analytic term misses fusion-induced rematerialization.  Weights stay
+    analytic (their bytes are exact).  Ops that fail to compile in
+    isolation keep the analytic term.
     """
     mesh = strategy.mesh
     total = 0.0
@@ -45,6 +54,11 @@ def strategy_memory_per_device(
             deg = ws.total_degree(mesh) if ws else 1
             factor = optimizer_state_factor if w.trainable else 1.0
             total += wb * factor / deg
+        if profiler is not None:
+            measured = profiler.measure_memory(layer, s, mesh)
+            if measured > 0:
+                total += measured  # already per-shard (local shapes)
+                continue
         for i, (shape, dt) in enumerate(opdef.infer(layer)):
             ob = math.prod(shape) * _dtype_bytes(dt)
             # NOTE: partial axes do NOT divide memory — a partial-sum tensor
@@ -63,6 +77,7 @@ def optimize_with_memory_budget(
     mem_budget_bytes: float,
     iters: int = 8,
     machine=None,
+    profiler=None,
 ):
     """λ binary search (reference ``graph_optimize_task`` λ loop,
     ``graph.cc:2056-2131``): ``optimize_fn(lambda_mem)`` returns either
@@ -91,7 +106,7 @@ def optimize_with_memory_budget(
     def mem_of(r: JointResult) -> float:
         st = Strategy(mesh)
         st.ops = r.assign
-        return strategy_memory_per_device(r.layers, st)
+        return strategy_memory_per_device(r.layers, st, profiler=profiler)
 
     def time_of(r: JointResult) -> float:
         st = Strategy(mesh)

@@ -151,6 +151,8 @@ def _unity_search_impl(
                 res = optimize_with_memory_budget(
                     run, layers, mv, mem_budget_bytes,
                     iters=mem_search_iters, machine=machine,
+                    # measured per-op memory tier (CompiledMemoryStats)
+                    profiler=profiler,
                 )
             else:
                 res = run(0.0)
@@ -186,8 +188,12 @@ def _unity_search_impl(
             for k in agg:
                 agg[k] += m_.query_stats[k]
         if jax.process_index() == 0 and sum(agg.values()):
-            print(
-                "[unity_search] measured-cost coverage: "
-                + format_coverage(agg)
-            )
+            line = "[unity_search] measured-cost coverage: " + format_coverage(agg)
+            ms = getattr(profiler, "mem_stats", None)
+            if ms and (ms["measured"] or ms["fallback"]):
+                line += (
+                    f"; memory {ms['measured']}/"
+                    f"{ms['measured'] + ms['fallback']} measured"
+                )
+            print(line)
     return best

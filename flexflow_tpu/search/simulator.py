@@ -134,6 +134,9 @@ class OpProfiler:
         # a non-traceable op doesn't re-attempt a full jit compile on every
         # DP/search evaluation
         self._failed: set = set()
+        # measured-vs-fallback accounting for the MEMORY tier (the time
+        # tier's twin lives on MeasuredCostModel.query_stats)
+        self.mem_stats = {"measured": 0, "fallback": 0}
         if cache_file and os.path.exists(cache_file):
             with open(cache_file) as f:
                 loaded = json.load(f)
@@ -148,10 +151,11 @@ class OpProfiler:
     def _key(layer: Layer, local_in: List[Tuple[int, ...]]) -> str:
         return repr((layer.params_key(), tuple(local_in)))
 
-    def measure(
+    def _local_input_shapes(
         self, layer: Layer, sharding: Optional[OpSharding], mesh: MachineMesh
-    ) -> float:
-        """Seconds for one fwd+bwd of this op at its per-shard shapes."""
+    ) -> List[Tuple[int, ...]]:
+        """Per-shard input shapes under ``sharding`` — the ONE resolution
+        shared by measure() and measure_memory()."""
         out0 = sharding.output[0] if sharding and sharding.output else None
         local_in = []
         for i, t in enumerate(layer.inputs):
@@ -163,7 +167,31 @@ class OpProfiler:
             ):
                 ts = out0
             local_in.append(_local_shape(t.shape, ts, mesh))
-        key = self._key(layer, local_in)
+        return local_in
+
+    def _local_weight_shapes(
+        self, layer: Layer, sharding: Optional[OpSharding], mesh: MachineMesh
+    ) -> Tuple[Tuple[int, ...], ...]:
+        """Per-shard weight shapes — part of every cache key: two
+        shardings of one layer can agree on input shapes yet differ on
+        weight shards (TP vs replicated weights), and the compiled
+        program differs with them."""
+        return tuple(
+            _local_shape(
+                w.shape,
+                sharding.weights.get(w.name) if sharding else None,
+                mesh,
+            )
+            for w in get_op_def(layer.op_type).weights(layer)
+        )
+
+    def measure(
+        self, layer: Layer, sharding: Optional[OpSharding], mesh: MachineMesh
+    ) -> float:
+        """Seconds for one fwd+bwd of this op at its per-shard shapes."""
+        local_in = self._local_input_shapes(layer, sharding, mesh)
+        local_w = self._local_weight_shapes(layer, sharding, mesh)
+        key = self._key(layer, local_in) + repr(local_w)
         if key in self.cache:
             return self.cache[key]
         if key in self._failed:
@@ -174,6 +202,72 @@ class OpProfiler:
         else:
             self._failed.add(key)
         return t
+
+    def measure_memory(
+        self, layer: Layer, sharding: Optional[OpSharding], mesh: MachineMesh
+    ) -> float:
+        """MEASURED per-op memory: the TEMP bytes of the compiled
+        fwd+grad program at the per-shard shapes, from XLA's actual
+        buffer assignment (``compiled.memory_analysis()``) — the saved
+        residuals + scratch the analytic activation estimate guesses at
+        (it cannot see fusion-induced rematerialization).  Output bytes
+        are deliberately EXCLUDED: the grad program's outputs are the
+        loss + parameter/input gradients, and parameter gradients are
+        already charged by the weights term's optimizer-state factor.
+
+        Reference parity: ``CostMetrics`` records per-op memory alongside
+        time (``include/flexflow/simulator.h:54-88``).  Returns -1.0 when
+        the op cannot compile in isolation; callers fall back to the
+        analytic term and ``mem_stats`` counts both outcomes for the
+        coverage report."""
+        local_in = self._local_input_shapes(layer, sharding, mesh)
+        local_w = self._local_weight_shapes(layer, sharding, mesh)
+        key = "mem:" + self._key(layer, local_in) + repr(local_w)
+        if key in self.cache:
+            self.mem_stats["measured"] += 1
+            return self.cache[key]
+        if key in self._failed:
+            self.mem_stats["fallback"] += 1
+            return -1.0
+        b = self._memory_of(layer, local_in, sharding, mesh)
+        if b > 0:
+            self.mem_stats["measured"] += 1
+            self.cache[key] = b
+        else:
+            self.mem_stats["fallback"] += 1
+            self._failed.add(key)
+        return b
+
+    def _memory_of(
+        self, layer: Layer, local_in, sharding, mesh
+    ) -> float:
+        opdef = get_op_def(layer.op_type)
+        rng = np.random.default_rng(0)
+        mk = lambda shape, dt: self._mk_array(rng, shape, dt)  # noqa: E731
+        ins = [mk(s, t.dtype) for s, t in zip(local_in, layer.inputs)]
+        params = {}
+        for w in opdef.weights(layer):
+            ws = sharding.weights.get(w.name) if sharding else None
+            params[w.name] = mk(_local_shape(w.shape, ws, mesh), w.dtype)
+
+        def fwd_loss(p, full):
+            import jax.numpy as jnp
+
+            outs = opdef.forward(layer, p, full, OpContext(training=False))
+            return sum(
+                jnp.sum(o.astype(jnp.float32))
+                for o in outs
+                if jnp.issubdtype(o.dtype, jnp.floating)
+            )
+
+        try:
+            fn, xs = self._make_jit_fn(fwd_loss, params, ins)
+            ma = fn.lower(params, xs).compile().memory_analysis()
+            if ma is None:  # backend without memory stats
+                return -1.0
+            return float(ma.temp_size_in_bytes)
+        except Exception:
+            return -1.0
 
     def measure_segment(
         self,
@@ -220,12 +314,12 @@ class OpProfiler:
             return jnp.asarray(rng.integers(0, 2, size=shape), dt.to_jnp())
         return jnp.asarray(rng.normal(size=shape), dt.to_jnp())
 
-    def _time_fwd_loss(self, fwd_loss, params, ins) -> float:
-        """Shared timing harness: jit (value_and_grad when anything is
-        differentiable), compile+warmup once, then wall-clock self.iters
-        runs.  ONE copy on purpose — _run and _run_segment must stay
-        comparable, so any change to iteration count / dtype handling /
-        sync placement applies to both tiers."""
+    @staticmethod
+    def _make_jit_fn(fwd_loss, params, ins):
+        """The ONE construction of the jitted fwd(+grad) op program —
+        shared by the timing harness AND the memory tier, so time and
+        memory measurements always describe the SAME compiled program.
+        Returns (jitted fn taking (params, xs), xs)."""
         import jax
         import jax.numpy as jnp
 
@@ -240,11 +334,21 @@ class OpProfiler:
                 full[i] = x
             return fwd_loss(p, full)
 
-        has_grad = bool(params) or bool(xs)
-        if has_grad:
+        if params or xs:
             fn = jax.jit(jax.value_and_grad(loss_with_subst, argnums=(0, 1)))
         else:
             fn = jax.jit(loss_with_subst)
+        return fn, xs
+
+    def _time_fwd_loss(self, fwd_loss, params, ins) -> float:
+        """Shared timing harness: jit (value_and_grad when anything is
+        differentiable), compile+warmup once, then wall-clock self.iters
+        runs.  ONE copy on purpose — _run and _run_segment must stay
+        comparable, so any change to iteration count / dtype handling /
+        sync placement applies to both tiers."""
+        import jax
+
+        fn, xs = self._make_jit_fn(fwd_loss, params, ins)
         try:
             out = fn(params, xs)  # compile + warmup
             jax.block_until_ready(out)

@@ -171,6 +171,8 @@ def gpt_generate_cached(
     temperature: float = 0.0,
     seed: int = 0,
     session: GPTDecodeSession | None = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> Tuple[np.ndarray, GPTDecodeSession]:
     """Cache-carrying generation — same contract as
     :func:`flexflow_tpu.models.transformer.gpt_generate` (greedy at
@@ -200,7 +202,9 @@ def gpt_generate_cached(
     from flexflow_tpu.models.transformer import sample_next
 
     for t in range(start, end):
-        nxt = sample_next(np.asarray(probs), temperature, rng)
+        nxt = sample_next(
+            np.asarray(probs), temperature, rng, top_k=top_k, top_p=top_p
+        )
         out[:, t] = nxt
         if t + 1 < end:
             probs = sess.step(nxt, t)

@@ -155,10 +155,14 @@ GPT2_SMALL = dict(hidden=768, heads=12, ff_dim=3072, num_layers=12)
 GPT2_MEDIUM = dict(hidden=1024, heads=16, ff_dim=4096, num_layers=24)
 
 
-def sample_next(probs, temperature: float, rng):
+def sample_next(probs, temperature: float, rng, top_k: int = 0,
+                top_p: float = 1.0):
     """Next-token selection shared by :func:`gpt_generate` and the
     KV-cache path (``models.gpt_decode``): greedy at temperature 0, else
-    temperature-scaled softmax sampling."""
+    temperature-scaled softmax sampling, optionally truncated to the
+    ``top_k`` highest-probability tokens and/or the ``top_p`` nucleus
+    (smallest prefix of the sorted distribution with cumulative mass
+    >= top_p) — beyond the reference, which has no generation path."""
     import numpy as np
 
     if temperature <= 0.0:
@@ -168,6 +172,21 @@ def sample_next(probs, temperature: float, rng):
     logp = np.log(np.maximum(probs.astype(np.float64), 1e-30)) / temperature
     z = np.exp(logp - logp.max(-1, keepdims=True))
     z /= z.sum(-1, keepdims=True)
+    if top_k and top_k < z.shape[-1]:
+        kth = np.sort(z, axis=-1)[:, -top_k][:, None]
+        z = np.where(z >= kth, z, 0.0)
+        z /= z.sum(-1, keepdims=True)
+    if top_p < 1.0:
+        order = np.argsort(-z, axis=-1)
+        sorted_z = np.take_along_axis(z, order, axis=-1)
+        cum = np.cumsum(sorted_z, axis=-1)
+        # keep the smallest prefix reaching top_p (the first token always
+        # survives so the distribution never empties)
+        keep_sorted = cum - sorted_z < top_p
+        keep = np.zeros_like(z, dtype=bool)
+        np.put_along_axis(keep, order, keep_sorted, axis=-1)
+        z = np.where(keep, z, 0.0)
+        z /= z.sum(-1, keepdims=True)
     return np.array(
         [rng.choice(z.shape[-1], p=z[b]) for b in range(z.shape[0])],
         np.int32,
@@ -180,6 +199,8 @@ def gpt_generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     seed: int = 0,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ):
     """Iterative decoding for a compiled :func:`gpt_decoder` model, the
     reference's own NMT-style scheme (``FFIterationConfig::seq_length``,
@@ -211,6 +232,7 @@ def gpt_generate(
     for t in range(start, end):
         probs = np.asarray(model.eval_batch([cur]))
         cur[:, t] = sample_next(
-            probs.reshape(batch, seq, -1)[:, t - 1], temperature, rng
+            probs.reshape(batch, seq, -1)[:, t - 1], temperature, rng,
+            top_k=top_k, top_p=top_p,
         )
     return cur[:, :end]

@@ -43,6 +43,7 @@ from flexflow_tpu.initializer import (
     ZeroInitializer,
 )
 from flexflow_tpu.model import FFModel
+from flexflow_tpu.obs import Tracer, get_tracer
 from flexflow_tpu.optimizer import AdamOptimizer, SGDOptimizer
 from flexflow_tpu.parallel.machine import MachineMesh
 from flexflow_tpu.runtime.recompile import RecompileState
@@ -75,6 +76,8 @@ __all__ = [
     "data_parallel_strategy",
     "tensor_parallel_strategy",
     "RecompileState",
+    "Tracer",
+    "get_tracer",
     "GlorotUniform",
     "ZeroInitializer",
     "OnesInitializer",

@@ -60,9 +60,15 @@ class FFConfig:
     # compiles real sub-programs), machine_model_version (one TPU machine
     # model, parameterized via --machine-model-file).
     # --- observability (reference model.cc:3650-3670) ---
+    # per-step timing printouts in fit() (the reference's --profiling
+    # per-op ELAPSED prints) + compile-time cost table
     profiling: bool = False
     export_strategy_computation_graph_file: Optional[str] = None
     taskgraph_file: Optional[str] = None
+    # unified tracing (docs/OBSERVABILITY.md): Chrome-trace JSON output
+    # path and granularity.  --trace-out alone implies level "step".
+    trace_out: Optional[str] = None
+    trace_level: str = "off"  # off | step | op
     # --- simulator (reference config.h:127-136) ---
     machine_model_file: Optional[str] = None
     # measured cost tier: search candidates costed by compiling-and-timing
@@ -176,6 +182,10 @@ class FFConfig:
                 self.enable_attribute_parallel = False
             elif a == "--profiling":
                 self.profiling = True
+            elif a == "--trace-out":
+                self.trace_out = take()
+            elif a == "--trace-level":
+                self.trace_level = take()
             elif a == "--export-strategy" or a == "--export":
                 self.export_strategy_file = take()
             elif a == "--import-strategy" or a == "--import":

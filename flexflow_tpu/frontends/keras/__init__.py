@@ -7,6 +7,7 @@ from flexflow_tpu.frontends.keras.callbacks import (
     Callback,
     EpochVerifyMetrics,
     LearningRateScheduler,
+    TraceCallback,
     VerifyMetrics,
 )
 from flexflow_tpu.frontends.keras.layers import (
@@ -36,5 +37,6 @@ __all__ = [
     "Callback", "Concatenate", "Conv2D", "Dense", "Dropout", "Embedding",
     "EpochVerifyMetrics", "Flatten", "Input", "LayerNormalization",
     "LearningRateScheduler", "MaxPooling2D", "Model", "Multiply", "Reshape",
-    "SGD", "Sequential", "Subtract", "VerifyMetrics", "layers",
+    "SGD", "Sequential", "Subtract", "TraceCallback", "VerifyMetrics",
+    "layers",
 ]

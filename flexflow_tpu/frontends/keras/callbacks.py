@@ -70,6 +70,57 @@ class VerifyMetrics(Callback):
         )
 
 
+class TraceCallback(Callback):
+    """Record epoch/batch spans into the process tracer
+    (``flexflow_tpu.obs``) and write the Chrome-trace file at train end.
+
+    With ``out_path`` set, the callback configures the tracer itself
+    (``level`` defaults to ``"step"``); otherwise it records into
+    whatever tracer ``--trace-out``/``--trace-level`` already installed.
+    The keras fit loop drives the model's executor directly, so this is
+    the frontend's hook point for the spans ``FFModel.fit`` would have
+    recorded.  See docs/OBSERVABILITY.md.
+    """
+
+    def __init__(self, out_path: Optional[str] = None, level: str = "step"):
+        self.out_path = out_path
+        self.level = level
+        self._epoch_span = None
+
+    def _tracer(self):
+        from flexflow_tpu.obs import get_tracer
+
+        return get_tracer()
+
+    def on_train_begin(self, logs=None):
+        if self.out_path is not None:
+            from flexflow_tpu.obs import configure
+
+            configure(level=self.level, out_path=self.out_path)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch_span = self._tracer().span("epoch", cat="fit", epoch=epoch)
+        self._epoch_span.__enter__()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._epoch_span is not None:
+            if logs:
+                self._epoch_span.set(**{k: float(v) for k, v in logs.items()})
+            self._epoch_span.__exit__(None, None, None)
+            self._epoch_span = None
+
+    def on_train_end(self, logs=None):
+        if self._epoch_span is not None:  # early stop mid-epoch
+            self._epoch_span.__exit__(None, None, None)
+            self._epoch_span = None
+        self._tracer().save()  # no-op when no out path is configured
+
+    @property
+    def summary(self):
+        """The tracer's machine-readable rollup (after/during training)."""
+        return self._tracer().summary()
+
+
 class EpochVerifyMetrics(Callback):
     """Stop early once an epoch reaches the target accuracy."""
 

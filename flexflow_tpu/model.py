@@ -40,6 +40,7 @@ from flexflow_tpu.fftype import (
 )
 from flexflow_tpu.initializer import Initializer
 from flexflow_tpu.metrics import Metrics, PerfMetrics
+from flexflow_tpu.obs import configure_from_config, get_tracer
 from flexflow_tpu.ops.base import get_op_def
 from flexflow_tpu.optimizer import AdamOptimizer, Optimizer, SGDOptimizer
 from flexflow_tpu.parallel.machine import MachineMesh, default_mesh
@@ -75,6 +76,9 @@ def _load_substitution_xfers(cfg: FFConfig):
 class FFModel:
     def __init__(self, config: Optional[FFConfig] = None) -> None:
         self.config = config or FFConfig()
+        # wire the process tracer BEFORE compile so search/compile spans
+        # land in the trace (no-op when --trace-out/--trace-level unset)
+        configure_from_config(self.config)
         # multi-host bootstrap before any device query (the reference starts
         # the Legion/GASNet runtime in the FFModel ctor, model.cc:1160).
         # Unconditional: initialize_distributed is a no-op when neither
@@ -768,8 +772,10 @@ class FFModel:
             remat_policy=cfg.remat_policy,
             dcn_axis=cfg.dcn_axis,
             zero1=cfg.enable_zero1,
+            profiling=cfg.profiling,
         )
-        self.executor.init_params()
+        with get_tracer().span("init_params", cat="compile"):
+            self.executor.init_params()
 
     def _write_exports(self, cfg, strategy, machine, profiler) -> None:
         """Strategy/observability outputs (reference --export-strategy /
@@ -971,30 +977,48 @@ class FFModel:
                 f"dataset has {len(xs[0])} samples < batch_size {bs}: zero batches"
             )
 
+        tracer = get_tracer()
+        profiling = self.config.profiling and jax.process_index() == 0
         pm = PerfMetrics()
-        for epoch in range(epochs):
-            it.reset()
-            # per-EPOCH accumulation, like the reference's reset_metrics()
-            # at each epoch start (flexflow_cffi.py fit / base_model._train)
-            pm = PerfMetrics()
-            for batch in it:
-                *bx, by = batch
-                loss, m = self.executor.train_step(bx, by)
-                pm.update({k: float(v) for k, v in m.items()}, bs)
-                # R17 recompile hook: per-iteration trigger/alter, like the
-                # reference's recompile_on_condition in the train loop
-                # (moe.cc:180)
-                if recompile_state is not None:
-                    recompile_state.observe(
-                        float(loss), {k: float(v) for k, v in m.items()}
+        with tracer.span("fit", cat="fit", epochs=epochs, batches=it.num_batches):
+            for epoch in range(epochs):
+                it.reset()
+                # per-EPOCH accumulation, like the reference's reset_metrics()
+                # at each epoch start (flexflow_cffi.py fit / base_model._train)
+                pm = PerfMetrics()
+                with tracer.span("epoch", cat="fit", epoch=epoch):
+                    for bi, batch in enumerate(it):
+                        *bx, by = batch
+                        with tracer.span("batch", cat="fit", level="op", batch=bi):
+                            loss, m = self.executor.train_step(bx, by)
+                        # reference --profiling per-iteration ELAPSED prints
+                        # (model.cc:3650-3653): per-step wall split
+                        if profiling and self.executor.last_step_stats:
+                            s = self.executor.last_step_stats
+                            print(
+                                f"[profiling] step {s['step']}: "
+                                f"{s['total_s'] * 1e3:.2f} ms "
+                                f"(dispatch {s['dispatch_s'] * 1e3:.2f} ms, "
+                                f"device {s['device_s'] * 1e3:.2f} ms, "
+                                f"jit {s['jit_cache']})"
+                            )
+                        pm.update({k: float(v) for k, v in m.items()}, bs)
+                        # R17 recompile hook: per-iteration trigger/alter,
+                        # like the reference's recompile_on_condition in the
+                        # train loop (moe.cc:180)
+                        if recompile_state is not None:
+                            recompile_state.observe(
+                                float(loss), {k: float(v) for k, v in m.items()}
+                            )
+                            recompile_state.maybe_recompile(self)
+                if verbose:
+                    print(
+                        f"epoch {epoch}: loss={float(loss):.4f} "
+                        f"accuracy={pm.accuracy:.4f} "
+                        f"throughput={pm.throughput():.2f} samples/s"
                     )
-                    recompile_state.maybe_recompile(self)
-            if verbose:
-                print(
-                    f"epoch {epoch}: loss={float(loss):.4f} "
-                    f"accuracy={pm.accuracy:.4f} "
-                    f"throughput={pm.throughput():.2f} samples/s"
-                )
+        if jax.process_index() == 0:
+            tracer.save()  # no-op without --trace-out
         return pm  # the FINAL epoch's metrics (reference parity)
 
     def eval(
@@ -1026,22 +1050,38 @@ class FFModel:
             f"inputs/labels disagree on sample count: "
             f"{[a.shape[0] for a in xs]} vs labels {ya.shape[0]}"
         )
-        for start in range(0, n, bs):
-            rows = min(bs, n - start)
-            bx = [a[start:start + rows] for a in xs]
-            if rows < bs:
-                bx = [
-                    np.concatenate([b, np.repeat(b[-1:], bs - rows, axis=0)])
-                    for b in bx
-                ]
-            logits = ex.forward(bx)
-            m = ex.metrics.compute(logits[:rows], _jnp.asarray(ya[start:start + rows]))
-            pm.update({k: float(v) for k, v in m.items()}, rows)
+        with get_tracer().span("eval", cat="fit", samples=n):
+            for start in range(0, n, bs):
+                rows = min(bs, n - start)
+                bx = [a[start:start + rows] for a in xs]
+                if rows < bs:
+                    bx = [
+                        np.concatenate([b, np.repeat(b[-1:], bs - rows, axis=0)])
+                        for b in bx
+                    ]
+                logits = ex.forward(bx)
+                m = ex.metrics.compute(logits[:rows], _jnp.asarray(ya[start:start + rows]))
+                pm.update({k: float(v) for k, v in m.items()}, rows)
         if verbose:
             print("eval: " + " ".join(
                 f"{k}={v:.4f}" for k, v in (("accuracy", pm.accuracy),)
             ))
         return pm
+
+    def last_step_stats(self) -> Optional[Dict[str, Any]]:
+        """Timing of the most recent training step (see
+        docs/OBSERVABILITY.md for the field glossary): ``step``,
+        ``total_s``, ``host_s``, ``dispatch_s``, ``device_s``,
+        ``compile_s``, ``jit_cache``.  None until a step has run with
+        tracing or ``--profiling`` enabled — the untraced fast path
+        records nothing (it would have to force a device sync)."""
+        assert self.executor is not None, "call compile() first"
+        return self.executor.last_step_stats
+
+    def trace_summary(self) -> Dict[str, Any]:
+        """The process tracer's machine-readable rollup (phases, spans,
+        counters) — the summary dict ``bench.py`` consumers read."""
+        return get_tracer().summary()
 
     def eval_batch(
         self, x: Sequence[np.ndarray], seq_length: Optional[int] = None
@@ -1140,15 +1180,21 @@ class FFModel:
                 for wname, arr in ws.items():
                     flat[f"{prefix}/{lname}/{wname}"] = self._to_numpy(arr)
 
-        put("params", ex.params)
-        put("state", ex.state)
-        for key, val in ex.opt_state.items():
-            if isinstance(val, dict):
-                put(f"opt/{key}", val)
-            else:
-                flat[f"opt_scalar/{key}"] = np.asarray(val)
-        flat["meta/step_count"] = np.asarray(ex._step_count)
-        np.savez(path, **flat)
+        tracer = get_tracer()
+        with tracer.span("checkpoint_save", cat="io", path=path):
+            put("params", ex.params)
+            put("state", ex.state)
+            for key, val in ex.opt_state.items():
+                if isinstance(val, dict):
+                    put(f"opt/{key}", val)
+                else:
+                    flat[f"opt_scalar/{key}"] = np.asarray(val)
+            flat["meta/step_count"] = np.asarray(ex._step_count)
+            np.savez(path, **flat)
+        tracer.counter(
+            "checkpoint.bytes_written",
+            float(sum(a.nbytes for a in flat.values())),
+        )
 
     def load_checkpoint(self, path: str) -> None:
         """Restore a :meth:`save_checkpoint` file into the compiled model
@@ -1156,7 +1202,8 @@ class FFModel:
         written under one strategy loads under any other)."""
         assert self.executor is not None, "call compile() first"
         ex = self.executor
-        with np.load(path) as z:
+        with get_tracer().span("checkpoint_load", cat="io", path=path), \
+                np.load(path) as z:
             for key in z.files:
                 # layer names may themselves contain '/', so parse as
                 # prefix[/okey]/<lname...>/wname with wname = last segment

@@ -65,6 +65,18 @@ class GPTDecodeSession:
         eps = self.eps
         has_bias = self.has_bias
         scale = 1.0 / math.sqrt(D)
+        # mirror the executor's mixed-precision rule (FFConfig.compute_dtype):
+        # float32 master params cast at use, caches/activations in the
+        # compute dtype, probabilities back in float32 — so cached decode
+        # matches the full-prefix path (and bench.py's staged-decode
+        # comparison) like-for-like under bfloat16
+        dt = model.executor.compute_dtype
+        mixed = dt != jnp.float32
+
+        def cast(x):
+            if mixed and x.dtype == jnp.float32:
+                return x.astype(dt)
+            return x
 
         def ln(p, x):
             mean = jnp.mean(x, axis=-1, keepdims=True)
@@ -74,6 +86,7 @@ class GPTDecodeSession:
         def step(params, cache_k, cache_v, tok, t):
             # tok (B,) int32; t () int32; caches (L, B, H, S, D)
             self._trace_count += 1  # traced once; calls replay the jit
+            params = jax.tree.map(cast, params)  # cast-at-use, like Executor
             x = params["tok_embed"]["kernel"][tok]  # (B, hidden)
             x = x + params["pos_embed"]["value"][t]
             mask = (jnp.arange(S) <= t)[None, None, :]
@@ -110,13 +123,15 @@ class GPTDecodeSession:
                 f = f @ p1["kernel"] + p1["bias"]
                 x = x + f
             x = ln(params["final_ln"], x)
-            probs = jax.nn.softmax(x @ params["lm_head"]["kernel"], axis=-1)
+            logits = x @ params["lm_head"]["kernel"]
+            # probabilities in float32, like the executor's fp32 loss head
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
             return probs, cache_k, cache_v
 
         # donate the caches: XLA reuses their buffers for the in-place
         # dynamic_update_slice instead of copying (L*B*H*S*D*2 floats)
         self._step = jax.jit(step, donate_argnums=(1, 2))
-        dt = jnp.float32
+        self._dtype = dt
         self._cache_shape = (L, B, H, S, D)
         ck = jnp.zeros(self._cache_shape, dt)
         cv = jnp.zeros(self._cache_shape, dt)
@@ -141,10 +156,10 @@ class GPTDecodeSession:
         jax, jnp = self._jax, self._jnp
         sk, sv = self._cache_sharding
         self.cache_k = jax.device_put(
-            jnp.zeros(self._cache_shape, jnp.float32), sk
+            jnp.zeros(self._cache_shape, self._dtype), sk
         )
         self.cache_v = jax.device_put(
-            jnp.zeros(self._cache_shape, jnp.float32), sv
+            jnp.zeros(self._cache_shape, self._dtype), sv
         )
 
     def step(self, tok: np.ndarray, t: int) -> np.ndarray:

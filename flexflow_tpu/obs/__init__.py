@@ -1,0 +1,27 @@
+"""Unified tracing & telemetry (see docs/OBSERVABILITY.md).
+
+``get_tracer()`` returns the process-wide :class:`Tracer`; the runtime,
+search, and fit loops record spans/counters into it, and ``--trace-out``
+exports Chrome-trace JSON readable by chrome://tracing / Perfetto and by
+``tools/trace_report.py``.
+"""
+
+from flexflow_tpu.obs.trace import (
+    CORE_COUNTERS,
+    LEVELS,
+    Tracer,
+    configure,
+    configure_from_config,
+    get_tracer,
+    set_tracer,
+)
+
+__all__ = [
+    "Tracer",
+    "get_tracer",
+    "set_tracer",
+    "configure",
+    "configure_from_config",
+    "CORE_COUNTERS",
+    "LEVELS",
+]

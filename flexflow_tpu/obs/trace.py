@@ -1,0 +1,293 @@
+"""Process-wide tracer: nestable spans, counters, Chrome-trace export.
+
+The reference leans on observability to make auto-parallelization
+debuggable — per-op ``--profiling`` timing printouts
+(``src/runtime/model.cc:3650-3653``), Legion Prof/Spy tracing, and the
+``log_measure``/``log_sim``/``log_dp`` logger categories.  This module is
+the TPU-native analog: ONE process-wide :class:`Tracer` that the runtime
+(``runtime/executor.py``), the search (``search/``), and the fit/eval
+loops (``model.py``) all record into, emitting standard
+Chrome-trace-format JSON (loadable in ``chrome://tracing`` / Perfetto,
+https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU)
+plus a machine-readable ``summary()`` dict that ``bench.py`` consumers
+and ``tools/trace_report.py`` read.
+
+Design constraints:
+  * Near-zero overhead when disabled: every instrumentation site either
+    checks ``tracer.enabled`` (one attr read) or receives the shared
+    ``_NULL_SPAN`` singleton — no allocation, no clock read, no event.
+  * Levels: ``off`` (default) < ``step`` (step/compile/search/epoch
+    spans) < ``op`` (adds per-op / per-frontier detail).  A span or
+    sample declared at ``level="op"`` is dropped unless the tracer runs
+    at ``op``.
+  * Spans nest: events are "X" (complete) records stamped at span EXIT
+    with the entry timestamp, so a child (which closes first) always
+    lies inside its parent's [ts, ts+dur] window on the same tid.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+LEVELS = ("off", "step", "op")
+
+# counter glossary (documented in docs/OBSERVABILITY.md): pre-registered
+# at 0 so a trace/summary always carries the full vocabulary — a consumer
+# can distinguish "no OOM rejections happened" from "this build doesn't
+# count them".
+CORE_COUNTERS = (
+    "jit.cache_hit",
+    "jit.cache_miss",
+    "recompile.count",
+    "search.candidates_explored",
+    "search.rewrites_considered",
+    "search.rewrites_applied",
+    "search.oom_rejections",
+    "profiler.cache_hit",
+    "profiler.cache_miss",
+    "checkpoint.bytes_written",
+)
+
+
+class _NullSpan:
+    """Shared no-op context manager — the disabled fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **args) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span; records an 'X' event at exit."""
+
+    __slots__ = ("tracer", "name", "cat", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args: Dict):
+        self.tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._t0 = 0.0
+
+    def set(self, **args) -> None:
+        """Attach/override args mid-span (e.g. a result computed inside)."""
+        self.args.update(args)
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.tracer._record_span(
+            self.name, self.cat, self._t0, time.perf_counter(), self.args
+        )
+        return False
+
+
+class Tracer:
+    """Nestable spans + counters with Chrome-trace JSON export.
+
+    All mutation is lock-guarded (the native dataloader and multi-host
+    helpers touch the runtime from worker threads); reads for export
+    happen under the same lock.
+    """
+
+    def __init__(self, level: str = "off", out_path: Optional[str] = None):
+        assert level in LEVELS, f"trace level must be one of {LEVELS}, got {level!r}"
+        self.level = level
+        self.enabled = level != "off"
+        self.op_level = level == "op"
+        self.out_path = out_path
+        self.events: List[Dict[str, Any]] = []
+        self.counters: Dict[str, float] = (
+            {k: 0.0 for k in CORE_COUNTERS} if self.enabled else {}
+        )
+        # per-(cat, name) span aggregates for summary(): [count, total_s]
+        self._span_agg: Dict[tuple, List[float]] = {}
+        self._samples: Dict[str, Dict[str, float]] = {}
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+
+    # --- recording ---------------------------------------------------------
+    def span(self, name: str, cat: str = "step", level: str = "step", **args):
+        """Context manager timing one phase.  ``cat`` is the Chrome-trace
+        category AND the summary phase bucket; ``level='op'`` spans are
+        recorded only when the tracer runs at op level."""
+        if not self.enabled or (level == "op" and not self.op_level):
+            return _NULL_SPAN
+        return _Span(self, name, cat, args)
+
+    def _record_span(self, name, cat, t0, t1, args) -> None:
+        with self._lock:
+            self.events.append({
+                "name": name,
+                "cat": cat,
+                "ph": "X",
+                "ts": (t0 - self._t0) * 1e6,
+                "dur": (t1 - t0) * 1e6,
+                "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "args": args,
+            })
+            agg = self._span_agg.setdefault((cat, name), [0, 0.0])
+            agg[0] += 1
+            agg[1] += t1 - t0
+
+    def counter(self, name: str, value: float = 1.0) -> None:
+        """Accumulate a named counter (cheap: no event per increment; the
+        cumulative values are emitted as 'C' events at export time)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0.0) + value
+
+    def sample(self, name: str, value: float, level: str = "op") -> None:
+        """Record an instantaneous gauge (e.g. frontier beam width): one
+        'C' event per call plus min/max/last aggregates in the summary."""
+        if not self.enabled or (level == "op" and not self.op_level):
+            return
+        with self._lock:
+            self.events.append({
+                "name": name,
+                "ph": "C",
+                "ts": (time.perf_counter() - self._t0) * 1e6,
+                "pid": os.getpid(),
+                "args": {name.rsplit(".", 1)[-1]: value},
+            })
+            s = self._samples.setdefault(
+                name, {"count": 0, "min": value, "max": value, "last": value}
+            )
+            s["count"] += 1
+            s["min"] = min(s["min"], value)
+            s["max"] = max(s["max"], value)
+            s["last"] = value
+
+    def instant(self, name: str, cat: str = "step", **args) -> None:
+        """Zero-duration marker event (e.g. a recompile trigger firing)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.events.append({
+                "name": name,
+                "cat": cat,
+                "ph": "i",
+                "s": "t",
+                "ts": (time.perf_counter() - self._t0) * 1e6,
+                "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "args": args,
+            })
+
+    # --- export ------------------------------------------------------------
+    def summary(self) -> Dict[str, Any]:
+        """Machine-readable rollup: per-phase (category) and per-span-name
+        time totals, counter values, gauge aggregates.  This is the shared
+        measurement vocabulary ``bench.py`` consumers read — see
+        docs/OBSERVABILITY.md for the field glossary."""
+        with self._lock:
+            phases: Dict[str, Dict[str, float]] = {}
+            spans: Dict[str, Dict[str, float]] = {}
+            for (cat, name), (n, tot) in self._span_agg.items():
+                ph = phases.setdefault(cat, {"count": 0, "total_s": 0.0})
+                ph["count"] += n
+                ph["total_s"] += tot
+                spans[name] = {
+                    "cat": cat,
+                    "count": n,
+                    "total_s": tot,
+                    "mean_s": tot / n if n else 0.0,
+                }
+            return {
+                "level": self.level,
+                "wall_s": time.perf_counter() - self._t0,
+                "phases": phases,
+                "spans": spans,
+                "counters": dict(self.counters),
+                "samples": {k: dict(v) for k, v in self._samples.items()},
+            }
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """Chrome-trace JSON Object Format: ``traceEvents`` plus the
+        summary under a vendor key (extra top-level keys are legal and
+        ignored by chrome://tracing / Perfetto)."""
+        summ = self.summary()
+        with self._lock:
+            events = list(self.events)
+            # final cumulative counter values as 'C' events so the
+            # counter track exists in the timeline UIs
+            ts = (time.perf_counter() - self._t0) * 1e6
+            pid = os.getpid()
+            for k, v in self.counters.items():
+                events.append({
+                    "name": k, "ph": "C", "ts": ts, "pid": pid,
+                    "args": {k.rsplit(".", 1)[-1]: v},
+                })
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid, "ts": 0,
+                "args": {"name": "flexflow_tpu"},
+            })
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "flexflow_tpu": {"summary": summ},
+        }
+
+    def save(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the Chrome-trace file; returns the path written (None when
+        no path is configured).  Safe to call repeatedly — later calls
+        overwrite with the fuller trace."""
+        path = path or self.out_path
+        if not path or not self.enabled:
+            return None
+        doc = self.to_chrome_trace()
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+
+# --- process-wide singleton -------------------------------------------------
+_TRACER = Tracer()  # disabled: every site sees the null fast path
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    global _TRACER
+    _TRACER = tracer
+    return _TRACER
+
+
+def configure(level: str = "step", out_path: Optional[str] = None) -> Tracer:
+    """Install a fresh enabled tracer as the process tracer."""
+    return set_tracer(Tracer(level=level, out_path=out_path))
+
+
+def configure_from_config(cfg) -> Tracer:
+    """Wire the process tracer to ``FFConfig`` (``--trace-out`` /
+    ``--trace-level``).  ``--trace-out`` alone implies level ``step``.
+    A config with tracing off leaves the current tracer untouched, so an
+    explicitly configured tracer survives auxiliary FFModel constructions
+    (e.g. a search probe model)."""
+    level = getattr(cfg, "trace_level", "off")
+    out = getattr(cfg, "trace_out", None)
+    if level == "off" and out:
+        level = "step"
+    if level == "off":
+        return _TRACER
+    return configure(level=level, out_path=out)

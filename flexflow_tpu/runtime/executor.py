@@ -30,6 +30,7 @@ cost model already assumes (``search/cost.py``).
 from __future__ import annotations
 
 import functools
+import time
 import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -41,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from flexflow_tpu.fftype import LossType, OperatorType
 from flexflow_tpu.loss import get_loss_fn
 from flexflow_tpu.metrics import Metrics
+from flexflow_tpu.obs import get_tracer
 from flexflow_tpu.ops.base import OpContext, get_op_def
 from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
 from flexflow_tpu.optimizer import Optimizer
@@ -66,6 +68,7 @@ class Executor:
         compute_dtype: str = "float32",
         dcn_axis: str = "data",
         zero1: bool = False,
+        profiling: bool = False,
     ) -> None:
         self.layers = layers
         self.graph_inputs = graph_inputs
@@ -111,6 +114,13 @@ class Executor:
         self.state: Dict[str, Dict[str, jax.Array]] = {}
         self.opt_state: Any = None
         self._step_count = 0
+        # observability: --profiling per-step timing, last_step_stats API,
+        # trace spans (docs/OBSERVABILITY.md).  The untraced train_step
+        # path is untouched when both are off.
+        self.profiling = profiling
+        self.last_step_stats: Optional[Dict[str, Any]] = None
+        self._step_compiled = None  # AOT executable (traced path only)
+        self._fwd_seqs_seen: set = set()  # fwd jit-cache hit/miss tracking
 
     # --- sharding helpers --------------------------------------------------
     def _constrain(self, x: jax.Array, pspec: PartitionSpec) -> jax.Array:
@@ -434,30 +444,168 @@ class Executor:
 
     # --- public API --------------------------------------------------------
     def train_step(self, inputs: Sequence[Any], labels: Any) -> Tuple[float, Dict[str, float]]:
-        if self._step_jit is None:
-            self._step_jit = self._build_step()
-        inputs = [
-            self._place(x, self._input_pspec(t), t.shape[0])
-            for x, t in zip(inputs, self.graph_inputs)
-        ]
-        labels = self._place(labels, self._label_pspec(), self.graph_inputs[0].shape[0])
-        self.params, self.state, self.opt_state, loss, m = self._step_jit(
-            self.params, self.state, self.opt_state, inputs, labels,
-            self._step_count,
-        )
+        tracer = get_tracer()
+        if not (tracer.enabled or self.profiling):
+            # fast path — no clock reads, no forced device sync (async
+            # dispatch stays pipelined).  An AOT executable left by an
+            # earlier instrumented step (e.g. bench.py's compile-capture
+            # step) is reused so the program never compiles twice.
+            if self._step_jit is None:
+                self._step_jit = self._build_step()
+                self._step_compiled = None
+            inputs = [
+                self._place(x, self._input_pspec(t), t.shape[0])
+                for x, t in zip(inputs, self.graph_inputs)
+            ]
+            labels = self._place(labels, self._label_pspec(), self.graph_inputs[0].shape[0])
+            fn = self._step_compiled or self._step_jit
+            args = (
+                self.params, self.state, self.opt_state, inputs, labels,
+                self._step_count,
+            )
+            try:
+                out = fn(*args)
+            except Exception:
+                if fn is self._step_jit:
+                    raise
+                # AOT executable pins input shardings; the jit wrapper
+                # reshards/retraces transparently (see instrumented path)
+                self._step_compiled = self._step_jit
+                out = self._step_jit(*args)
+            self.params, self.state, self.opt_state, loss, m = out
+            self._step_count += 1
+            return loss, m
+        return self._train_step_instrumented(tracer, inputs, labels)
+
+    def _train_step_instrumented(
+        self, tracer, inputs: Sequence[Any], labels: Any
+    ) -> Tuple[float, Dict[str, float]]:
+        """Timed step (tracing or --profiling): host placement+dispatch
+        vs device wall split, jit-compile events with cache hit/miss, and
+        a device-memory snapshot from the compiled program's
+        ``memory_analysis()``.  Opt-in because the block_until_ready it
+        inserts serializes the async dispatch the fast path relies on.
+        The first call compiles AOT (``jit.lower().compile()``) so
+        compile time is attributed to its own span instead of hiding
+        inside step 0's device time."""
+        t_begin = time.perf_counter()
+        step_no = self._step_count
+        with tracer.span("train_step", cat="step", step=step_no):
+            if self._step_jit is None:
+                with tracer.span("build_step", cat="compile"):
+                    self._step_jit = self._build_step()
+                self._step_compiled = None
+            with tracer.span("h2d_place", cat="step", level="op"):
+                inputs = [
+                    self._place(x, self._input_pspec(t), t.shape[0])
+                    for x, t in zip(inputs, self.graph_inputs)
+                ]
+                labels = self._place(
+                    labels, self._label_pspec(), self.graph_inputs[0].shape[0]
+                )
+            args = (
+                self.params, self.state, self.opt_state, inputs, labels,
+                self._step_count,
+            )
+            compile_s = 0.0
+            if self._step_compiled is None:
+                t0 = time.perf_counter()
+                with tracer.span("jit_compile", cat="compile", fn="train_step"):
+                    try:
+                        self._step_compiled = self._step_jit.lower(*args).compile()
+                    except Exception:
+                        # AOT unsupported for this arg mix: the jit wrapper
+                        # compiles lazily on the first call instead
+                        self._step_compiled = self._step_jit
+                compile_s = time.perf_counter() - t0
+                tracer.counter("jit.cache_miss")
+                self._record_memory_snapshot(tracer)
+            else:
+                tracer.counter("jit.cache_hit")
+            t0 = time.perf_counter()
+            try:
+                out = self._step_compiled(*args)
+            except Exception:
+                if self._step_compiled is self._step_jit:
+                    raise
+                # the AOT executable pins the exact input shardings it was
+                # compiled with, but GSPMD may evolve param shardings after
+                # the first update — fall back to the jit wrapper, which
+                # reshards/retraces transparently (and stays the fn from
+                # here on)
+                self._step_compiled = self._step_jit
+                tracer.counter("jit.cache_miss")
+                out = self._step_jit(*args)
+            dispatch_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            with tracer.span("device_step", cat="step", step=step_no):
+                out = jax.block_until_ready(out)
+            device_s = time.perf_counter() - t0
+        self.params, self.state, self.opt_state, loss, m = out
         self._step_count += 1
+        total_s = time.perf_counter() - t_begin
+        self.last_step_stats = {
+            "step": step_no,
+            "total_s": total_s,
+            "host_s": total_s - device_s,
+            "dispatch_s": dispatch_s,
+            "device_s": device_s,
+            "compile_s": compile_s,
+            "jit_cache": "miss" if compile_s else "hit",
+        }
         return loss, m
+
+    def _record_memory_snapshot(self, tracer) -> None:
+        """Device-memory footprint of the compiled step from XLA's actual
+        buffer assignment (``compiled.memory_analysis()`` — the same
+        source the search's measured memory tier reads)."""
+        try:
+            ma = self._step_compiled.memory_analysis()
+        except Exception:
+            return
+        if ma is None:
+            return
+        for field in (
+            "temp_size_in_bytes",
+            "argument_size_in_bytes",
+            "output_size_in_bytes",
+            "generated_code_size_in_bytes",
+        ):
+            v = getattr(ma, field, None)
+            if v is not None:
+                tracer.sample(
+                    "memory." + field.replace("_size_in_bytes", "_bytes"),
+                    float(v), level="step",
+                )
 
     def forward(
         self, inputs: Sequence[Any], seq_length: Optional[int] = None
     ) -> jax.Array:
+        tracer = get_tracer()
         if self._fwd_jit is None:
             self._fwd_jit = self._build_fwd()
-        inputs = [
-            self._place(x, self._input_pspec(t), t.shape[0])
-            for x, t in zip(inputs, self.graph_inputs)
-        ]
-        return self._fwd_jit(self.params, self.state, inputs, seq_length)
+            self._fwd_seqs_seen = set()
+        if tracer.enabled:
+            # static seq_length: each distinct value is its own trace
+            # (model.cc:2415-2420), so classify hit/miss per value
+            if seq_length in self._fwd_seqs_seen:
+                tracer.counter("jit.cache_hit")
+                cm = tracer.span("forward", cat="step", level="op")
+            else:
+                self._fwd_seqs_seen.add(seq_length)
+                tracer.counter("jit.cache_miss")
+                cm = tracer.span(
+                    "jit_compile", cat="compile", fn="forward",
+                    seq_length=str(seq_length),
+                )
+        else:
+            cm = tracer.span("forward")  # disabled tracer -> shared null span
+        with cm:
+            inputs = [
+                self._place(x, self._input_pspec(t), t.shape[0])
+                for x, t in zip(inputs, self.graph_inputs)
+            ]
+            return self._fwd_jit(self.params, self.state, inputs, seq_length)
 
     def _label_pspec(self) -> PartitionSpec:
         if self.strategy.mesh.axis_size("data") > 1:

@@ -51,7 +51,14 @@ class RecompileState:
         trigger, run alter + recompile when true."""
         if not self.trigger_fn(self):
             return False
-        self.alter_fn(model)
-        model.recompile()
+        from flexflow_tpu.obs import get_tracer
+
+        tracer = get_tracer()
+        with tracer.span(
+            "recompile", cat="compile", iteration=self.iteration
+        ):
+            self.alter_fn(model)
+            model.recompile()
+        tracer.counter("recompile.count")
         self.recompilations += 1
         return True

@@ -234,6 +234,18 @@ _PURE_OPS = frozenset(
 )
 
 
+def _initializer_key(init) -> Tuple:
+    """Hashable value identity of an initializer attr: None (framework
+    default) is its own class; configured instances compare by type +
+    constructor state, so two separately built ``GlorotUniform(0)`` merge
+    but differently parameterized initializers never do."""
+    if init is None:
+        return ("default",)
+    return (type(init).__name__,) + tuple(
+        sorted((k, repr(v)) for k, v in vars(init).items())
+    )
+
+
 class BatchSiblings(StructXfer):
     """Two same-hyperparameter Linears (or Convs) consuming the SAME tensor
     become one batched GEMM + split — TASO's merge-matmul class (the
@@ -249,11 +261,19 @@ class BatchSiblings(StructXfer):
 
     def _group_key(self, l: Layer):
         a = l.attrs
+        # initializer identity is part of the key: the batched layer is
+        # born with match[0]'s initializers, so a PRE-INIT application
+        # would otherwise silently re-initialize every sibling from the
+        # first layer's distribution
+        inits = (
+            _initializer_key(a.get("kernel_initializer")),
+            _initializer_key(a.get("bias_initializer")),
+        )
         if self.op is OperatorType.LINEAR:
             return (
                 l.inputs[0].guid, str(a.get("activation", ActiMode.NONE)),
                 bool(a.get("use_bias", True)), l.inputs[0].dtype.value,
-            )
+            ) + inits
         if a.get("groups", 1) != 1:
             return None
         return (
@@ -261,7 +281,7 @@ class BatchSiblings(StructXfer):
             bool(a.get("use_bias", True)), l.inputs[0].dtype.value,
             a["kernel_h"], a["kernel_w"], a["stride_h"], a["stride_w"],
             a["padding_h"], a["padding_w"],
-        )
+        ) + inits
 
     def find_matches(self, layers):
         """One match per sibling GROUP (all same-hyperparameter consumers

@@ -26,6 +26,7 @@ from __future__ import annotations
 import heapq
 from typing import Dict, List, Optional, Tuple
 
+from flexflow_tpu.obs import get_tracer
 from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
 from flexflow_tpu.parallel.machine import MachineMesh
 from flexflow_tpu.parallel.spec import TensorSharding
@@ -114,18 +115,21 @@ class SearchHelper:
         combinations — the reference's exact DP had no such knob to get
         wrong (``graph.cc:1803``, horizontal splits), so the TPU build
         must not expose one that silently degrades quality."""
-        best_cost, best_assign, hit = self._sweep(self.beam)
-        b, stall = self.beam, 0
-        # widening can only change the result when the beam bound
-        # actually pruned something — skip the re-sweeps otherwise
-        # (solve() is the inner loop of every lambda probe per mesh)
-        while hit and b < 256 and stall < 2:
-            b *= 2
-            c, a, hit = self._sweep(b)
-            if c < best_cost * (1.0 - 1e-9):
-                best_cost, best_assign, stall = c, a, 0
-            else:
-                stall += 1
+        with get_tracer().span(
+            "dp_solve", cat="search", layers=len(self.layers), beam=self.beam,
+        ):
+            best_cost, best_assign, hit = self._sweep(self.beam)
+            b, stall = self.beam, 0
+            # widening can only change the result when the beam bound
+            # actually pruned something — skip the re-sweeps otherwise
+            # (solve() is the inner loop of every lambda probe per mesh)
+            while hit and b < 256 and stall < 2:
+                b *= 2
+                c, a, hit = self._sweep(b)
+                if c < best_cost * (1.0 - 1e-9):
+                    best_cost, best_assign, stall = c, a, 0
+                else:
+                    stall += 1
         return best_cost, best_assign
 
     def _sweep(
@@ -133,6 +137,8 @@ class SearchHelper:
     ) -> Tuple[float, Dict[int, OpSharding], bool]:
         """One frontier-DP pass at a fixed beam width; the returned flag
         reports whether the beam bound ever pruned the state set."""
+        tracer = get_tracer()
+        explored = 0  # (state x candidate) evaluations this sweep
         hit_bound = False
         # state: frontier signature -> (cost, assignment dict)
         init_front = {
@@ -180,6 +186,7 @@ class SearchHelper:
                             want = cand.inputs[i] if i < len(cand.inputs) else None
                             c += self._edge_cost(t, in_shs[i], want)
                         choices.append((c, cand))
+                explored += len(choices)
                 for c, cand in choices:
                     na = dict(assign)
                     na[int(layer.layer_guid)] = cand
@@ -204,8 +211,12 @@ class SearchHelper:
                     beam, new_states.items(), key=lambda kv: kv[1][0]
                 )
                 new_states = dict(kept)
+            # frontier width per layer: the state-blowup signal the beam
+            # bound exists to cap (log_dp analog)
+            tracer.sample("search.frontier_width", float(len(new_states)))
             states = new_states
 
+        tracer.counter("search.candidates_explored", float(explored))
         best_cost, best_assign, _ = min(states.values(), key=lambda v: v[0])
         return best_cost, best_assign, hit_bound
 

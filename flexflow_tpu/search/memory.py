@@ -92,8 +92,11 @@ def optimize_with_memory_budget(
     fits, returns the minimum-memory assignment seen and logs a warning
     (the reference errors out of ``try_one_lambda`` similarly).
     """
+    from flexflow_tpu.obs import get_tracer
     from flexflow_tpu.search.cost import estimate_strategy_cost
     from flexflow_tpu.search.substitution import JointResult
+
+    tracer = get_tracer()
 
     def norm(res) -> JointResult:
         if isinstance(res, JointResult):
@@ -106,7 +109,12 @@ def optimize_with_memory_budget(
     def mem_of(r: JointResult) -> float:
         st = Strategy(mesh)
         st.ops = r.assign
-        return strategy_memory_per_device(r.layers, st, profiler=profiler)
+        m = strategy_memory_per_device(r.layers, st, profiler=profiler)
+        if m > mem_budget_bytes:
+            # λ-probe result exceeds the per-device HBM budget — the
+            # search's OOM rejection (reference try_one_lambda failure)
+            tracer.counter("search.oom_rejections")
+        return m
 
     def time_of(r: JointResult) -> float:
         st = Strategy(mesh)

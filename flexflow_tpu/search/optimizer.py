@@ -72,6 +72,7 @@ def unity_search(
     rewrites, the returned Strategy carries ``rewritten_layers`` /
     ``output_remap`` — callers must execute that layer list.
     """
+    from flexflow_tpu.obs import get_tracer
     from flexflow_tpu.search.candidates import SearchOptions, search_options
 
     if struct_xfers == "default":
@@ -79,7 +80,11 @@ def unity_search(
 
         struct_xfers = default_struct_xfers(inference=inference)
 
-    with search_options(options if options is not None else SearchOptions()):
+    with search_options(options if options is not None else SearchOptions()), \
+            get_tracer().span(
+                "unity_search", cat="search",
+                layers=len(layers), budget=budget, mesh=str(tuple(mesh.shape)),
+            ):
         return _unity_search_impl(
             layers, mesh, graph_inputs, budget, alpha, machine,
             mem_budget_bytes, explore_meshes, beam, profiler, mem_search_iters,
@@ -147,15 +152,21 @@ def _unity_search_impl(
             )
 
         try:
-            if mem_budget_bytes is not None:
-                res = optimize_with_memory_budget(
-                    run, layers, mv, mem_budget_bytes,
-                    iters=mem_search_iters, machine=machine,
-                    # measured per-op memory tier (CompiledMemoryStats)
-                    profiler=profiler,
-                )
-            else:
-                res = run(0.0)
+            from flexflow_tpu.obs import get_tracer
+
+            with get_tracer().span(
+                "search_mesh", cat="search", mesh=str(tuple(mv.shape)),
+            ) as sp:
+                if mem_budget_bytes is not None:
+                    res = optimize_with_memory_budget(
+                        run, layers, mv, mem_budget_bytes,
+                        iters=mem_search_iters, machine=machine,
+                        # measured per-op memory tier (CompiledMemoryStats)
+                        profiler=profiler,
+                    )
+                else:
+                    res = run(0.0)
+                sp.set(cost=res.cost)
         except ShardingError:
             # mesh factorization incompatible with the model's explicit
             # parallel-op attrs (fixed degree/axis) — skip, like the

@@ -117,6 +117,13 @@ def find_fusion_segments(layers: List[Layer]) -> Dict[int, List[Layer]]:
     return segs
 
 
+# Persisted --cost-cache schema version.  Bump whenever the cache KEY
+# derivation changes (e.g. the round-5 addition of local weight shapes):
+# stale-version entries are DISCARDED on load instead of silently never
+# hitting while old keys accumulate in the file.
+COST_CACHE_VERSION = 2
+
+
 class OpProfiler:
     """Compile-and-time profiler with a persistent cost cache.
 
@@ -124,6 +131,10 @@ class OpProfiler:
     the reference's (OperatorParameters, MachineView) hash.  Segment
     measurement (``measure_segment``) compiles a whole fusion chain as one
     program, keyed by every member's params and the anchor's local shapes.
+
+    Cache file format: ``{"version": N, "entries": {key: seconds}}``.
+    A version mismatch (or the legacy flat-dict format) discards the file's
+    entries wholesale — explicit invalidation beats silent misses.
     """
 
     def __init__(self, cache_file: Optional[str] = None, iters: int = 5) -> None:
@@ -140,12 +151,22 @@ class OpProfiler:
         if cache_file and os.path.exists(cache_file):
             with open(cache_file) as f:
                 loaded = json.load(f)
-            self.cache = {k: v for k, v in loaded.items() if v > 0}
+            entries = {}
+            if (
+                isinstance(loaded, dict)
+                and loaded.get("version") == COST_CACHE_VERSION
+                and isinstance(loaded.get("entries"), dict)
+            ):
+                entries = loaded["entries"]
+            self.cache = {k: v for k, v in entries.items() if v > 0}
 
     def save(self) -> None:
         if self.cache_file:
             with open(self.cache_file, "w") as f:
-                json.dump(self.cache, f, indent=1, sort_keys=True)
+                json.dump(
+                    {"version": COST_CACHE_VERSION, "entries": self.cache},
+                    f, indent=1, sort_keys=True,
+                )
 
     @staticmethod
     def _key(layer: Layer, local_in: List[Tuple[int, ...]]) -> str:
@@ -189,13 +210,17 @@ class OpProfiler:
         self, layer: Layer, sharding: Optional[OpSharding], mesh: MachineMesh
     ) -> float:
         """Seconds for one fwd+bwd of this op at its per-shard shapes."""
+        from flexflow_tpu.obs import get_tracer
+
         local_in = self._local_input_shapes(layer, sharding, mesh)
         local_w = self._local_weight_shapes(layer, sharding, mesh)
         key = self._key(layer, local_in) + repr(local_w)
         if key in self.cache:
+            get_tracer().counter("profiler.cache_hit")
             return self.cache[key]
         if key in self._failed:
             return -1.0
+        get_tracer().counter("profiler.cache_miss")
         t = self._run(layer, local_in, sharding, mesh)
         if t > 0:  # never persist the failure sentinel — retry next session
             self.cache[key] = t
@@ -295,10 +320,14 @@ class OpProfiler:
             tuple(local_in),
             None if out0 is None else out0.key(),
         ))
+        from flexflow_tpu.obs import get_tracer
+
         if key in self.cache:
+            get_tracer().counter("profiler.cache_hit")
             return self.cache[key]
         if key in self._failed:
             return -1.0
+        get_tracer().counter("profiler.cache_miss")
         t = self._run_segment(chain, local_in, sharding, mesh)
         if t > 0:
             self.cache[key] = t
@@ -659,6 +688,9 @@ def simulate_strategy(
         from flexflow_tpu.search.memory import strategy_memory_per_device
 
         if strategy_memory_per_device(layers, strategy) > mem_budget_bytes:
+            from flexflow_tpu.obs import get_tracer
+
+            get_tracer().counter("search.oom_rejections")
             return (float("inf"), []) if return_tasks else float("inf")
 
     # devices along axes no output sharding uses are exact replicas of

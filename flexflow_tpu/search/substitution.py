@@ -30,6 +30,7 @@ import itertools
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from flexflow_tpu.fftype import OperatorType
+from flexflow_tpu.obs import get_tracer
 from flexflow_tpu.ops.base import get_op_def
 from flexflow_tpu.parallel.machine import MachineMesh
 from flexflow_tpu.parallel.strategy import OpSharding, Strategy
@@ -501,6 +502,9 @@ def base_optimize(
             c = cost_of(n_lyrs, n_assign)
             if c < best_cost:
                 best_cost = c
+                if len(n_detail) > len(best.applied_detail):
+                    # a structural-rewrite variant took the lead
+                    get_tracer().counter("search.rewrites_applied")
                 best = JointResult(
                     c, n_assign, n_lyrs, n_remap,
                     tuple(d[0] for d in n_detail), n_detail, n_wmaps,
@@ -522,6 +526,7 @@ def base_optimize(
             res = apply_rewrite(lyrs, mr.match, rw)
             if res is None:
                 continue
+            get_tracer().counter("search.rewrites_considered")
             n_lyrs, guid_map, tmap = res
             alive = {int(l.layer_guid) for l in n_lyrs}
             n_assign = {

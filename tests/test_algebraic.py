@@ -117,6 +117,37 @@ def test_batch_sibling_convs_parity():
     assert sum(l.op_type is OperatorType.CONV2D for l in m2.layers) == 1
 
 
+def test_batch_siblings_initializer_identity_gates_merge():
+    """Siblings with DIFFERENT initializers must not merge: the batched
+    layer is born with match[0]'s initializers, so a pre-init application
+    would silently re-initialize the others from the wrong distribution.
+    Equal-but-separately-constructed initializers still merge."""
+    from flexflow_tpu import FFConfig, FFModel, NormInitializer, ZeroInitializer
+    from flexflow_tpu.search.algebraic import BatchSiblings
+
+    def mk(k_init_q, k_init_k):
+        m = FFModel(FFConfig(batch_size=16))
+        x = m.create_tensor((16, 32))
+        q = m.dense(x, 24, kernel_initializer=k_init_q, name="q")
+        k = m.dense(x, 24, kernel_initializer=k_init_k, name="k")
+        m.add(q, k)
+        return m
+
+    rule = BatchSiblings(OperatorType.LINEAR)
+    # differing distributions: no match
+    m = mk(NormInitializer(stddev=0.02), ZeroInitializer())
+    assert rule.find_matches(m.layers) == []
+    # same-parameter instances (built separately): merge
+    m = mk(NormInitializer(stddev=0.02), NormInitializer(stddev=0.02))
+    assert len(rule.find_matches(m.layers)) == 1
+    # both default (None): merge
+    m = mk(None, None)
+    assert len(rule.find_matches(m.layers)) == 1
+    # default vs explicit: no match (Glorot default vs zeros differ)
+    m = mk(None, ZeroInitializer())
+    assert rule.find_matches(m.layers) == []
+
+
 def test_fuse_activation_parity():
     def build(m):
         x = m.create_tensor((16, 32))

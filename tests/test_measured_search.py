@@ -55,10 +55,15 @@ def test_compile_with_measured_cost_populates_cache(tmp_path):
         optimizer=SGDOptimizer(lr=0.01),
         loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
     )
-    # the profiler cache was consulted, filled, and persisted
+    # the profiler cache was consulted, filled, and persisted (versioned
+    # format: {"version": N, "entries": {...}})
     assert os.path.exists(cache)
     with open(cache) as f:
-        entries = json.load(f)
+        doc = json.load(f)
+    from flexflow_tpu.search.simulator import COST_CACHE_VERSION
+
+    assert doc["version"] == COST_CACHE_VERSION
+    entries = doc["entries"]
     assert len(entries) > 0
     assert all(v > 0 for v in entries.values())
     # the searched model still trains
@@ -210,7 +215,7 @@ def test_segment_measurement_runs_real_chain(tmp_path):
     assert t_seg > 0
     prof.save()
     with open(tmp_path / "seg.json") as f:
-        cached = json.load(f)
+        cached = json.load(f)["entries"]
     assert any(k.startswith("('seg'") for k in cached), list(cached)
 
 
@@ -293,7 +298,7 @@ def test_measured_memory_tier(tmp_path):
     assert prof.measure_memory(dense, st.op_sharding(dense), mesh) == m
     prof.save()
     assert any(k.startswith("mem:") for k in
-               __import__("json").load(open(tmp_path / "mem.json")))
+               __import__("json").load(open(tmp_path / "mem.json"))["entries"])
 
     analytic = strategy_memory_per_device(model.layers, st)
     measured = strategy_memory_per_device(model.layers, st, profiler=prof)

@@ -58,6 +58,33 @@ def test_profiler_measures_and_caches(tmp_path):
     assert t3 == pytest.approx(t1)
 
 
+def test_cost_cache_version_invalidation(tmp_path):
+    """Stale-version (or legacy flat-format) --cost-cache files are
+    discarded on load instead of silently never hitting."""
+    import json
+
+    from flexflow_tpu.search.simulator import COST_CACHE_VERSION
+
+    cache = str(tmp_path / "costs.json")
+    # legacy flat format (pre-versioning)
+    with open(cache, "w") as f:
+        json.dump({"some-old-key": 1.0}, f)
+    assert OpProfiler(cache_file=cache).cache == {}
+    # explicit stale version
+    with open(cache, "w") as f:
+        json.dump(
+            {"version": COST_CACHE_VERSION - 1, "entries": {"k": 1.0}}, f
+        )
+    assert OpProfiler(cache_file=cache).cache == {}
+    # current version round-trips
+    prof = OpProfiler(cache_file=cache)
+    prof.cache = {"k": 2.0}
+    prof.save()
+    doc = json.load(open(cache))
+    assert doc["version"] == COST_CACHE_VERSION
+    assert OpProfiler(cache_file=cache).cache == {"k": 2.0}
+
+
 def test_profiler_sharded_shapes_faster_or_equal():
     """Per-shard local shapes are smaller => measured time shouldn't grow."""
     model = build_mlp(batch=256, d=256, hidden=1024)

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Render a flexflow_tpu Chrome-trace file into per-phase breakdown tables.
+
+Usage:
+    python tools/trace_report.py TRACE.json [--by {cat,name}] [--top N]
+
+Reads the ``--trace-out`` JSON (``{"traceEvents": [...], "flexflow_tpu":
+{"summary": {...}}}``, also loadable in chrome://tracing / Perfetto) and
+prints:
+
+  * a per-phase (event category) time breakdown — count, total ms,
+    mean ms, %% of traced wall time;
+  * a per-span-name breakdown (``--by name``, the default shows both);
+  * the counter table (jit cache hits, search candidates, OOM
+    rejections, ... — glossary in docs/OBSERVABILITY.md);
+  * gauge samples (frontier widths, memory snapshot) when present.
+
+Pure stdlib — runnable on a machine without jax installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> str:
+    if not rows:
+        return "  (empty)"
+    widths = [
+        max(len(str(h)), *(len(str(r[i])) for r in rows))
+        for i, h in enumerate(headers)
+    ]
+    def fmt(vals):
+        return "  " + "  ".join(str(v).ljust(w) for v, w in zip(vals, widths))
+    sep = "  " + "  ".join("-" * w for w in widths)
+    return "\n".join([fmt(headers), sep] + [fmt(r) for r in rows])
+
+
+def _aggregate(events: List[Dict], key: str) -> Dict[str, List[float]]:
+    """{bucket: [count, total_us]} over 'X' (complete) events."""
+    agg: Dict[str, List[float]] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        bucket = str(e.get(key, "?"))
+        a = agg.setdefault(bucket, [0, 0.0])
+        a[0] += 1
+        a[1] += float(e.get("dur", 0.0))
+    return agg
+
+
+def _breakdown(agg: Dict[str, List[float]], wall_us: float, label: str,
+               top: int) -> str:
+    rows = []
+    for bucket, (n, tot) in sorted(
+        agg.items(), key=lambda kv: -kv[1][1]
+    )[:top]:
+        rows.append([
+            bucket, int(n),
+            f"{tot / 1e3:.2f}", f"{tot / 1e3 / n:.3f}",
+            f"{100.0 * tot / wall_us:.1f}%" if wall_us > 0 else "-",
+        ])
+    return (
+        f"per-{label} time breakdown:\n"
+        + _table([label, "spans", "total_ms", "mean_ms", "% wall"], rows)
+    )
+
+
+def render(doc: Dict, by: str = "both", top: int = 40) -> str:
+    events = doc.get("traceEvents", [])
+    summary = (doc.get("flexflow_tpu") or {}).get("summary", {})
+    wall_us = float(summary.get("wall_s", 0.0)) * 1e6
+    if wall_us <= 0 and events:
+        wall_us = max(
+            (e.get("ts", 0.0) + e.get("dur", 0.0)) for e in events
+        ) - min(e.get("ts", 0.0) for e in events)
+
+    out = []
+    n_spans = sum(1 for e in events if e.get("ph") == "X")
+    out.append(
+        f"trace: {n_spans} spans, {len(events)} events, "
+        f"wall {wall_us / 1e6:.3f} s, level={summary.get('level', '?')}"
+    )
+    if by in ("cat", "both"):
+        out.append(_breakdown(_aggregate(events, "cat"), wall_us, "phase", top))
+    if by in ("name", "both"):
+        out.append(_breakdown(_aggregate(events, "name"), wall_us, "span", top))
+
+    counters = summary.get("counters")
+    if counters is None:  # fall back to final 'C' events
+        counters = {}
+        for e in events:
+            if e.get("ph") == "C":
+                for v in (e.get("args") or {}).values():
+                    counters[e["name"]] = v
+    if counters:
+        rows = [
+            [k, int(v) if float(v).is_integer() else f"{v:.3g}"]
+            for k, v in sorted(counters.items())
+        ]
+        out.append("counters:\n" + _table(["counter", "value"], rows))
+    samples = summary.get("samples") or {}
+    if samples:
+        rows = [
+            [k, int(s.get("count", 0)), f"{s.get('min', 0):.6g}",
+             f"{s.get('max', 0):.6g}", f"{s.get('last', 0):.6g}"]
+            for k, s in sorted(samples.items())
+        ]
+        out.append("gauges:\n" + _table(
+            ["gauge", "samples", "min", "max", "last"], rows
+        ))
+    return "\n\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="Chrome-trace JSON written by --trace-out")
+    ap.add_argument("--by", choices=("cat", "name", "both"), default="both")
+    ap.add_argument("--top", type=int, default=40,
+                    help="max rows per breakdown table")
+    args = ap.parse_args(argv)
+    with open(args.trace) as f:
+        doc = json.load(f)
+    print(render(doc, by=args.by, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

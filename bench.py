@@ -414,7 +414,13 @@ def run_bench(backend: str) -> None:
         "sps_min": head["sps_min"],
         "sps_max": head["sps_max"],
         "timing_windows": repeats,
-        # shared observability vocabulary (docs/OBSERVABILITY.md)
+        # shared observability vocabulary (docs/OBSERVABILITY.md): the
+        # same field names a --metrics-out training stream carries, so
+        # tools/bench_compare.py reads bench artifacts and metrics
+        # streams with one code path
+        "samples_per_s": round(samples_per_sec, 2),
+        "tokens_per_s": round(samples_per_sec * seq, 2),
+        "step_wall_s": round(head["step_time_ms"] / 1000.0, 6),
         "jit_compile_s": round(compile_stats.get("compile_s", 0.0), 3),
         "init_params_s": round(
             obs_summary["spans"].get("init_params", {}).get("total_s", 0.0), 3
@@ -427,6 +433,29 @@ def run_bench(backend: str) -> None:
     # is a hang, not an error) must not discard the measured number —
     # the parent salvages the last JSON line even on child timeout
     print(json.dumps(record), flush=True)
+
+    # optional per-run metrics record in the training-stream schema
+    # (--metrics-out): one step_record with the headline throughput, so
+    # bench runs land in the same JSONL timeline as training runs
+    metrics_out = os.environ.get("FFTPU_BENCH_METRICS_OUT")
+    if metrics_out:
+        import time as _time
+
+        from flexflow_tpu.obs import MetricsStream, step_record
+
+        stream = MetricsStream(metrics_out)
+        stream.append(step_record(
+            step=0,
+            t=_time.time(),
+            loss=None,
+            step_wall_s=record["step_wall_s"],
+            compile_s=record["jit_compile_s"],
+            jit_cache="miss",
+            samples=batch,
+            tokens=batch * seq,
+            metrics={"metric": record["metric"], "mfu": record["mfu"]},
+        ))
+        stream.close()
 
     # attention-core comparison (round-2 verdict item 1 done-condition):
     # flash vs XLA sdpa at s=512 and s=2048, fwd+bwd.  Chained-scan
@@ -505,6 +534,11 @@ def main() -> None:
     if "--run" in sys.argv:
         run_bench(os.environ.get("FFTPU_BENCH_BACKEND", "tpu"))
         return
+    if "--metrics-out" in sys.argv:
+        # forwarded to the child via env (the child owns the jax runtime)
+        os.environ["FFTPU_BENCH_METRICS_OUT"] = sys.argv[
+            sys.argv.index("--metrics-out") + 1
+        ]
     errors = []
     if "--cpu" in sys.argv:
         errors.append("cpu requested via --cpu flag")

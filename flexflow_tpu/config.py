@@ -69,6 +69,19 @@ class FFConfig:
     # path and granularity.  --trace-out alone implies level "step".
     trace_out: Optional[str] = None
     trace_level: str = "off"  # off | step | op
+    # --- run-health monitor (docs/OBSERVABILITY.md) ---
+    # per-step JSONL metrics stream (loss/grad-norm/throughput/counter
+    # deltas, one schema-versioned record per step)
+    metrics_out: Optional[str] = None
+    # anomaly policy: non-finite loss/grad + EMA loss-spike detectors.
+    # "dump"/"raise" write a debug bundle (config, strategy, last-N step
+    # records, Chrome trace, memory snapshot) on the first anomaly.
+    health: str = "off"  # off | warn | dump | raise
+    health_dir: str = "health_bundles"  # bundle output directory
+    health_window: int = 64  # flight-recorder ring size (last-N records)
+    health_spike_factor: float = 4.0  # loss > factor * EMA(loss) => spike
+    health_ema_decay: float = 0.9
+    health_warmup_steps: int = 5  # finite losses seeding the EMA baseline
     # --- simulator (reference config.h:127-136) ---
     machine_model_file: Optional[str] = None
     # measured cost tier: search candidates costed by compiling-and-timing
@@ -186,6 +199,16 @@ class FFConfig:
                 self.trace_out = take()
             elif a == "--trace-level":
                 self.trace_level = take()
+            elif a == "--metrics-out":
+                self.metrics_out = take()
+            elif a == "--health":
+                self.health = take()
+            elif a == "--health-dir":
+                self.health_dir = take()
+            elif a == "--health-window":
+                self.health_window = int(take())
+            elif a == "--health-spike-factor":
+                self.health_spike_factor = float(take())
             elif a == "--export-strategy" or a == "--export":
                 self.export_strategy_file = take()
             elif a == "--import-strategy" or a == "--import":

@@ -7,6 +7,7 @@ from flexflow_tpu.frontends.keras.callbacks import (
     Callback,
     EpochVerifyMetrics,
     LearningRateScheduler,
+    MetricsCallback,
     TraceCallback,
     VerifyMetrics,
 )
@@ -36,7 +37,7 @@ __all__ = [
     "Activation", "Adam", "Add", "AveragePooling2D", "BatchNormalization",
     "Callback", "Concatenate", "Conv2D", "Dense", "Dropout", "Embedding",
     "EpochVerifyMetrics", "Flatten", "Input", "LayerNormalization",
-    "LearningRateScheduler", "MaxPooling2D", "Model", "Multiply", "Reshape",
-    "SGD", "Sequential", "Subtract", "TraceCallback", "VerifyMetrics",
-    "layers",
+    "LearningRateScheduler", "MaxPooling2D", "MetricsCallback", "Model",
+    "Multiply", "Reshape", "SGD", "Sequential", "Subtract", "TraceCallback",
+    "VerifyMetrics", "layers",
 ]

@@ -121,6 +121,60 @@ class TraceCallback(Callback):
         return self._tracer().summary()
 
 
+class MetricsCallback(Callback):
+    """Wire the run-health monitor (``flexflow_tpu.obs.health``) into a
+    keras fit: a per-step JSONL metrics stream (``out_path``) and/or the
+    NaN/loss-spike detectors (``policy``), with the debug-bundle flight
+    recorder.  The per-step records are produced by the executor itself
+    (every ``train_step`` feeds the process monitor), so this callback
+    only configures the monitor and flushes the stream at train end —
+    the keras sibling of ``--metrics-out`` / ``--health``.
+
+    With neither ``out_path`` nor ``policy`` given, the callback records
+    into whatever monitor is already installed (e.g. by ``FFConfig``).
+    NOTE: configure the grad-norm diagnostics BEFORE the first training
+    step — the norms are baked into the jitted step program at its first
+    build."""
+
+    def __init__(
+        self,
+        out_path: Optional[str] = None,
+        policy: Optional[str] = None,
+        **monitor_kw,
+    ):
+        self.out_path = out_path
+        self.policy = policy
+        self.monitor_kw = monitor_kw
+
+    def _monitor(self):
+        from flexflow_tpu.obs import get_monitor
+
+        return get_monitor()
+
+    def on_train_begin(self, logs=None):
+        if self.out_path is not None or self.policy is not None:
+            from flexflow_tpu.obs import configure_monitor
+
+            configure_monitor(
+                policy=self.policy or "off",
+                metrics_out=self.out_path,
+                **self.monitor_kw,
+            )
+
+    def on_train_end(self, logs=None):
+        self._monitor().flush()
+
+    @property
+    def records(self):
+        """The flight-recorder ring (the last-N step records)."""
+        return list(self._monitor().ring)
+
+    @property
+    def bundle_path(self):
+        """Path of the debug bundle, if an anomaly dumped one."""
+        return self._monitor().bundle_path
+
+
 class EpochVerifyMetrics(Callback):
     """Stop early once an epoch reaches the target accuracy."""
 

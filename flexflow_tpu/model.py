@@ -21,6 +21,7 @@ What compile() does here vs the reference:
 
 from __future__ import annotations
 
+import dataclasses
 import math
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -40,7 +41,12 @@ from flexflow_tpu.fftype import (
 )
 from flexflow_tpu.initializer import Initializer
 from flexflow_tpu.metrics import Metrics, PerfMetrics
-from flexflow_tpu.obs import configure_from_config, get_tracer
+from flexflow_tpu.obs import (
+    configure_from_config,
+    configure_monitor_from_config,
+    get_monitor,
+    get_tracer,
+)
 from flexflow_tpu.ops.base import get_op_def
 from flexflow_tpu.optimizer import AdamOptimizer, Optimizer, SGDOptimizer
 from flexflow_tpu.parallel.machine import MachineMesh, default_mesh
@@ -79,6 +85,9 @@ class FFModel:
         # wire the process tracer BEFORE compile so search/compile spans
         # land in the trace (no-op when --trace-out/--trace-level unset)
         configure_from_config(self.config)
+        # ... and the run-health monitor (--metrics-out / --health);
+        # same contract: an off config leaves the current monitor alone
+        configure_monitor_from_config(self.config)
         # multi-host bootstrap before any device query (the reference starts
         # the Legion/GASNet runtime in the FFModel ctor, model.cc:1160).
         # Unconditional: initialize_distributed is a no-op when neither
@@ -776,6 +785,28 @@ class FFModel:
         )
         with get_tracer().span("init_params", cat="compile"):
             self.executor.init_params()
+        # run-health monitor context: what a debug bundle snapshots
+        # beyond the step stream.  Providers are evaluated at dump time,
+        # so a post-compile recompile()/optimize_for_inference() bundle
+        # reflects the strategy the run actually died under.
+        monitor = get_monitor()
+        if monitor.enabled:
+            cfg_doc = dataclasses.asdict(cfg)
+            cfg_doc["mesh"] = {
+                "shape": list(strategy.mesh.shape),
+                "axis_names": list(strategy.mesh.axis_names),
+            }
+            monitor.set_context(
+                config=cfg_doc,
+                strategy_provider=lambda: self.strategy.to_json(
+                    layers=self.layers
+                ),
+                memory_provider=lambda: (
+                    self.executor.memory_snapshot()
+                    if self.executor is not None
+                    else None
+                ),
+            )
 
     def _write_exports(self, cfg, strategy, machine, profiler) -> None:
         """Strategy/observability outputs (reference --export-strategy /
@@ -1019,6 +1050,7 @@ class FFModel:
                     )
         if jax.process_index() == 0:
             tracer.save()  # no-op without --trace-out
+        get_monitor().flush()  # fsync the metrics stream (no-op when off)
         return pm  # the FINAL epoch's metrics (reference parity)
 
     def eval(

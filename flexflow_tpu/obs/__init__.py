@@ -1,11 +1,31 @@
-"""Unified tracing & telemetry (see docs/OBSERVABILITY.md).
+"""Unified tracing, telemetry & run health (see docs/OBSERVABILITY.md).
 
 ``get_tracer()`` returns the process-wide :class:`Tracer`; the runtime,
 search, and fit loops record spans/counters into it, and ``--trace-out``
 exports Chrome-trace JSON readable by chrome://tracing / Perfetto and by
 ``tools/trace_report.py``.
+
+``get_monitor()`` returns the process-wide :class:`HealthMonitor` — the
+per-step metrics stream (``--metrics-out`` JSONL), the NaN/loss-spike
+detectors (``--health``), and the debug-bundle flight recorder.
 """
 
+from flexflow_tpu.obs.health import (
+    HEALTH_POLICIES,
+    HealthError,
+    HealthMonitor,
+    SpikeDetector,
+    configure_monitor,
+    configure_monitor_from_config,
+    get_monitor,
+    set_monitor,
+)
+from flexflow_tpu.obs.metrics import (
+    METRICS_SCHEMA,
+    MetricsStream,
+    read_metrics,
+    step_record,
+)
 from flexflow_tpu.obs.trace import (
     CORE_COUNTERS,
     LEVELS,
@@ -24,4 +44,16 @@ __all__ = [
     "configure_from_config",
     "CORE_COUNTERS",
     "LEVELS",
+    "HealthMonitor",
+    "HealthError",
+    "SpikeDetector",
+    "HEALTH_POLICIES",
+    "get_monitor",
+    "set_monitor",
+    "configure_monitor",
+    "configure_monitor_from_config",
+    "MetricsStream",
+    "METRICS_SCHEMA",
+    "read_metrics",
+    "step_record",
 ]

@@ -1,0 +1,349 @@
+"""Run-health monitor: anomaly detectors + flight recorder + crash bundles.
+
+FlexFlow's loop is measurement-driven — a chosen strategy is only as
+trustworthy as what we can observe about the run.  This module watches
+the per-step scalar stream (loss, grad norm — computed INSIDE the jitted
+step, see ``runtime/executor.py``) and, when a step goes bad, freezes the
+evidence: a **debug bundle** directory holding the config, the chosen
+strategy, the last-N step records from a bounded ring buffer, the Chrome
+trace so far, and the compiled step's ``memory_analysis()`` snapshot.
+The failure-diagnosis emphasis of ReCycle and MegaScale's always-on
+telemetry (PAPERS.md) are the models: a bad step must be diagnosable
+from artifacts alone, without a re-run.
+
+Detectors (active when ``--health`` is not ``off``):
+  * non-finite — loss or grad-norm is NaN/Inf
+  * loss spike — loss exceeds ``spike_factor`` x EMA(loss) after a
+    warmup of finite observations (EMA over finite losses only, so one
+    NaN doesn't poison the baseline)
+
+Policies (``--health off|warn|dump|raise``):
+  * ``warn``  — print one warning line + a tracer instant event
+  * ``dump``  — warn + write the debug bundle (at most ONE per run; a
+    diverged run would otherwise dump every subsequent step)
+  * ``raise`` — dump + raise :class:`HealthError` out of ``train_step``
+
+Like the tracer, ONE process-wide monitor (``get_monitor()``); the
+executor's untraced fast path checks a single ``enabled`` attribute, so
+a disabled monitor costs nothing (pinned by
+``tests/test_health.py::test_disabled_monitor_zero_overhead``).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import math
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from flexflow_tpu.obs.metrics import (
+    MetricsStream,
+    hbm_high_water,
+    json_safe,
+    step_record,
+)
+from flexflow_tpu.obs.trace import get_tracer
+
+HEALTH_POLICIES = ("off", "warn", "dump", "raise")
+
+
+class HealthError(RuntimeError):
+    """Raised out of ``train_step`` under the ``raise`` policy.  Carries
+    the bundle path so a driver can point at the evidence."""
+
+    def __init__(self, reason: str, step: int, bundle_path: Optional[str]):
+        self.reason = reason
+        self.step = step
+        self.bundle_path = bundle_path
+        at = f" (bundle: {bundle_path})" if bundle_path else ""
+        super().__init__(f"run-health anomaly {reason!r} at step {step}{at}")
+
+
+class SpikeDetector:
+    """EMA loss-spike detector — the math is isolated here so the test
+    suite can pin it independently of the monitor plumbing.
+
+    ``observe(loss)`` returns True when the spike fires: loss exceeds
+    ``factor * ema`` AFTER ``warmup`` finite observations have seeded
+    the EMA.  Non-finite losses neither fire the spike (the non-finite
+    detector owns those) nor update the EMA."""
+
+    def __init__(self, factor: float = 4.0, decay: float = 0.9, warmup: int = 5):
+        assert factor > 1.0 and 0.0 < decay < 1.0 and warmup >= 1
+        self.factor = factor
+        self.decay = decay
+        self.warmup = warmup
+        self.ema: Optional[float] = None
+        self.seen = 0
+
+    def observe(self, loss: Optional[float]) -> bool:
+        if loss is None or not math.isfinite(loss):
+            return False
+        fired = (
+            self.seen >= self.warmup
+            and self.ema is not None
+            and loss > self.factor * self.ema
+        )
+        if not fired:  # a spike is excluded from its own baseline
+            self.ema = (
+                loss
+                if self.ema is None
+                else self.decay * self.ema + (1.0 - self.decay) * loss
+            )
+            self.seen += 1
+        return fired
+
+
+class HealthMonitor:
+    """Flight recorder + detectors + bundle writer (see module doc)."""
+
+    def __init__(
+        self,
+        policy: str = "off",
+        stream: Optional[MetricsStream] = None,
+        bundle_dir: str = "health_bundles",
+        window: int = 64,
+        spike_factor: float = 4.0,
+        ema_decay: float = 0.9,
+        warmup_steps: int = 5,
+    ):
+        assert policy in HEALTH_POLICIES, (
+            f"health policy must be one of {HEALTH_POLICIES}, got {policy!r}"
+        )
+        self.policy = policy
+        self.stream = stream or MetricsStream(None)
+        # detectors run only under an explicit policy; a bare
+        # --metrics-out records the stream without judging it
+        self.detecting = policy != "off"
+        self.enabled = self.detecting or self.stream.enabled
+        # grad/param norms are worth their in-step compute whenever the
+        # monitor is on at all — the stream without them is half-blind
+        self.wants_diagnostics = self.enabled
+        self.bundle_dir = bundle_dir
+        self.ring: collections.deque = collections.deque(maxlen=max(1, window))
+        self.spike = SpikeDetector(spike_factor, ema_decay, warmup_steps)
+        self.anomalies: List[Dict[str, Any]] = []
+        self.bundle_path: Optional[str] = None  # set by the ONE dump
+        self._context: Dict[str, Any] = {}
+        self._last_counters: Dict[str, float] = {}
+        self._primary: Optional[bool] = None  # lazy: is this process 0?
+
+    def _is_primary(self) -> bool:
+        """Multi-host runs share the filesystem: only process 0 writes
+        the stream/bundle (detectors still run everywhere — the loss is
+        replicated, so a ``raise`` fires consistently on all hosts).
+        Resolved lazily because the monitor is configured before the
+        distributed runtime initializes."""
+        if self._primary is None:
+            try:
+                import jax
+
+                self._primary = jax.process_index() == 0
+            except Exception:
+                self._primary = True
+        return self._primary
+
+    # --- wiring ------------------------------------------------------------
+    def set_context(
+        self,
+        config: Optional[Dict[str, Any]] = None,
+        strategy_provider: Optional[Callable[[], str]] = None,
+        memory_provider: Optional[Callable[[], Optional[Dict[str, Any]]]] = None,
+    ) -> None:
+        """Attach what a bundle needs beyond the step stream.  Providers
+        are callables evaluated AT DUMP TIME (the strategy/memory state
+        the run died with, not the one it compiled with)."""
+        if config is not None:
+            self._context["config"] = config
+        if strategy_provider is not None:
+            self._context["strategy"] = strategy_provider
+        if memory_provider is not None:
+            self._context["memory"] = memory_provider
+
+    def counter_deltas(self, counters: Dict[str, float]) -> Dict[str, float]:
+        """Per-step deltas of the tracer's cumulative counters; only
+        counters that moved appear in the record."""
+        out = {
+            k: v - self._last_counters.get(k, 0.0)
+            for k, v in counters.items()
+            if v != self._last_counters.get(k, 0.0)
+        }
+        self._last_counters = dict(counters)
+        return out
+
+    # --- per-step hook ------------------------------------------------------
+    def observe_step(
+        self,
+        stats: Dict[str, Any],
+        loss: float,
+        metrics: Dict[str, float],
+        samples: Optional[int] = None,
+        tokens: Optional[int] = None,
+    ) -> Optional[str]:
+        """Record one step and run the detectors.  ``stats`` is the
+        executor's ``last_step_stats`` dict; ``metrics`` may carry the
+        in-step ``grad_norm``/``param_norm`` scalars.  Returns the
+        anomaly reason (after applying the policy) or None."""
+        metrics = dict(metrics)
+        grad_norm = metrics.pop("grad_norm", None)
+        param_norm = metrics.pop("param_norm", None)
+        tracer = get_tracer()
+        rec = step_record(
+            step=stats["step"],
+            t=time.time(),
+            loss=loss,
+            grad_norm=grad_norm,
+            param_norm=param_norm,
+            step_wall_s=stats.get("total_s"),
+            host_s=stats.get("host_s"),
+            dispatch_s=stats.get("dispatch_s"),
+            device_s=stats.get("device_s"),
+            compile_s=stats.get("compile_s"),
+            jit_cache=stats.get("jit_cache"),
+            samples=samples,
+            tokens=tokens,
+            hbm_peak_bytes=hbm_high_water(),
+            counters=self.counter_deltas(dict(tracer.counters)),
+            metrics=metrics,
+        )
+        self.ring.append(rec)
+        if self._is_primary():
+            self.stream.append(rec)
+        if not self.detecting:
+            return None
+        reason = None
+        if loss is not None and not math.isfinite(loss):
+            reason = "non_finite_loss"
+        elif grad_norm is not None and not math.isfinite(grad_norm):
+            reason = "non_finite_grad"
+        elif self.spike.observe(loss):
+            reason = "loss_spike"
+        if reason is None:
+            return None
+        return self._on_anomaly(reason, rec)
+
+    # --- anomaly handling ---------------------------------------------------
+    def _on_anomaly(self, reason: str, rec: Dict[str, Any]) -> str:
+        step = rec["step"]
+        if len(self.anomalies) < 1000:  # a diverged run trips every step
+            self.anomalies.append({"reason": reason, "step": step})
+        tracer = get_tracer()
+        tracer.instant(
+            "health_anomaly", cat="health", reason=reason, step=step
+        )
+        print(
+            f"[health] {reason} at step {step}: loss={rec.get('loss')} "
+            f"grad_norm={rec.get('grad_norm')} (policy={self.policy})",
+            flush=True,
+        )
+        path = None
+        if self.policy in ("dump", "raise"):
+            path = self.dump_bundle(reason, rec)
+        if self.policy == "raise":
+            raise HealthError(reason, step, path or self.bundle_path)
+        return reason
+
+    def dump_bundle(self, reason: str, rec: Dict[str, Any]) -> Optional[str]:
+        """Write the debug bundle directory; at most ONE per run (a
+        diverged run trips the detector on every subsequent step — the
+        first bundle holds the onset, which is the diagnostic one)."""
+        if self.bundle_path is not None or not self._is_primary():
+            return None
+        name = f"bundle_step{int(rec['step']):06d}_{reason}"
+        path = os.path.join(self.bundle_dir, name)
+        os.makedirs(path, exist_ok=True)
+
+        def put(fname, doc):
+            try:
+                with open(os.path.join(path, fname), "w") as f:
+                    if isinstance(doc, str):
+                        f.write(doc)
+                    else:
+                        json.dump(doc, f, indent=1, default=str)
+            except Exception as e:  # one broken artifact must not lose the rest
+                print(f"[health] bundle artifact {fname} failed: {e}", flush=True)
+
+        put("anomaly.json", {
+            "reason": reason,
+            "step": rec["step"],
+            "record": json_safe(rec),
+            "wall_time": time.time(),
+            "anomalies_so_far": self.anomalies,
+        })
+        if "config" in self._context:
+            put("config.json", self._context["config"])
+        if "strategy" in self._context:
+            try:
+                put("strategy.json", self._context["strategy"]())
+            except Exception as e:
+                put("strategy.json", {"error": str(e)})
+        if "memory" in self._context:
+            try:
+                mem = self._context["memory"]()
+            except Exception as e:
+                mem = {"error": str(e)}
+            if mem is not None:
+                put("memory_analysis.json", mem)
+        # last-N step records, newest last — JSONL like the live stream
+        tail = "\n".join(
+            json.dumps(json_safe(r), default=str) for r in self.ring
+        )
+        put("metrics_tail.jsonl", tail + "\n")
+        # the trace so far — valid Chrome-trace JSON even when the tracer
+        # is disabled (empty traceEvents + metadata)
+        put("trace.json", get_tracer().to_chrome_trace())
+        self.bundle_path = path
+        print(f"[health] debug bundle written: {path}", flush=True)
+        return path
+
+    def flush(self) -> None:
+        self.stream.close()
+
+
+# --- process-wide singleton -------------------------------------------------
+_MONITOR = HealthMonitor()  # disabled: the fast path sees enabled=False
+
+
+def get_monitor() -> HealthMonitor:
+    return _MONITOR
+
+
+def set_monitor(monitor: HealthMonitor) -> HealthMonitor:
+    global _MONITOR
+    _MONITOR = monitor
+    return _MONITOR
+
+
+def configure_monitor(
+    policy: str = "warn",
+    metrics_out: Optional[str] = None,
+    **kw,
+) -> HealthMonitor:
+    """Install a fresh monitor as the process monitor."""
+    return set_monitor(
+        HealthMonitor(policy=policy, stream=MetricsStream(metrics_out), **kw)
+    )
+
+
+def configure_monitor_from_config(cfg) -> HealthMonitor:
+    """Wire the process monitor to ``FFConfig`` (``--metrics-out`` /
+    ``--health`` / ``--health-dir`` / ``--health-window`` /
+    ``--health-spike-factor``).  A config with everything off leaves the
+    current monitor untouched, so an explicitly configured monitor
+    survives auxiliary FFModel constructions (same contract as
+    ``configure_from_config`` for the tracer)."""
+    policy = getattr(cfg, "health", "off")
+    out = getattr(cfg, "metrics_out", None)
+    if policy == "off" and not out:
+        return _MONITOR
+    return configure_monitor(
+        policy=policy,
+        metrics_out=out,
+        bundle_dir=getattr(cfg, "health_dir", "health_bundles"),
+        window=getattr(cfg, "health_window", 64),
+        spike_factor=getattr(cfg, "health_spike_factor", 4.0),
+        ema_decay=getattr(cfg, "health_ema_decay", 0.9),
+        warmup_steps=getattr(cfg, "health_warmup_steps", 5),
+    )

@@ -1,0 +1,207 @@
+"""Per-step metrics stream: one schema-versioned JSONL record per step.
+
+The PR-1 tracer answers "what happened when" (spans, counters); this
+module adds the time-series layer — "is this run healthy and is it
+getting slower" — mirroring the reference's periodic throughput prints
+(``src/metrics_functions/metrics_functions.cc:213-216``) and the
+Chrome-trace-style per-step telemetry of MegaScale-class tooling
+(PAPERS.md).  Every consumer (``FFModel.fit`` via the HealthMonitor,
+the keras ``MetricsCallback``, ``bench.py``, ``tools/bench_compare.py``)
+reads and writes the SAME record vocabulary, so a bench artifact and a
+training stream are directly comparable.
+
+Record schema (``METRICS_SCHEMA``; see docs/OBSERVABILITY.md):
+  * identity — ``schema`` (version tag), ``step``, ``t`` (unix time)
+  * health scalars — ``loss``, ``grad_norm``, ``param_norm`` (the norms
+    are computed INSIDE the jitted step and cost one scalar fetch; null
+    when the monitor ran without diagnostics)
+  * throughput — ``samples_per_s``, ``tokens_per_s`` (null when the
+    model has no sequence dim), ``step_wall_s``, ``host_s``,
+    ``dispatch_s``, ``device_s``, ``compile_s``, ``jit_cache``
+  * memory — ``hbm_peak_bytes`` (``device.memory_stats()`` high-water
+    when the backend reports one, else null)
+  * ``counters`` — tracer counter DELTAS since the previous record
+  * ``metrics`` — the step's metric dict (accuracy etc.)
+
+Records are append-only JSONL: one JSON object per line, so a crashed
+run still leaves every completed step parseable (a trailing partial
+line is skipped by :func:`read_metrics`).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Dict, List, Optional
+
+# bump when a field changes meaning; ADDING fields is compatible and
+# does not bump (consumers must ignore unknown keys)
+METRICS_SCHEMA = "ffmetrics/1"
+
+# the full record vocabulary, pre-seeded to None so every record carries
+# every key — a consumer can distinguish "not measured" from "missing"
+RECORD_FIELDS = (
+    "step",
+    "t",
+    "loss",
+    "grad_norm",
+    "param_norm",
+    "samples_per_s",
+    "tokens_per_s",
+    "step_wall_s",
+    "host_s",
+    "dispatch_s",
+    "device_s",
+    "compile_s",
+    "jit_cache",
+    "hbm_peak_bytes",
+)
+
+
+def json_safe(v):
+    """JSON has no NaN/Inf literal; encode non-finite floats as strings
+    (round-trip restored by read_metrics) so an anomalous record — the
+    one a crash bundle exists to capture — is still STRICT valid JSON.
+    Recursive: the nested counters/metrics dicts can carry them too."""
+    if isinstance(v, float) and not math.isfinite(v):
+        return "NaN" if math.isnan(v) else ("Inf" if v > 0 else "-Inf")
+    if isinstance(v, dict):
+        return {k: json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [json_safe(x) for x in v]
+    return v
+
+
+def _unclean(v):
+    if v == "NaN":
+        return float("nan")
+    if v == "Inf":
+        return float("inf")
+    if v == "-Inf":
+        return float("-inf")
+    if isinstance(v, dict):
+        return {k: _unclean(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_unclean(x) for x in v]
+    return v
+
+
+def step_record(
+    step: int,
+    t: float,
+    loss: Optional[float] = None,
+    grad_norm: Optional[float] = None,
+    param_norm: Optional[float] = None,
+    step_wall_s: Optional[float] = None,
+    host_s: Optional[float] = None,
+    dispatch_s: Optional[float] = None,
+    device_s: Optional[float] = None,
+    compile_s: Optional[float] = None,
+    jit_cache: Optional[str] = None,
+    samples: Optional[int] = None,
+    tokens: Optional[int] = None,
+    hbm_peak_bytes: Optional[float] = None,
+    counters: Optional[Dict[str, float]] = None,
+    metrics: Optional[Dict[str, float]] = None,
+) -> Dict[str, Any]:
+    """Build one schema-conformant step record.  Throughput is derived
+    here from (samples, tokens, step_wall_s) — the ONE place the
+    division lives, shared by training streams and ``bench.py``."""
+    rec: Dict[str, Any] = {"schema": METRICS_SCHEMA}
+    rec.update({k: None for k in RECORD_FIELDS})
+    rec["step"] = int(step)
+    rec["t"] = float(t)
+    for k, v in (
+        ("loss", loss),
+        ("grad_norm", grad_norm),
+        ("param_norm", param_norm),
+        ("step_wall_s", step_wall_s),
+        ("host_s", host_s),
+        ("dispatch_s", dispatch_s),
+        ("device_s", device_s),
+        ("compile_s", compile_s),
+        ("hbm_peak_bytes", hbm_peak_bytes),
+    ):
+        if v is not None:
+            rec[k] = float(v)
+    if jit_cache is not None:
+        rec["jit_cache"] = str(jit_cache)
+    if step_wall_s and step_wall_s > 0:
+        if samples is not None:
+            rec["samples_per_s"] = samples / step_wall_s
+        if tokens is not None:
+            rec["tokens_per_s"] = tokens / step_wall_s
+    rec["counters"] = dict(counters) if counters else {}
+    rec["metrics"] = dict(metrics) if metrics else {}
+    return rec
+
+
+def hbm_high_water() -> Optional[float]:
+    """Peak device-memory bytes from ``device.memory_stats()`` when the
+    backend exposes it (TPU/GPU do; CPU returns None).  Max over local
+    devices — the binding constraint is the fullest chip."""
+    try:
+        import jax
+
+        peaks = []
+        for d in jax.local_devices():
+            ms = d.memory_stats()
+            if ms:
+                v = ms.get("peak_bytes_in_use", ms.get("bytes_in_use"))
+                if v is not None:
+                    peaks.append(float(v))
+        return max(peaks) if peaks else None
+    except Exception:  # pragma: no cover - backend quirks must not kill a step
+        return None
+
+
+class MetricsStream:
+    """Append-only JSONL writer for step records.
+
+    Opened lazily on the first append (a configured-but-never-stepped
+    run leaves no file) and flushed per record — the stream is a flight
+    recorder, so its whole point is surviving the crash that ends the
+    run."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self.enabled = bool(path)
+        self.records_written = 0
+        self._f = None
+
+    def append(self, record: Dict[str, Any]) -> None:
+        if not self.enabled:
+            return
+        if self._f is None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._f = open(self.path, "a")
+        json.dump(json_safe(record), self._f)
+        self._f.write("\n")
+        self._f.flush()
+        self.records_written += 1
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def read_metrics(path: str) -> List[Dict[str, Any]]:
+    """Parse a metrics JSONL file back into records (non-finite floats
+    restored).  A trailing partial line — the signature of a hard crash
+    mid-write — is skipped, everything before it is returned."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line
+            out.append({k: _unclean(v) for k, v in rec.items()})
+    return out

@@ -42,7 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from flexflow_tpu.fftype import LossType, OperatorType
 from flexflow_tpu.loss import get_loss_fn
 from flexflow_tpu.metrics import Metrics
-from flexflow_tpu.obs import get_tracer
+from flexflow_tpu.obs import get_monitor, get_tracer
 from flexflow_tpu.ops.base import OpContext, get_op_def
 from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
 from flexflow_tpu.optimizer import Optimizer
@@ -121,6 +121,16 @@ class Executor:
         self.last_step_stats: Optional[Dict[str, Any]] = None
         self._step_compiled = None  # AOT executable (traced path only)
         self._fwd_seqs_seen: set = set()  # fwd jit-cache hit/miss tracking
+        # run-health monitor vocabulary: samples (and tokens when the
+        # first input carries a sequence dim) consumed per step — the
+        # numerators of the stream's samples_per_s / tokens_per_s
+        b = graph_inputs[0].shape[0] if graph_inputs else None
+        self._samples_per_step = b
+        self._tokens_per_step = (
+            b * graph_inputs[0].shape[1]
+            if graph_inputs and graph_inputs[0].ndim >= 2
+            else None
+        )
 
     # --- sharding helpers --------------------------------------------------
     def _constrain(self, x: jax.Array, pspec: PartitionSpec) -> jax.Array:
@@ -403,6 +413,21 @@ class Executor:
         # back to a host-passed counter so the rng stream still advances.
         opt_has_step = isinstance(self.opt_state, dict) and "step" in self.opt_state
         self._opt_has_step = opt_has_step
+        # run-health diagnostics: global grad/param L2 norms computed
+        # INSIDE the step program (two scalar outputs fused into the
+        # existing metrics fetch — near-zero marginal device cost, zero
+        # cost when the monitor is off).  Captured at build time; the
+        # LR-scheduler's `_step_jit = None` retrace picks up changes.
+        diagnostics = get_monitor().wants_diagnostics
+
+        def global_norm(tree):
+            sq = sum(
+                jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+                for leaf in jax.tree.leaves(tree)
+                if hasattr(leaf, "dtype")
+                and jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+            )
+            return jnp.sqrt(sq)
 
         def step(params, state, opt_state, inputs, labels, host_step):
             cnt = opt_state["step"] if opt_has_step else host_step
@@ -426,6 +451,10 @@ class Executor:
                     self._zero1_constrain, new_opt, self._zero1_specs
                 )
             m = metrics.compute(logits, labels) if metrics else {}
+            if diagnostics:
+                m = dict(m)
+                m["grad_norm"] = global_norm(grads)
+                m["param_norm"] = global_norm(new_params)
             return new_params, new_state, new_opt, loss, m
 
         donate = (0, 1, 2)
@@ -445,7 +474,7 @@ class Executor:
     # --- public API --------------------------------------------------------
     def train_step(self, inputs: Sequence[Any], labels: Any) -> Tuple[float, Dict[str, float]]:
         tracer = get_tracer()
-        if not (tracer.enabled or self.profiling):
+        if not (tracer.enabled or self.profiling or get_monitor().enabled):
             # fast path — no clock reads, no forced device sync (async
             # dispatch stays pipelined).  An AOT executable left by an
             # earlier instrumented step (e.g. bench.py's compile-capture
@@ -553,18 +582,40 @@ class Executor:
             "compile_s": compile_s,
             "jit_cache": "miss" if compile_s else "hit",
         }
+        # run-health monitor: feed the flight recorder / detectors.  The
+        # float() fetches are the monitor's documented per-step cost (the
+        # block_until_ready above already synced, so they are host copies
+        # of ready scalars, not fresh device round-trips).  A "raise"
+        # policy propagates HealthError out of this call AFTER the step's
+        # results were committed above — the bundle captures the state
+        # the run died with.
+        monitor = get_monitor()
+        if monitor.enabled:
+            monitor.observe_step(
+                self.last_step_stats,
+                float(loss),
+                {k: float(v) for k, v in m.items()},
+                samples=self._samples_per_step,
+                tokens=self._tokens_per_step,
+            )
         return loss, m
 
-    def _record_memory_snapshot(self, tracer) -> None:
+    def memory_snapshot(self) -> Optional[Dict[str, float]]:
         """Device-memory footprint of the compiled step from XLA's actual
         buffer assignment (``compiled.memory_analysis()`` — the same
-        source the search's measured memory tier reads)."""
+        source the search's measured memory tier reads).  None when no
+        AOT executable exists yet or the backend reports nothing.  Feeds
+        both the tracer gauges and the health monitor's debug bundle."""
+        compiled = self._step_compiled
+        if compiled is None or compiled is self._step_jit:
+            return None
         try:
-            ma = self._step_compiled.memory_analysis()
+            ma = compiled.memory_analysis()
         except Exception:
-            return
+            return None
         if ma is None:
-            return
+            return None
+        out: Dict[str, float] = {}
         for field in (
             "temp_size_in_bytes",
             "argument_size_in_bytes",
@@ -573,10 +624,18 @@ class Executor:
         ):
             v = getattr(ma, field, None)
             if v is not None:
-                tracer.sample(
-                    "memory." + field.replace("_size_in_bytes", "_bytes"),
-                    float(v), level="step",
-                )
+                out[field] = float(v)
+        return out or None
+
+    def _record_memory_snapshot(self, tracer) -> None:
+        snap = self.memory_snapshot()
+        if not snap:
+            return
+        for field, v in snap.items():
+            tracer.sample(
+                "memory." + field.replace("_size_in_bytes", "_bytes"),
+                v, level="step",
+            )
 
     def forward(
         self, inputs: Sequence[Any], seq_length: Optional[int] = None

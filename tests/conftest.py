@@ -9,7 +9,16 @@ first dispatch and hangs the whole suite whenever the TPU tunnel is
 unreachable.
 """
 
+import faulthandler
 import os
+
+# Suite-crash canary (VERDICT r5 weak #5): a round-5 full-suite run died
+# with a bare `Fatal Python error` and no traceback.  faulthandler dumps
+# every thread's Python stack on SIGSEGV/SIGFPE/SIGABRT/SIGBUS — next
+# time the crash leaves evidence.  (Tier-1 docs also set
+# PYTHONFAULTHANDLER=1 so crashes during interpreter startup, before
+# this conftest imports, are covered too.)
+faulthandler.enable()
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")

@@ -1,0 +1,333 @@
+"""Run-health monitor: metrics stream, NaN/spike flight recorder, crash
+bundles, and the bench regression gate (docs/OBSERVABILITY.md).
+
+Acceptance-pinning tests: an injected non-finite loss in a tiny training
+run produces EXACTLY ONE debug bundle containing config, strategy, step
+records, and a valid Chrome trace; ``tools/bench_compare.py`` flags a
+synthetic 20% throughput regression against ``BENCH_r05.json`` and
+passes on the real recorded numbers.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    ActiMode,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.obs import (
+    HealthError,
+    HealthMonitor,
+    MetricsStream,
+    SpikeDetector,
+    Tracer,
+    configure_monitor,
+    get_monitor,
+    read_metrics,
+    set_monitor,
+    set_tracer,
+    step_record,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_obs():
+    """Monitor and tracer are process-wide: restore the disabled
+    defaults after every test so an enabled monitor never leaks (it
+    switches the executor onto the instrumented step path)."""
+    yield
+    set_monitor(HealthMonitor())
+    set_tracer(Tracer())
+
+
+def _fit_mlp(x, y, epochs=1, **cfg_kw):
+    cfg = FFConfig(batch_size=16, **cfg_kw)
+    model = FFModel(cfg)
+    t = model.create_tensor((16, 32), name="x")
+    t = model.dense(t, 64, ActiMode.RELU, name="fc1")
+    t = model.dense(t, 10, name="fc2")
+    model.softmax(t, name="probs")
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    model.fit(x, y, epochs=epochs, verbose=False)
+    return model
+
+
+def _data(n=32, bad=False):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 32)).astype(np.float32)
+    if bad:
+        x[0, 0] = np.nan  # poisons every batch-0 activation -> NaN loss
+    y = rng.integers(0, 10, size=(n, 1)).astype(np.int32)
+    return x, y
+
+
+# ------------------------------------------------------------- detectors
+def test_spike_detector_ema_math():
+    det = SpikeDetector(factor=2.0, decay=0.5, warmup=3)
+    for _ in range(3):  # warmup: constant loss seeds the EMA
+        assert det.observe(1.0) is False
+    assert det.ema == pytest.approx(1.0)
+    assert det.observe(1.5) is False  # 1.5 < 2*1.0: no spike
+    assert det.ema == pytest.approx(0.5 * 1.0 + 0.5 * 1.5)  # EMA advanced
+    assert det.observe(3.0) is True  # 3.0 > 2*1.25: spike
+    assert det.ema == pytest.approx(1.25)  # a spike never joins its baseline
+    assert det.observe(1.0) is False  # recovery keeps running
+    assert det.ema == pytest.approx(0.5 * 1.25 + 0.5 * 1.0)
+
+
+def test_spike_detector_ignores_non_finite():
+    det = SpikeDetector(factor=2.0, decay=0.5, warmup=2)
+    det.observe(1.0)
+    det.observe(1.0)
+    ema = det.ema
+    assert det.observe(float("nan")) is False  # non-finite owns its own detector
+    assert det.observe(float("inf")) is False
+    assert det.ema == ema and det.seen == 2  # baseline unpoisoned
+
+
+def test_ring_buffer_bound():
+    mon = HealthMonitor(policy="warn", window=8)
+    for i in range(20):
+        mon.observe_step({"step": i, "total_s": 0.1}, loss=1.0, metrics={})
+    assert len(mon.ring) == 8
+    assert [r["step"] for r in mon.ring] == list(range(12, 20))
+
+
+# ------------------------------------------------------- stream / schema
+def test_jsonl_schema_round_trip(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    stream = MetricsStream(path)
+    rec = step_record(
+        step=3, t=123.0, loss=float("nan"), grad_norm=float("inf"),
+        param_norm=2.5, step_wall_s=0.5, samples=16, tokens=512,
+        jit_cache="hit", counters={"jit.cache_hit": 1.0},
+        metrics={"accuracy": 0.5},
+    )
+    stream.append(rec)
+    stream.append(step_record(step=4, t=124.0, loss=0.25))
+    stream.close()
+    back = read_metrics(path)
+    assert len(back) == 2
+    r = back[0]
+    assert r["schema"] == "ffmetrics/1"
+    assert r["step"] == 3
+    assert math.isnan(r["loss"])  # non-finite floats survive the round trip
+    assert math.isinf(r["grad_norm"])
+    assert r["samples_per_s"] == pytest.approx(16 / 0.5)
+    assert r["tokens_per_s"] == pytest.approx(512 / 0.5)
+    assert r["counters"] == {"jit.cache_hit": 1.0}
+    assert r["metrics"] == {"accuracy": 0.5}
+    assert back[1]["loss"] == 0.25
+    assert back[1]["jit_cache"] is None  # full vocabulary, null when unmeasured
+
+
+def test_metrics_stream_from_fit(tmp_path):
+    """--metrics-out on a healthy fit: one record per step with loss,
+    in-step grad/param norms, throughput, and timing split."""
+    out = str(tmp_path / "steps.jsonl")
+    x, y = _data(64)
+    _fit_mlp(x, y, epochs=2, metrics_out=out)
+    recs = read_metrics(out)
+    assert len(recs) == 8  # 4 batches x 2 epochs
+    for i, r in enumerate(recs):
+        assert r["step"] == i
+        assert math.isfinite(r["loss"])
+        assert r["grad_norm"] is not None and math.isfinite(r["grad_norm"])
+        assert r["param_norm"] is not None and r["param_norm"] > 0
+        assert r["samples_per_s"] > 0
+        assert r["step_wall_s"] >= r["device_s"] >= 0
+        assert r["jit_cache"] in ("hit", "miss")
+        assert "accuracy" in r["metrics"]
+    # monitor without an explicit policy records but never judges
+    assert get_monitor().anomalies == []
+    assert get_monitor().bundle_path is None
+
+
+# ----------------------------------------------------- anomaly -> bundle
+def test_injected_nan_dumps_exactly_one_bundle(tmp_path):
+    """THE acceptance scenario: a NaN loss mid-training writes one debug
+    bundle with config, strategy, step records, and a valid Chrome
+    trace — and only one, despite every subsequent step being bad."""
+    bundles = str(tmp_path / "bundles")
+    out = str(tmp_path / "steps.jsonl")
+    x, y = _data(64, bad=True)
+    _fit_mlp(
+        x, y, epochs=2,
+        health="dump", health_dir=bundles, metrics_out=out,
+        trace_level="step",
+    )
+    mon = get_monitor()
+    assert len(mon.anomalies) >= 2  # every step tripped the detector...
+    dirs = os.listdir(bundles)
+    assert len(dirs) == 1  # ...but only the onset dumped
+    bdir = os.path.join(bundles, dirs[0])
+    assert dirs[0].startswith("bundle_step") and "non_finite" in dirs[0]
+
+    anomaly = json.load(open(os.path.join(bdir, "anomaly.json")))
+    assert anomaly["reason"].startswith("non_finite")
+    assert anomaly["record"]["loss"] == "NaN"  # JSON-safe encoding
+
+    cfg_doc = json.load(open(os.path.join(bdir, "config.json")))
+    assert cfg_doc["health"] == "dump" and cfg_doc["batch_size"] == 16
+    assert "mesh" in cfg_doc
+
+    strategy = json.loads(open(os.path.join(bdir, "strategy.json")).read())
+    assert strategy  # importable Strategy JSON (dict with assignments)
+
+    tail = [
+        json.loads(ln)
+        for ln in open(os.path.join(bdir, "metrics_tail.jsonl"))
+        if ln.strip()
+    ]
+    assert len(tail) >= 1 and tail[-1]["step"] == anomaly["step"]
+
+    trace = json.load(open(os.path.join(bdir, "trace.json")))
+    assert isinstance(trace["traceEvents"], list)
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert "train_step" in names  # real spans, not just metadata
+    assert "health_anomaly" in names  # the detector left its marker
+
+
+def test_raise_policy_raises_health_error(tmp_path):
+    x, y = _data(32, bad=True)
+    with pytest.raises(HealthError) as ei:
+        _fit_mlp(
+            x, y, health="raise", health_dir=str(tmp_path / "b"),
+        )
+    assert ei.value.reason == "non_finite_loss"
+    assert ei.value.bundle_path and os.path.isdir(ei.value.bundle_path)
+
+
+def test_warn_policy_never_writes(tmp_path, capsys):
+    x, y = _data(32, bad=True)
+    _fit_mlp(x, y, health="warn", health_dir=str(tmp_path / "b"))
+    assert not os.path.exists(str(tmp_path / "b"))
+    assert "[health] non_finite_loss" in capsys.readouterr().out
+
+
+def test_loss_spike_detection_via_monitor():
+    """End-to-end spike path through observe_step (synthetic stats)."""
+    mon = set_monitor(HealthMonitor(
+        policy="warn", spike_factor=2.0, ema_decay=0.5, warmup_steps=3,
+    ))
+    reasons = [
+        mon.observe_step({"step": i, "total_s": 0.1}, loss=l, metrics={})
+        for i, l in enumerate([1.0, 1.0, 1.0, 1.1, 9.0, 1.0])
+    ]
+    assert reasons[4] == "loss_spike"
+    assert [r for r in reasons if r] == ["loss_spike"]
+
+
+# ------------------------------------------------------- zero overhead
+def test_disabled_monitor_zero_overhead(tmp_path):
+    """Default config: monitor disabled -> the executor takes the
+    untraced fast path, records nothing, writes nothing."""
+    cwd_before = set(os.listdir("."))
+    x, y = _data(32)
+    model = _fit_mlp(x, y)
+    mon = get_monitor()
+    assert not mon.enabled and not mon.wants_diagnostics
+    assert len(mon.ring) == 0
+    assert mon.stream.records_written == 0
+    assert model.last_step_stats() is None  # fast path: no forced sync
+    assert set(os.listdir(".")) == cwd_before
+    # and the step program carries no diagnostics outputs
+    loss, m = model.executor.train_step([x[:16]], y[:16])
+    assert "grad_norm" not in m
+
+
+# -------------------------------------------------- bench_compare gate
+BENCH_COMPARE = os.path.join(REPO, "tools", "bench_compare.py")
+BENCH_R05 = os.path.join(REPO, "BENCH_r05.json")
+
+
+def _run_gate(*args):
+    return subprocess.run(
+        [sys.executable, BENCH_COMPARE, *args],
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+def test_bench_compare_passes_on_real_numbers(tmp_path):
+    r = _run_gate(BENCH_R05, "--baseline", BENCH_R05)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PASS" in r.stdout
+
+
+def test_bench_compare_flags_synthetic_regression(tmp_path):
+    """A 20% throughput drop vs BENCH_r05.json must gate (exit 1)."""
+    base = json.load(open(BENCH_R05))["parsed"]
+    cur = json.loads(json.dumps(base))
+    cur["value"] = round(base["value"] * 0.8, 2)
+    cur_path = str(tmp_path / "current.json")
+    json.dump(cur, open(cur_path, "w"))
+    r = _run_gate(cur_path, "--baseline", BENCH_R05)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "REGRESSED" in r.stdout and "throughput" in r.stdout
+
+
+def test_bench_compare_secondary_metrics_gated(tmp_path):
+    base = json.load(open(BENCH_R05))["parsed"]
+    cur = json.loads(json.dumps(base))
+    cur["secondary"]["gpt_decode"]["cached_tok_per_s"] = round(
+        base["secondary"]["gpt_decode"]["cached_tok_per_s"] * 0.5, 2
+    )
+    cur_path = str(tmp_path / "current.json")
+    json.dump(cur, open(cur_path, "w"))
+    r = _run_gate(cur_path, "--baseline", BENCH_R05)
+    assert r.returncode == 1
+    assert "gpt_decode_cached" in r.stdout
+
+
+def test_bench_compare_backend_mismatch_is_not_a_regression(tmp_path):
+    """A CPU-fallback run never gates against a TPU baseline."""
+    base = json.load(open(BENCH_R05))["parsed"]
+    cur = json.loads(json.dumps(base))
+    cur["backend"] = "tpu"
+    cur["value"] = 0.01  # would be a catastrophic "regression"
+    cur_path = str(tmp_path / "current.json")
+    json.dump(cur, open(cur_path, "w"))
+    r = _run_gate(cur_path, "--baseline", BENCH_R05)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = _run_gate(cur_path, "--baseline", BENCH_R05, "--strict")
+    assert r.returncode == 1
+
+
+# ------------------------------------------------------- keras frontend
+def test_keras_metrics_callback(tmp_path):
+    from flexflow_tpu.frontends import keras as ff_keras
+
+    out = str(tmp_path / "keras_steps.jsonl")
+    model = ff_keras.Sequential([
+        ff_keras.Dense(16, activation="relu"),
+        ff_keras.Dense(4, activation="softmax"),
+    ])
+    model.compile(optimizer=ff_keras.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(32, 1)).astype(np.int32)
+    cb = ff_keras.MetricsCallback(out_path=out, policy="warn")
+    model.fit(x, y, batch_size=16, epochs=2, callbacks=[cb], verbose=False)
+    recs = read_metrics(out)
+    assert len(recs) == 4  # 2 batches x 2 epochs
+    assert all(math.isfinite(r["loss"]) for r in recs)
+    assert cb.records and cb.records[-1]["step"] == 3
+    assert cb.bundle_path is None

@@ -1,0 +1,104 @@
+"""Calibration of the measured memory tier (ADVICE r5).
+
+The λ memory search prices a strategy by summing per-op measured temp
+bytes (``OpProfiler.measure_memory`` — XLA ``CompiledMemoryStats`` of
+each op compiled in isolation, ``search/simulator.py``).  The ground
+truth for a whole step is the compiled step program's own
+``memory_analysis()`` (what ``Executor.memory_snapshot`` reports).  The
+two CANNOT agree exactly — the whole step fuses across op boundaries,
+shares residuals, and adds optimizer temporaries the per-op tier never
+sees — but the per-op sum must stay a sane predictor, not drift into
+fiction.  This test pins the observed error band; the band itself is
+documented in docs/OBSERVABILITY.md.
+
+Observed on the CPU backend (jax 0.9-era, 3-dense MLP below): per-op
+sum ≈ 0.6x the whole-graph temp bytes — the whole step carries the
+backward+optimizer temporaries that dominate at these sizes.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    ActiMode,
+    FFConfig,
+    FFModel,
+    LossType,
+    MachineMesh,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.obs import Tracer, configure, set_tracer
+from flexflow_tpu.search.simulator import OpProfiler
+
+
+@pytest.fixture(autouse=True)
+def _reset_tracer():
+    yield
+    set_tracer(Tracer())
+
+
+def _mlp(batch=16):
+    model = FFModel(FFConfig(batch_size=batch))
+    t = model.create_tensor((batch, 32), name="x")
+    t = model.dense(t, 64, ActiMode.RELU, name="fc1")
+    t = model.dense(t, 32, ActiMode.RELU, name="fc2")
+    t = model.dense(t, 10, name="fc3")
+    model.softmax(t, name="probs")
+    return model
+
+
+def test_per_op_temp_sum_vs_whole_graph_memory():
+    mesh = MachineMesh((1,), ("data",))
+    model = _mlp()
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+        mesh=mesh,
+    )
+
+    # per-op measured tier: every op of this model must compile in
+    # isolation (fallbacks here would silently hollow out the claim)
+    prof = OpProfiler(iters=1)
+    per_op = {}
+    for layer in model.layers:
+        b = prof.measure_memory(layer, None, mesh)
+        assert b > 0, f"{layer.name} fell back to the analytic tier"
+        per_op[layer.name] = b
+    op_sum = sum(per_op.values())
+
+    # whole-graph: the instrumented step path compiles AOT, then
+    # memory_snapshot reads the step executable's buffer assignment
+    configure(level="step")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 32)).astype(np.float32)
+    y = rng.integers(0, 10, size=(16, 1)).astype(np.int32)
+    model.executor.train_step([x], y)
+    snap = model.executor.memory_snapshot()
+    if snap is None or not snap.get("temp_size_in_bytes"):
+        pytest.skip("backend reports no compiled memory stats")
+    whole = snap["temp_size_in_bytes"]
+
+    ratio = op_sum / whole
+    # the documented error band (docs/OBSERVABILITY.md): the per-op sum
+    # may under-count (fusion, optimizer temps live only in the full
+    # step) or over-count (residuals shared across ops are charged per
+    # op), but an order-of-magnitude drift means the tier is broken
+    assert 0.2 <= ratio <= 5.0, (
+        f"per-op temp sum {op_sum:.0f}B vs whole-graph {whole:.0f}B "
+        f"(ratio {ratio:.2f}) outside the calibrated band [0.2, 5.0]; "
+        f"per-op: {per_op}"
+    )
+
+
+def test_memory_snapshot_none_before_compile():
+    """memory_snapshot is None until the instrumented path built an AOT
+    executable (the fast path never compiles one)."""
+    model = _mlp()
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        mesh=MachineMesh((1,), ("data",)),
+    )
+    assert model.executor.memory_snapshot() is None

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Bench regression gate: diff a bench/metrics JSON against the
+``BENCH_r0*.json`` trajectory and exit non-zero past a threshold.
+
+The round artifacts record the throughput of record per round; this tool
+makes "is this build getting slower" a CI-checkable question instead of
+a judge's eyeball pass.  It understands three input shapes:
+
+  * a raw ``bench.py`` output record (``{"metric": ..., "value": ...}``)
+  * a round artifact wrapper (``{"n": 5, "parsed": {...}}``)
+  * a ``--metrics-out`` JSONL stream (``ffmetrics/1`` records; the last
+    record with a ``samples_per_s`` becomes the headline)
+
+Comparisons are backend-matched ONLY: a CPU-fallback run is never gated
+against a TPU baseline (different hardware, not a regression).  The
+measured metrics on both sides:
+
+  * headline ``value`` (samples/s, higher is better)
+  * ``secondary.dlrm.samples_per_sec``, ``secondary.bert_large.samples_per_sec``
+  * ``secondary.gpt_decode.cached_tok_per_s``
+
+Usage:
+  python tools/bench_compare.py CURRENT.json                 # vs newest same-backend BENCH_r0*.json
+  python tools/bench_compare.py CURRENT.json --baseline BENCH_r05.json
+  python tools/bench_compare.py CURRENT.json --threshold 0.2
+  python tools/bench_compare.py CURRENT.json --strict        # missing baseline is a failure
+
+Exit codes: 0 = within threshold (or no comparable baseline, unless
+--strict), 1 = regression past threshold, 2 = input error.
+
+The default threshold (15%) sits above the documented run-to-run
+variance of the tunneled link (BENCH artifacts show ±10% between
+windows) — tighten with --threshold when the link is direct.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_THRESHOLD = 0.15
+
+# (label, path into the record, higher_is_better) — the gated metrics
+GATED = (
+    ("throughput", ("value",), True),
+    ("dlrm", ("secondary", "dlrm", "samples_per_sec"), True),
+    ("bert_large", ("secondary", "bert_large", "samples_per_sec"), True),
+    ("gpt_decode_cached", ("secondary", "gpt_decode", "cached_tok_per_s"), True),
+)
+
+
+def _dig(d: Any, path: Tuple[str, ...]) -> Optional[float]:
+    for k in path:
+        if not isinstance(d, dict) or d.get(k) is None:
+            return None
+        d = d[k]
+    return float(d) if isinstance(d, (int, float)) else None
+
+
+def load_record(path: str) -> Optional[Dict[str, Any]]:
+    """Normalize any of the three input shapes into a bench record."""
+    text = open(path).read().strip()
+    # JSONL metrics stream: last record carrying a throughput
+    if "\n" in text or text.startswith('{"schema"'):
+        best = None
+        for line in text.splitlines():
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and rec.get("schema", "").startswith("ffmetrics/"):
+                if rec.get("samples_per_s") is not None:
+                    best = rec
+        if best is not None:
+            return {
+                "metric": "metrics_stream",
+                "value": best["samples_per_s"],
+                "backend": best.get("metrics", {}).get("backend", "unknown"),
+            }
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        return None
+    if not isinstance(doc, dict):
+        return None
+    if "parsed" in doc:  # round artifact wrapper
+        doc = doc["parsed"]
+    if isinstance(doc, dict) and "value" in doc:
+        return doc
+    return None
+
+
+def find_baselines(root: str) -> List[Tuple[str, Dict[str, Any]]]:
+    """Every parseable BENCH_r0*.json, oldest→newest."""
+    out = []
+    for p in sorted(glob.glob(os.path.join(root, "BENCH_r[0-9]*.json"))):
+        rec = load_record(p)
+        if rec is not None and rec.get("value"):
+            out.append((p, rec))
+    return out
+
+
+def compare(
+    current: Dict[str, Any],
+    baseline: Dict[str, Any],
+    threshold: float,
+) -> List[Dict[str, Any]]:
+    """Per-metric comparison rows; a row regresses when the current
+    value falls more than ``threshold`` below the baseline."""
+    rows = []
+    for label, path, _higher in GATED:
+        base = _dig(baseline, path)
+        cur = _dig(current, path)
+        if base is None or cur is None or base <= 0:
+            continue
+        ratio = cur / base
+        rows.append({
+            "metric": label,
+            "baseline": base,
+            "current": cur,
+            "ratio": ratio,
+            "regressed": ratio < (1.0 - threshold),
+        })
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("current", help="bench record / round artifact / metrics JSONL")
+    ap.add_argument("--baseline", action="append", default=None,
+                    help="baseline file(s); default: BENCH_r0*.json in --repo-root")
+    ap.add_argument("--repo-root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help=f"max tolerated fractional drop (default {DEFAULT_THRESHOLD})")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail when no comparable (same-backend) baseline exists")
+    args = ap.parse_args(argv)
+
+    current = load_record(args.current)
+    if current is None:
+        print(f"bench_compare: cannot parse {args.current}", file=sys.stderr)
+        return 2
+    backend = current.get("backend", "unknown")
+
+    if args.baseline:
+        baselines = []
+        for p in args.baseline:
+            rec = load_record(p)
+            if rec is None:
+                print(f"bench_compare: cannot parse baseline {p}", file=sys.stderr)
+                return 2
+            baselines.append((p, rec))
+    else:
+        baselines = find_baselines(args.repo_root)
+
+    # backend-matched only — newest matching artifact is the gate
+    matched = [(p, r) for p, r in baselines if r.get("backend") == backend]
+    if not matched:
+        msg = (f"bench_compare: no {backend!r}-backend baseline among "
+               f"{len(baselines)} candidate(s); nothing to gate against")
+        print(msg)
+        return 1 if args.strict else 0
+    base_path, base = matched[-1]
+
+    rows = compare(current, base, args.threshold)
+    if not rows:
+        print(f"bench_compare: no shared metrics between {args.current} "
+              f"and {base_path}")
+        return 1 if args.strict else 0
+
+    print(f"bench_compare: current={args.current} baseline={base_path} "
+          f"backend={backend} threshold={args.threshold:.0%}")
+    bad = 0
+    for r in rows:
+        verdict = "REGRESSED" if r["regressed"] else "ok"
+        bad += r["regressed"]
+        print(f"  {r['metric']:<20} {r['baseline']:>12.2f} -> "
+              f"{r['current']:>12.2f}  ({r['ratio']:.2%} of baseline)  {verdict}")
+    if bad:
+        print(f"bench_compare: {bad} metric(s) regressed more than "
+              f"{args.threshold:.0%} — FAIL")
+        return 1
+    print("bench_compare: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -396,10 +396,22 @@ def run_bench(backend: str) -> None:
     device_kind = jax.devices()[0].device_kind
     peak = _peak_flops(device_kind) if on_tpu else None
     mfu = (step_flops / (head["step_time_ms"] / 1000.0) / peak) if peak else None
+    # machine-model identity ("preset:v5e" / "file:<sha256/12>" /
+    # "default:..."): compile() priced this run's strategy against this
+    # model, and tools/bench_compare.py refuses to gate runs priced
+    # against different topologies
+    from flexflow_tpu.search.cost import TPUMachineModel
+
+    machine_id = (
+        TPUMachineModel.from_file(cfg.machine_model_file).source
+        if cfg.machine_model_file
+        else TPUMachineModel.detect().source
+    )
     record = {
         "metric": "bert_base_train_throughput",
         "value": round(samples_per_sec, 2),
         "unit": "samples/s",
+        "machine_model": machine_id,
         # the baseline is the TPU number of record; a CPU-fallback
         # run is NOT on-target, so report null rather than 1.0
         "vs_baseline": 1.0 if on_tpu else None,

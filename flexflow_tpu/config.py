@@ -83,6 +83,9 @@ class FFConfig:
     health_ema_decay: float = 0.9
     health_warmup_steps: int = 5  # finite losses seeding the EMA baseline
     # --- simulator (reference config.h:127-136) ---
+    # v1 flat scalars or the v2 multi-slice schema (slices, per-axis ICI
+    # link classes, DCN uplinks/contention) — docs/MACHINE_MODEL.md; the
+    # loader dispatches on the file's "version" key
     machine_model_file: Optional[str] = None
     # measured cost tier: search candidates costed by compiling-and-timing
     # ops on device (the reference's default behavior,

@@ -766,6 +766,15 @@ class FFModel:
         # the filesystem/stdout; the reference's exports run in the
         # singleton GRAPH_OPTIMIZE task, mapper.cc:274)
         if jax.process_index() == 0:
+            if cfg.profiling and getattr(machine, "decision_stats", None):
+                ds = machine.decision_stats
+                print(
+                    f"[machine-model] {machine.source}: collective routing "
+                    f"decisions ring={ds['ring']} "
+                    f"hierarchical={ds['hierarchical']} "
+                    f"(min(ring, hierarchical) per slice-crossing "
+                    f"collective, docs/MACHINE_MODEL.md)"
+                )
             self._write_exports(cfg, strategy, machine, profiler)
 
         self.executor = Executor(

@@ -50,6 +50,8 @@ CORE_COUNTERS = (
     "profiler.cache_hit",
     "profiler.cache_miss",
     "checkpoint.bytes_written",
+    "network.ring_collectives",
+    "network.hierarchical_collectives",
 )
 
 

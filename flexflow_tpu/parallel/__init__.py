@@ -1,4 +1,28 @@
 from flexflow_tpu.parallel.machine import MachineMesh, PhysicalTopology
 from flexflow_tpu.parallel.spec import ParallelDim, TensorSharding
 
-__all__ = ["MachineMesh", "ParallelDim", "PhysicalTopology", "TensorSharding"]
+# network.py subclasses search.cost.TPUMachineModel, and search.cost itself
+# imports parallel.machine (which initializes this package) — so the network
+# names load lazily (PEP 562) to keep the import graph acyclic.
+_NETWORK_NAMES = (
+    "LinkClass",
+    "NetworkedMachineModel",
+    "SliceTopology",
+    "load_machine_model",
+)
+
+__all__ = [
+    "MachineMesh",
+    "ParallelDim",
+    "PhysicalTopology",
+    "TensorSharding",
+    *_NETWORK_NAMES,
+]
+
+
+def __getattr__(name):
+    if name in _NETWORK_NAMES:
+        from flexflow_tpu.parallel import network
+
+        return getattr(network, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -87,6 +87,17 @@ class PhysicalTopology:
         and per-ring bandwidth drops by s.  Greedy largest-axis-first, so
         the biggest axes land on the full-bandwidth embeddings.
         """
+        d = self.assign_detail(logical_shape)
+        if d is None:
+            return None
+        return {i: (n, mult) for i, (n, mult, _) in d.items()}
+
+    def assign_detail(self, logical_shape: Sequence[int]):
+        """Like :meth:`assign` but each entry is ``(n, link_mult, dims)``
+        where ``dims`` is the tuple of physical-dim indices the axis's
+        embedding occupies — per-dim link classes
+        (:class:`~flexflow_tpu.parallel.network.SliceTopology`) price an
+        axis by the slowest link among its dims."""
         sizes = list(logical_shape)
         if math.prod(sizes) > self.size:
             return None
@@ -97,7 +108,7 @@ class PhysicalTopology:
         remaining = list(self.dims)  # remaining split capacity per dim
         splits = [1] * len(self.dims)  # product of split factors taken
         whole = [True] * len(self.dims)  # dim not yet split/used
-        out = {i: (1, 1.0) for i in range(len(sizes)) if sizes[i] == 1}
+        out = {i: (1, 1.0, ()) for i in range(len(sizes)) if sizes[i] == 1}
         nd = len(self.dims)
 
         def take_whole(pick):
@@ -125,7 +136,7 @@ class PhysicalTopology:
                     continue
                 if math.prod(self.dims[i] for i in pick) != a:
                     continue
-                out[ax] = (a, take_whole(pick))
+                out[ax] = (a, take_whole(pick), tuple(pick))
                 if rec(k + 1):
                     return True
                 untake_whole(pick)
@@ -139,7 +150,7 @@ class PhysicalTopology:
                     remaining[i] //= a
                     splits[i] *= a
                     whole[i] = False
-                    out[ax] = (a, mult)
+                    out[ax] = (a, mult, (i,))
                     if rec(k + 1):
                         return True
                     splits[i] //= a
@@ -163,7 +174,7 @@ class PhysicalTopology:
                     remaining[j] //= r
                     splits[j] *= r
                     whole[j] = False
-                    out[ax] = (a, 1.0)
+                    out[ax] = (a, 1.0, tuple(pick) + (j,))
                     if rec(k + 1):
                         return True
                     splits[j] //= r

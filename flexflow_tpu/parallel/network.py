@@ -1,0 +1,478 @@
+"""Multi-slice networked machine model — topology-aware collective pricing.
+
+The reference drives its search with a ``NetworkedMachineModel`` built from
+explicit per-link topology matrices and routing strategies
+(``include/flexflow/simulator.h:212-605``, ``src/runtime/network.cc``,
+config file ``machine_config_example``).  The TPU analog here models a pod
+as **N slices × a per-slice ICI torus**, where each ICI dimension carries
+its own link class (bandwidth + per-phase latency), slices connect through
+per-host DCN uplinks, and every slice-crossing collective chooses between
+two routings:
+
+  * **flat ring** — one ring threaded through all ``n`` participants; the
+    slice-boundary hop is a single chip-pair flow, so it rides ONE host's
+    aggregate uplink bandwidth and the whole ring is bottlenecked by it.
+  * **hierarchical** — intra-slice reduce-scatter over ICI, inter-slice
+    collective over DCN on the scattered shards (``m`` parallel flows
+    spread over every host's uplinks), intra-slice all-gather.  Pays two
+    extra phase latencies but moves ``1/m`` of the bytes per uplink-set
+    and engages ``hosts_per_slice`` uplink sets in parallel.
+
+Collectives are priced ``min(ring, hierarchical)``; the decision is
+tallied in :attr:`NetworkedMachineModel.decision_stats` and exported to
+the tracer (``network.ring_collectives`` /
+``network.hierarchical_collectives``) by :meth:`flush_decisions`.
+
+Concurrent slice-crossing collectives share uplink bandwidth:
+``dcn_contention`` divides the effective per-host uplink rate (the
+analytic stand-in for the event simulator's serialized comm streams,
+where true overlap cannot arise).
+
+The v2 ``--machine-model-file`` schema (see docs/MACHINE_MODEL.md and
+``examples/machine_configs/v5p_2slice.json``) is the
+``machine_config_example`` analog; v1 flat files (no ``"version"`` key)
+keep loading as the scalar :class:`TPUMachineModel`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Dict, Optional, Tuple
+
+from flexflow_tpu.parallel.machine import MachineMesh, PhysicalTopology
+
+# --machine-model-file schema version this module reads/writes.  v1 files
+# carry no "version" key and load through the legacy flat-scalar path.
+MACHINE_MODEL_SCHEMA_VERSION = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkClass:
+    """One ICI link class: per-direction bandwidth (bytes/s) and the
+    per-collective-phase latency (s) of a ring over links of this class."""
+
+    bw: float
+    latency: float = 1e-6
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """Per-slice ICI torus: dims + wraparound + a link class per dim.
+
+    The per-dim link classes are what the flat ``PhysicalTopology`` cannot
+    express — e.g. a v5p 4×4×4 cube whose z-dim rides fewer optical links,
+    or twisted-torus builds where one axis is degraded.
+    """
+
+    dims: Tuple[int, ...]
+    wrap: Tuple[bool, ...] = ()
+    links: Tuple[LinkClass, ...] = ()
+
+    def __post_init__(self) -> None:
+        if not self.wrap:
+            object.__setattr__(self, "wrap", tuple(False for _ in self.dims))
+        if not self.links:
+            object.__setattr__(
+                self, "links", tuple(LinkClass(9e10) for _ in self.dims)
+            )
+        assert len(self.wrap) == len(self.dims)
+        assert len(self.links) == len(self.dims)
+
+    @property
+    def size(self) -> int:
+        return math.prod(self.dims)
+
+    @property
+    def grid(self) -> PhysicalTopology:
+        return PhysicalTopology(self.dims, self.wrap)
+
+    def embed(self, shape) -> Optional[Dict[int, "AxisEmbedding"]]:
+        """Map logical axis sizes onto the slice grid; each axis is priced
+        by the slowest link among the physical dims it occupies, scaled by
+        the torus-ring/strided-split multiplier (``assign_detail``)."""
+        detail = self.grid.assign_detail(shape)
+        if detail is None:
+            return None
+        out = {}
+        for ax, (n, mult, dims) in detail.items():
+            if dims:
+                bw = min(self.links[d].bw for d in dims) * mult
+                lat = max(self.links[d].latency for d in dims)
+            else:  # size-1 axis: never collectived, placeholder class
+                bw = max(l.bw for l in self.links)
+                lat = min(l.latency for l in self.links)
+            out[ax] = AxisEmbedding(n=n, bw=bw, latency=lat)
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisEmbedding:
+    """One logical axis's intra-slice embedding: size, effective ring
+    bandwidth, per-phase latency."""
+
+    n: int
+    bw: float
+    latency: float
+
+
+@dataclasses.dataclass(frozen=True)
+class _AxisBinding:
+    """Per-mesh-axis binding produced by ``for_mesh``: the inter-slice
+    factor (1 = entirely intra-slice) and the intra-slice link terms."""
+
+    slices: int
+    intra: int
+    bw: float
+    lat: float
+
+
+def _networked_base():
+    from flexflow_tpu.search.cost import TPUMachineModel
+
+    return TPUMachineModel
+
+
+class NetworkedMachineModel(_networked_base()):
+    """Drop-in for :class:`TPUMachineModel` with multi-slice topology-aware
+    collective pricing (see module docstring).  All search/cost/simulator
+    call sites interact through the shared interface: ``legal_mesh`` /
+    ``for_mesh`` / ``all_reduce`` / ``all_gather`` / ``reduce_scatter`` /
+    ``all_to_all`` plus the roofline scalars ``peak_flops``/``hbm_bw``."""
+
+    def __init__(
+        self,
+        slice_topology: SliceTopology,
+        num_slices: int = 1,
+        hosts_per_slice: int = 1,
+        peak_flops: float = 4.59e14,
+        hbm_bw: float = 2.765e12,
+        dcn_bw_per_uplink: float = 6.25e9,  # bytes/s per uplink direction
+        dcn_uplinks_per_host: int = 1,
+        dcn_latency: float = 1e-5,  # per-phase DCN collective latency (s)
+        dcn_contention: int = 1,  # concurrent slice-crossing collectives
+        dcn_axes: Tuple[str, ...] = ("data",),
+        latency: float = 1e-6,
+    ) -> None:
+        assert num_slices >= 1 and hosts_per_slice >= 1
+        super().__init__(
+            peak_flops=peak_flops,
+            hbm_bw=hbm_bw,
+            ici_bw=max(l.bw for l in slice_topology.links),
+            dcn_bw=dcn_bw_per_uplink * dcn_uplinks_per_host,
+            latency=latency,
+            dcn_latency=dcn_latency,
+            dcn_axes=tuple(dcn_axes),
+            topology=slice_topology.grid,
+        )
+        self.slice_topology = slice_topology
+        self.num_slices = num_slices
+        self.hosts_per_slice = hosts_per_slice
+        self.dcn_bw_per_uplink = dcn_bw_per_uplink
+        self.dcn_uplinks_per_host = dcn_uplinks_per_host
+        self.dcn_contention = max(1, int(dcn_contention))
+        # ring-vs-hierarchical tallies, SHARED with every for_mesh clone so
+        # the root model observes the whole search's routing decisions
+        self.decision_stats = {"ring": 0, "hierarchical": 0}
+        self._flushed = {"ring": 0, "hierarchical": 0}
+        self._axis_bind: Dict[str, _AxisBinding] = {}
+
+    # --- capacity / DCN rates ---------------------------------------------
+    @property
+    def total_devices(self) -> int:
+        return self.num_slices * self.slice_topology.size
+
+    @property
+    def host_dcn_bw(self) -> float:
+        """ONE host's aggregate uplink bandwidth under the declared
+        contention — the flat ring's slice-boundary bottleneck."""
+        return (
+            self.dcn_uplinks_per_host * self.dcn_bw_per_uplink
+            / self.dcn_contention
+        )
+
+    def _slice_dcn_bw(self, m: int) -> float:
+        """Aggregate cross-slice bandwidth for ``m`` parallel per-chip
+        flows: at most ``hosts_per_slice`` uplink sets engage."""
+        return min(max(1, m), self.hosts_per_slice) * self.host_dcn_bw
+
+    # --- mesh binding ------------------------------------------------------
+    def _plan(self, mesh: MachineMesh):
+        """(dcn_axis_name | None, slice_factor, intra embedding) or None.
+
+        The slice boundary constrains which axes may cross DCN: only an
+        axis named in ``dcn_axes`` may carry the inter-slice factor, and
+        everything else must embed ICI-contiguously inside ONE slice —
+        the constraint the reference encodes as inter-node vs intra-node
+        connection matrices (``simulator.h:300-420``)."""
+        shape, names = mesh.shape, mesh.axis_names
+        if mesh.size <= self.slice_topology.size:
+            emb = self.slice_topology.embed(shape)
+            if emb is not None:
+                return None, 1, emb
+        for a in self.dcn_axes:
+            if a not in names:
+                continue
+            idx = names.index(a)
+            sz = shape[idx]
+            for s in range(2, min(sz, self.num_slices) + 1):
+                if sz % s or mesh.size // s > self.slice_topology.size:
+                    continue
+                intra = list(shape)
+                intra[idx] = sz // s
+                emb = self.slice_topology.embed(intra)
+                if emb is not None:
+                    return a, s, emb
+        return None
+
+    def legal_mesh(self, mesh: MachineMesh) -> bool:
+        if mesh.size > self.total_devices:
+            return False
+        return self._plan(mesh) is not None
+
+    def for_mesh(self, mesh: MachineMesh) -> "NetworkedMachineModel":
+        clone = NetworkedMachineModel(
+            slice_topology=self.slice_topology,
+            num_slices=self.num_slices,
+            hosts_per_slice=self.hosts_per_slice,
+            peak_flops=self.peak_flops,
+            hbm_bw=self.hbm_bw,
+            dcn_bw_per_uplink=self.dcn_bw_per_uplink,
+            dcn_uplinks_per_host=self.dcn_uplinks_per_host,
+            dcn_latency=self.dcn_latency,
+            dcn_contention=self.dcn_contention,
+            dcn_axes=self.dcn_axes,
+            latency=self.latency,
+        )
+        clone.source = self.source
+        # share the tallies: decisions made under any bound clone are
+        # visible on the root model (and flush exactly once)
+        clone.decision_stats = self.decision_stats
+        clone._flushed = self._flushed
+        plan = self._plan(mesh)
+        if plan is not None:
+            dcn_axis, s, emb = plan
+            for i, name in enumerate(mesh.axis_names):
+                e = emb.get(i)
+                clone._axis_bind[name] = _AxisBinding(
+                    slices=s if name == dcn_axis else 1,
+                    intra=e.n if e else 1,
+                    bw=e.bw if e else self.ici_bw,
+                    lat=e.latency if e else self.latency,
+                )
+        return clone
+
+    # --- collective pricing ------------------------------------------------
+    def _binding(self, axis: Optional[str], n: int) -> Tuple[int, int, float, float]:
+        """(slice factor S, per-slice degree m, intra bw, intra latency)
+        for a collective of total degree ``n`` over ``axis``.  ``n`` may
+        exceed the axis size (grad-sync rings spanning several axes with a
+        DCN participant); the extra factor rides the intra-slice part."""
+        b = self._axis_bind.get(axis)
+        if b is not None:
+            s = b.slices
+        elif axis in self.dcn_axes and self.num_slices > 1:
+            s = self.num_slices  # unbound model: assume the full pod span
+        else:
+            s = 1
+        if s <= 1 or n % s:
+            return 1, n, (b.bw if b else self.ici_bw), (b.lat if b else self.latency)
+        return s, max(1, n // s), (b.bw if b else self.ici_bw), (b.lat if b else self.latency)
+
+    def _decide(self, ring: float, hier: float) -> float:
+        if ring < hier:
+            self.decision_stats["ring"] += 1
+            return ring
+        self.decision_stats["hierarchical"] += 1
+        return hier
+
+    def all_reduce(self, nbytes: float, n: int, axis: Optional[str] = None) -> float:
+        if n <= 1:
+            return 0.0
+        s, m, bw, lat = self._binding(axis, n)
+        if s <= 1:
+            return lat * math.log2(max(2, n)) + 2 * nbytes * (n - 1) / (n * bw)
+        ring = self.dcn_latency + 2 * nbytes * (n - 1) / (n * self.host_dcn_bw)
+        hier = (
+            self.dcn_latency
+            + 2 * nbytes * (s - 1) / (s * self._slice_dcn_bw(m))
+        )
+        if m > 1:  # intra-slice reduce-scatter + all-gather phases
+            hier += 2 * (lat + nbytes * (m - 1) / (m * bw))
+        return self._decide(ring, hier)
+
+    def all_gather(self, nbytes_out: float, n: int, axis: Optional[str] = None) -> float:
+        if n <= 1:
+            return 0.0
+        s, m, bw, lat = self._binding(axis, n)
+        if s <= 1:
+            return lat + nbytes_out * (n - 1) / (n * bw)
+        ring = self.dcn_latency + nbytes_out * (n - 1) / (n * self.host_dcn_bw)
+        hier = (
+            self.dcn_latency
+            + nbytes_out * (s - 1) / (s * self._slice_dcn_bw(m))
+        )
+        if m > 1:  # gather the slice-local 1/s share over ICI first
+            hier += lat + (nbytes_out / s) * (m - 1) / (m * bw)
+        return self._decide(ring, hier)
+
+    def reduce_scatter(self, nbytes_in: float, n: int, axis: Optional[str] = None) -> float:
+        if n <= 1:
+            return 0.0
+        s, m, bw, lat = self._binding(axis, n)
+        if s <= 1:
+            return lat + nbytes_in * (n - 1) / (n * bw)
+        ring = self.dcn_latency + nbytes_in * (n - 1) / (n * self.host_dcn_bw)
+        hier = (
+            self.dcn_latency
+            + nbytes_in * (s - 1) / (s * self._slice_dcn_bw(m))
+        )
+        if m > 1:  # scatter within the slice first, then across slices
+            hier += lat + nbytes_in * (m - 1) / (m * bw)
+        return self._decide(ring, hier)
+
+    def all_to_all(self, nbytes: float, n: int, axis: Optional[str] = None) -> float:
+        """a2a is a permutation — no byte-reducing hierarchical form — but
+        every chip transmits concurrently, so the crossing fraction rides
+        the slice-aggregate uplinks, not one host's."""
+        if n <= 1:
+            return 0.0
+        s, m, bw, lat = self._binding(axis, n)
+        if s <= 1:
+            return lat + nbytes * (n - 1) / (n * bw)
+        t = self.dcn_latency + m * nbytes * (s - 1) / (s * self._slice_dcn_bw(m))
+        if m > 1:
+            t += lat + nbytes * (m - 1) / (n * bw)
+        return t
+
+    # --- observability ------------------------------------------------------
+    def flush_decisions(self) -> Dict[str, int]:
+        """Push ring/hierarchical decision deltas to the process tracer
+        (counters ``network.ring_collectives`` /
+        ``network.hierarchical_collectives``) and return them.  Called at
+        the end of each DP solve / strategy estimate / simulation so the
+        hot pricing path never touches the tracer lock."""
+        from flexflow_tpu.obs import get_tracer
+
+        tracer = get_tracer()
+        delta = {}
+        for key, counter in (
+            ("ring", "network.ring_collectives"),
+            ("hierarchical", "network.hierarchical_collectives"),
+        ):
+            d = self.decision_stats[key] - self._flushed[key]
+            if d:
+                tracer.counter(counter, float(d))
+            self._flushed[key] = self.decision_stats[key]
+            delta[key] = d
+        return delta
+
+    # --- serialization ------------------------------------------------------
+    def to_dict(self) -> dict:
+        t = self.slice_topology
+        return {
+            "version": MACHINE_MODEL_SCHEMA_VERSION,
+            "peak_flops": self.peak_flops,
+            "hbm_bw": self.hbm_bw,
+            "slices": {
+                "count": self.num_slices,
+                "hosts_per_slice": self.hosts_per_slice,
+                "ici": {
+                    "dims": list(t.dims),
+                    "wrap": list(t.wrap),
+                    "links": [
+                        {"bw": l.bw, "latency": l.latency} for l in t.links
+                    ],
+                },
+            },
+            "dcn": {
+                "bw_per_uplink": self.dcn_bw_per_uplink,
+                "uplinks_per_host": self.dcn_uplinks_per_host,
+                "latency": self.dcn_latency,
+                "contention": self.dcn_contention,
+            },
+            "dcn_axes": list(self.dcn_axes),
+            "latency": self.latency,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "NetworkedMachineModel":
+        from flexflow_tpu.search.cost import TPUMachineModel
+
+        ver = d.get("version")
+        if ver != MACHINE_MODEL_SCHEMA_VERSION:
+            raise ValueError(
+                f"machine-model schema version {ver!r} unsupported "
+                f"(this build reads v{MACHINE_MODEL_SCHEMA_VERSION} and "
+                "legacy v1 flat files)"
+            )
+        chip = {}
+        if d.get("chip"):
+            dk = str(d["chip"]).lower()
+            for key in sorted(TPUMachineModel.CHIP_PRESETS, key=len, reverse=True):
+                if key in dk:
+                    chip = dict(TPUMachineModel.CHIP_PRESETS[key])
+                    break
+        default_ici = chip.get("ici_bw", 9e10)
+        s = d.get("slices", {})
+        ici = s.get("ici", {})
+        dims = tuple(int(x) for x in ici.get("dims", (1,)))
+        links = tuple(
+            LinkClass(
+                bw=float(l.get("bw", default_ici)),
+                latency=float(l.get("latency", 1e-6)),
+            )
+            for l in ici.get("links", ())
+        )
+        if not links:
+            links = tuple(LinkClass(default_ici) for _ in dims)
+        if len(links) == 1 and len(dims) > 1:  # one class for every dim
+            links = links * len(dims)
+        topo = SliceTopology(
+            dims=dims, wrap=tuple(bool(w) for w in ici.get("wrap", ())),
+            links=links,
+        )
+        dcn = d.get("dcn", {})
+        return NetworkedMachineModel(
+            slice_topology=topo,
+            num_slices=int(s.get("count", 1)),
+            hosts_per_slice=int(s.get("hosts_per_slice", 1)),
+            peak_flops=float(d.get("peak_flops", chip.get("peak_flops", 4.59e14))),
+            hbm_bw=float(d.get("hbm_bw", chip.get("hbm_bw", 2.765e12))),
+            dcn_bw_per_uplink=float(dcn.get("bw_per_uplink", 6.25e9)),
+            dcn_uplinks_per_host=int(dcn.get("uplinks_per_host", 1)),
+            dcn_latency=float(dcn.get("latency", 1e-5)),
+            dcn_contention=int(dcn.get("contention", 1)),
+            dcn_axes=tuple(d.get("dcn_axes", ("data",))),
+            latency=float(d.get("latency", 1e-6)),
+        )
+
+
+def load_machine_model(path: str):
+    """Load a ``--machine-model-file``: v2 (``"version": 2``) builds a
+    :class:`NetworkedMachineModel`; v1 flat files (no version key) keep
+    loading as the scalar :class:`TPUMachineModel` — existing config files
+    stay valid."""
+    from flexflow_tpu.search.cost import TPUMachineModel
+
+    with open(path) as f:
+        d = json.load(f)
+    if d.get("version") == MACHINE_MODEL_SCHEMA_VERSION:
+        m = NetworkedMachineModel.from_dict(d)
+    elif "version" in d:
+        raise ValueError(
+            f"{path}: unsupported machine-model schema version "
+            f"{d['version']!r}"
+        )
+    else:
+        m = TPUMachineModel._from_v1_dict(d)
+    m.source = f"file:{_file_digest(path)}"
+    return m
+
+
+def _file_digest(path: str) -> str:
+    import hashlib
+
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:12]

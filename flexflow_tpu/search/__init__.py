@@ -43,9 +43,12 @@ __all__ = [
     "apply_rewrite",
     "default_struct_xfers",
     "MeasuredCostModel",
+    "NetworkedMachineModel",
     "OpProfiler",
     "SearchHelper",
+    "SliceTopology",
     "TPUMachineModel",
+    "load_machine_model",
     "base_optimize",
     "estimate_strategy_cost",
     "generate_all_pcg_xfers",
@@ -55,3 +58,13 @@ __all__ = [
     "strategy_memory_per_device",
     "unity_search",
 ]
+
+
+def __getattr__(name):
+    # parallel.network subclasses TPUMachineModel (imported from this
+    # package), so its names load lazily here to keep imports acyclic
+    if name in ("NetworkedMachineModel", "SliceTopology", "load_machine_model"):
+        from flexflow_tpu.parallel import network
+
+        return getattr(network, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -279,13 +279,21 @@ def op_candidates(layer: Layer, mesh: MachineMesh) -> List[OpSharding]:
                 kshape = get_op_def(layer.op_type).weights(layer)[0].shape
                 wspec = {"kernel": _spec_with(len(kshape), {0: "model"})}
                 ids = layer.inputs[0]
-                batch = {0: "data"} if dp > 1 and ids.shape[0] % dp == 0 else {}
                 out_shape = outs[0][0]
-                out = TensorSharding(
-                    spec=_spec_with(len(out_shape), batch).spec,
-                    partial_axes=("model",),
-                )
-                add([out], wspec, [_spec_with(ids.ndim, batch)])
+                batches = [{}]
+                if dp > 1 and ids.shape[0] % dp == 0:
+                    # batch-sharded AND batch-replicated variants: batch
+                    # sharding makes the table grad partial over "data",
+                    # which prices a table-sized sync over that axis — when
+                    # "data" crosses a slice boundary (DCN), the replicated-
+                    # batch layout is how vocab sharding stays affordable
+                    batches.insert(0, {0: "data"})
+                for batch in batches:
+                    out = TensorSharding(
+                        spec=_spec_with(len(out_shape), batch).spec,
+                        partial_axes=("model",),
+                    )
+                    add([out], wspec, [_spec_with(ids.ndim, batch)])
 
     # expert parallelism: batched expert weights shard over the 'expert'
     # axis; the op's forward opens the all-to-all dispatch internally

@@ -66,6 +66,11 @@ class TPUMachineModel:
         self.topology = topology
         # per-axis ring-bandwidth multipliers, set by for_mesh()
         self._axis_mult: Dict[str, float] = {}
+        # machine-model identity for bench records / the regression gate
+        # (tools/bench_compare.py refuses to diff runs priced against
+        # different topologies): "default:...", "preset:<chip>", or
+        # "file:<sha256/12>" — set by for_chip/from_file/load_machine_model
+        self.source = "default:v5p-class"
 
     @classmethod
     def for_chip(cls, device_kind: str, **over) -> "TPUMachineModel":
@@ -73,12 +78,17 @@ class TPUMachineModel:
         ``device_kind`` (e.g. ``"TPU v5 lite"``)."""
         dk = device_kind.lower()
         base = {}
+        preset = None
         for key in sorted(cls.CHIP_PRESETS, key=len, reverse=True):
             if key in dk:
                 base = dict(cls.CHIP_PRESETS[key])
+                preset = key
                 break
         base.update(over)
-        return cls(**base)
+        m = cls(**base)
+        if preset is not None:
+            m.source = f"preset:{preset}"
+        return m
 
     @classmethod
     def detect(cls, **over) -> "TPUMachineModel":
@@ -96,10 +106,19 @@ class TPUMachineModel:
 
     @staticmethod
     def from_file(path: str) -> "TPUMachineModel":
-        import json
+        """Load a ``--machine-model-file`` of either schema: a v2 file
+        (``"version": 2`` — slices/link-classes/DCN uplinks) returns a
+        :class:`~flexflow_tpu.parallel.network.NetworkedMachineModel`;
+        a legacy v1 flat file returns a plain :class:`TPUMachineModel`."""
+        from flexflow_tpu.parallel.network import load_machine_model
 
-        with open(path) as f:
-            d = json.load(f)
+        return load_machine_model(path)
+
+    @staticmethod
+    def _from_v1_dict(d: dict) -> "TPUMachineModel":
+        """The legacy flat-scalar schema (v1): top-level roofline/ICI/DCN
+        scalars + optional ``chip`` preset + optional ``topology`` grid."""
+        d = dict(d)
         if "dcn_axes" in d:
             d["dcn_axes"] = tuple(d["dcn_axes"])
         chip = d.pop("chip", None)
@@ -148,6 +167,7 @@ class TPUMachineModel:
             dcn_latency=self.dcn_latency, dcn_axes=self.dcn_axes,
             topology=self.topology,
         )
+        bound.source = self.source
         if assign is not None:
             bound._axis_mult = {
                 mesh.axis_names[i]: mult for i, (_, mult) in assign.items()
@@ -546,4 +566,8 @@ def estimate_strategy_cost(
                     t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m,
                     with_backward=t.owner_layer is not None,
                 )
+    # multi-slice models tally ring-vs-hierarchical routing choices per
+    # collective; surface them as tracer counters once per estimate
+    if hasattr(m, "flush_decisions"):
+        m.flush_decisions()
     return total

@@ -130,6 +130,11 @@ class SearchHelper:
                     best_cost, best_assign, stall = c, a, 0
                 else:
                     stall += 1
+            # multi-slice machine models tally per-collective ring-vs-
+            # hierarchical routing choices; export the solve's deltas as
+            # tracer counters (network.* glossary, docs/OBSERVABILITY.md)
+            if hasattr(self.machine, "flush_decisions"):
+                self.machine.flush_decisions()
         return best_cost, best_assign
 
     def _sweep(

@@ -123,11 +123,15 @@ def _unity_search_impl(
             continue
         cands.append(mv)
     if not cands and machine is not None and machine.topology is not None:
+        slices = getattr(machine, "num_slices", 1)
         raise ValueError(
             f"no mesh factorization of {mesh.size} devices embeds in the "
-            f"declared physical topology {machine.topology.dims} "
-            f"({machine.topology.size} chips) — check the machine-model "
-            f"file against the actual device count"
+            f"declared physical topology "
+            + (f"{slices} slices x " if slices > 1 else "")
+            + f"{machine.topology.dims} "
+            f"({slices * machine.topology.size} chips; only "
+            f"{tuple(machine.dcn_axes)} may cross the slice boundary) — "
+            f"check the machine-model file against the actual device count"
         )
 
     best: Optional[Strategy] = None

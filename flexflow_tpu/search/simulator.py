@@ -823,6 +823,10 @@ def simulate_strategy(
             produced[o.guid] = dev_tasks
 
     makespan = max((t.end for t in tasks), default=0.0)
+    # export the sim's ring-vs-hierarchical routing tallies (multi-slice
+    # machine models only; no-op for the scalar model)
+    if hasattr(m, "flush_decisions"):
+        m.flush_decisions()
     if return_tasks:
         # the critical device's timeline (taskgraph export reads this)
         worst = max(tasks, key=lambda t: t.end).device if tasks else 0

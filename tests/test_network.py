@@ -1,0 +1,363 @@
+"""Multi-slice networked machine model (docs/MACHINE_MODEL.md).
+
+The reference prices search candidates with a ``NetworkedMachineModel``
+built from per-link topology matrices + routing strategies
+(``include/flexflow/simulator.h:212-605``, ``src/runtime/network.cc``,
+``machine_config_example``).  These tests pin the TPU analog: N slices x
+per-slice ICI link classes, per-host DCN uplinks with contention, and
+``min(ring, hierarchical)`` routing per slice-crossing collective.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from flexflow_tpu.parallel.machine import MachineMesh
+from flexflow_tpu.parallel.network import (
+    MACHINE_MODEL_SCHEMA_VERSION,
+    LinkClass,
+    NetworkedMachineModel,
+    SliceTopology,
+    load_machine_model,
+)
+from flexflow_tpu.search import TPUMachineModel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pod_2x4x2(**over):
+    """2 slices x (4, 2) ici, 2 hosts/slice, 4 x 6.25 GB/s uplinks/host."""
+    kw = dict(
+        slice_topology=SliceTopology(
+            dims=(4, 2), wrap=(True, False),
+            links=(LinkClass(9e10, 1e-6), LinkClass(9e10, 1e-6)),
+        ),
+        num_slices=2,
+        hosts_per_slice=2,
+        dcn_bw_per_uplink=6.25e9,
+        dcn_uplinks_per_host=4,
+        dcn_latency=1e-5,
+        dcn_axes=("data",),
+    )
+    kw.update(over)
+    return NetworkedMachineModel(**kw)
+
+
+# ------------------------------------------------------------- schema IO
+def test_v2_round_trip():
+    m = _pod_2x4x2(dcn_contention=2)
+    d = m.to_dict()
+    assert d["version"] == MACHINE_MODEL_SCHEMA_VERSION
+    rt = NetworkedMachineModel.from_dict(d)
+    assert rt.to_dict() == d
+    assert rt.num_slices == 2
+    assert rt.hosts_per_slice == 2
+    assert rt.dcn_contention == 2
+    assert rt.slice_topology == m.slice_topology
+
+
+def test_v2_file_load(tmp_path):
+    m = _pod_2x4x2()
+    p = tmp_path / "machine_v2.json"
+    p.write_text(json.dumps(m.to_dict()))
+    loaded = load_machine_model(str(p))
+    assert isinstance(loaded, NetworkedMachineModel)
+    assert loaded.slice_topology == m.slice_topology
+    assert loaded.source.startswith("file:")
+    # the shared entry point dispatches by schema version
+    assert isinstance(TPUMachineModel.from_file(str(p)), NetworkedMachineModel)
+
+
+def test_shipped_v5p_2slice_example_loads():
+    m = load_machine_model(
+        os.path.join(REPO, "examples", "machine_configs", "v5p_2slice.json")
+    )
+    assert isinstance(m, NetworkedMachineModel)
+    assert m.num_slices == 2
+    assert m.total_devices == 16
+    # chip preset resolved: v5p roofline scalars
+    assert m.peak_flops == pytest.approx(4.59e14)
+    assert m.hbm_bw == pytest.approx(2.765e12)
+
+
+def test_v1_files_still_load_flat(tmp_path):
+    """v1 back-compat: no "version" key -> scalar TPUMachineModel, chip
+    preset + topology grid + dcn_axes preserved (the pre-v2 behavior)."""
+    for name in ("v5e.json", "v5e_multislice.json", "v5p.json"):
+        m = load_machine_model(
+            os.path.join(REPO, "examples", "machine_configs", name)
+        )
+        assert not isinstance(m, NetworkedMachineModel), name
+        assert m.topology is not None, name
+    m = load_machine_model(
+        os.path.join(REPO, "examples", "machine_configs", "v5e_multislice.json")
+    )
+    assert m.dcn_axes == ("data",)
+    assert m.peak_flops == pytest.approx(1.97e14)
+    assert m.source.startswith("file:")
+
+
+def test_unknown_schema_version_rejected(tmp_path):
+    p = tmp_path / "machine_v9.json"
+    p.write_text(json.dumps({"version": 9}))
+    with pytest.raises(ValueError, match="version"):
+        load_machine_model(str(p))
+
+
+# ----------------------------------------------------- slice-aware legality
+def test_legal_mesh_slice_boundaries():
+    """Only dcn_axes may carry the inter-slice factor; everything else
+    must embed inside ONE slice."""
+    m = _pod_2x4x2()
+    mk = lambda s: MachineMesh(s, ("data", "model"))  # noqa: E731
+    assert m.legal_mesh(mk((16, 1)))
+    assert m.legal_mesh(mk((8, 2)))
+    assert m.legal_mesh(mk((4, 4)))
+    assert m.legal_mesh(mk((2, 8)))
+    assert not m.legal_mesh(mk((1, 16)))  # model can't cross the boundary
+    assert m.legal_mesh(mk((8, 1)))  # fits in one slice, no DCN
+    assert m.legal_mesh(mk((1, 8)))
+    assert not m.legal_mesh(mk((32, 1)))  # more than the pod
+    assert not m.legal_mesh(mk((2, 6)))  # 6 doesn't embed in (4, 2)
+
+
+def test_single_slice_fit_never_crosses_dcn():
+    m = _pod_2x4x2()
+    bound = m.for_mesh(MachineMesh((8, 1), ("data", "model")))
+    assert bound._axis_bind["data"].slices == 1
+    # data fits in one slice -> priced as an intra-slice ring collective
+    t = bound.all_reduce(1 << 20, 8, axis="data")
+    assert t < 1e-4
+    assert bound.decision_stats == {"ring": 0, "hierarchical": 0}
+
+
+# --------------------------------------------------------- per-axis rates
+def test_per_axis_link_classes():
+    """Each mesh axis is priced by the link class of the physical dims it
+    occupies — the per-axis bandwidth/latency the flat model collapses."""
+    m = NetworkedMachineModel(
+        slice_topology=SliceTopology(
+            dims=(4, 2),
+            links=(LinkClass(9e10, 1e-6), LinkClass(4.5e10, 2e-6)),
+        ),
+        num_slices=1,
+    )
+    bound = m.for_mesh(MachineMesh((4, 2), ("data", "model")))
+    assert bound._axis_bind["data"].bw == pytest.approx(9e10)
+    assert bound._axis_bind["model"].bw == pytest.approx(4.5e10)
+    assert bound._axis_bind["model"].lat == pytest.approx(2e-6)
+    big = 1 << 30
+    t_fast = bound.all_gather(big, 4, axis="data")
+    t_slow = bound.all_gather(big, 2, axis="model")
+    # (n-1)/n bytes over 90 GB/s vs (n-1)/n over 45 GB/s
+    assert t_fast == pytest.approx(big * (3 / 4) / 9e10, rel=1e-3)
+    assert t_slow == pytest.approx(big * (1 / 2) / 4.5e10, rel=1e-3)
+
+
+def test_slice_crossing_axis_priced_at_dcn_rates():
+    """A slice-crossing collective must cost far more than an intra-slice
+    one moving the same bytes — DCN rates, not ICI rates, per axis."""
+    m = _pod_2x4x2()
+    bound = m.for_mesh(MachineMesh((2, 8), ("data", "model")))
+    assert bound._axis_bind["data"].slices == 2
+    assert bound._axis_bind["model"].slices == 1
+    big = float(1 << 30)
+    t_dcn = bound.all_reduce(big, 2, axis="data")
+    t_ici = bound.all_reduce(big, 8, axis="model")
+    assert t_dcn > 2 * t_ici, (t_dcn, t_ici)
+    # and the crossing time is governed by the uplink rate: with the axis
+    # fully inter-slice (m=1, one chip per slice participates) the flow
+    # rides ONE host's aggregate uplinks
+    host_bw = 4 * 6.25e9
+    assert t_dcn == pytest.approx(
+        m.dcn_latency + 2 * big * (1 / 2) / host_bw, rel=1e-3
+    )
+
+
+# -------------------------------------------------- ring-vs-hierarchical
+def test_ring_hierarchical_crossover():
+    """min(ring, hierarchical): small slice-crossing tensors take the
+    single-phase flat ring (two extra intra-slice phase latencies beat the
+    byte savings); large ones take hierarchical (all hosts' uplinks carry
+    1/m of the bytes each).  Both sides of the crossover exercised."""
+    m = _pod_2x4x2()
+    bound = m.for_mesh(MachineMesh((16, 1), ("data", "model")))
+    host_bw = 4 * 6.25e9
+
+    small = 1e3
+    t_small = bound.all_reduce(small, 16, axis="data")
+    assert bound.decision_stats["ring"] == 1
+    assert bound.decision_stats["hierarchical"] == 0
+    # the flat-ring price: one DCN phase, boundary on ONE host's uplinks
+    assert t_small == pytest.approx(
+        m.dcn_latency + 2 * small * (15 / 16) / host_bw, rel=1e-6
+    )
+
+    big = 1e9
+    t_big = bound.all_reduce(big, 16, axis="data")
+    assert bound.decision_stats["hierarchical"] == 1
+    ring_price = m.dcn_latency + 2 * big * (15 / 16) / host_bw
+    assert t_big < ring_price  # hierarchical beat the ring
+    # monotone through the crossover: min() of two linear-in-B prices
+    prev = 0.0
+    for b in (1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9):
+        t = bound.all_reduce(b, 16, axis="data")
+        assert t >= prev
+        prev = t
+    # all_gather/reduce_scatter route too
+    bound.all_gather(1e9, 16, axis="data")
+    bound.reduce_scatter(1e9, 16, axis="data")
+    assert bound.decision_stats["hierarchical"] >= 3
+
+
+def test_contention_halves_effective_uplink_bandwidth():
+    """dcn_contention=k divides the effective per-host uplink rate by k:
+    with the axis fully inter-slice (m=1) the bandwidth term is exactly
+    k x the uncontended one."""
+    base = _pod_2x4x2(dcn_contention=1)
+    cont = _pod_2x4x2(dcn_contention=2)
+    mesh = MachineMesh((2, 8), ("data", "model"))
+    big = float(1 << 30)
+    t1 = base.for_mesh(mesh).all_reduce(big, 2, axis="data")
+    t2 = cont.for_mesh(mesh).all_reduce(big, 2, axis="data")
+    assert (t2 - base.dcn_latency) == pytest.approx(
+        2 * (t1 - base.dcn_latency), rel=1e-6
+    )
+    assert cont.host_dcn_bw == pytest.approx(base.host_dcn_bw / 2)
+
+
+# ------------------------------------------------------- tracer counters
+def test_decision_counters_flushed_to_tracer():
+    from flexflow_tpu.obs import Tracer, get_tracer, set_tracer
+
+    old = get_tracer()
+    set_tracer(Tracer(level="step"))
+    try:
+        m = _pod_2x4x2()
+        bound = m.for_mesh(MachineMesh((16, 1), ("data", "model")))
+        bound.all_reduce(1e3, 16, axis="data")  # ring
+        bound.all_reduce(1e9, 16, axis="data")  # hierarchical
+        delta = bound.flush_decisions()
+        assert delta == {"ring": 1, "hierarchical": 1}
+        counters = get_tracer().summary()["counters"]
+        assert counters["network.ring_collectives"] == 1.0
+        assert counters["network.hierarchical_collectives"] == 1.0
+        # decisions land on the ROOT model too (shared tallies), and a
+        # second flush is a no-op
+        assert m.decision_stats == {"ring": 1, "hierarchical": 1}
+        assert bound.flush_decisions() == {"ring": 0, "hierarchical": 0}
+    finally:
+        set_tracer(old)
+
+
+def test_estimate_strategy_cost_flushes_decisions():
+    """estimate_strategy_cost over a slice-crossing mesh surfaces the
+    routing tallies as tracer counters (docs/OBSERVABILITY.md)."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.obs import Tracer, get_tracer, set_tracer
+    from flexflow_tpu.parallel.strategy import data_parallel_strategy
+    from flexflow_tpu.search import estimate_strategy_cost
+
+    model = FFModel(FFConfig(batch_size=64))
+    t = model.create_tensor((64, 32))
+    t = model.dense(t, 64)
+    t = model.dense(t, 8)
+    model.softmax(t)
+    mesh = MachineMesh((16, 1), ("data", "model"))
+    st = data_parallel_strategy(model.layers, mesh)
+    old = get_tracer()
+    set_tracer(Tracer(level="step"))
+    try:
+        machine = _pod_2x4x2()
+        cost = estimate_strategy_cost(model.layers, st, machine=machine)
+        assert cost > 0
+        counters = get_tracer().summary()["counters"]
+        assert (
+            counters["network.ring_collectives"]
+            + counters["network.hierarchical_collectives"]
+        ) > 0
+    finally:
+        set_tracer(old)
+
+
+# ------------------------------------------------------------- tool smoke
+def test_topology_report_smoke(tmp_path):
+    """tools/topology_report.py prints the per-axis table and the
+    ring-vs-hierarchical time matrix for a v2 config (and runs on v1)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "topology_report.py"),
+         os.path.join(REPO, "examples", "machine_configs", "v5p_2slice.json"),
+         "--mesh", "16x1"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    for needle in ("2 slice(s)", "per-dim ici link classes", "crosses-dcn",
+                   "allreduce time", "allgather time", "(ring)", "(hier)",
+                   "routing decisions"):
+        assert needle in out, f"missing {needle!r} in:\n{out}"
+    # v1 configs keep working through the same tool
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "topology_report.py"),
+         os.path.join(REPO, "examples", "machine_configs", "v5e.json"),
+         "--mesh", "4x2"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr
+    assert "(v1 flat)" in r.stdout
+
+
+# ----------------------------------------------- bench identity gate
+def test_bench_compare_refuses_machine_model_mismatch(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_compare
+    finally:
+        sys.path.pop(0)
+    base = {
+        "metric": "bert_base_train_throughput", "value": 100.0,
+        "unit": "samples/s", "backend": "cpu",
+        "machine_model": "preset:v5p",
+    }
+    cur = dict(base, value=50.0, machine_model="file:abcdef123456")
+    bp = tmp_path / "BENCH_r01.json"
+    bp.write_text(json.dumps(base))
+    cp = tmp_path / "current.json"
+    cp.write_text(json.dumps(cur))
+    # mismatched machine model: refuse (0 non-strict, 1 strict) even
+    # though the value halved — a different topology is not a regression
+    assert bench_compare.main([str(cp), "--baseline", str(bp)]) == 0
+    assert bench_compare.main(
+        [str(cp), "--baseline", str(bp), "--strict"]
+    ) == 1
+    # matching identity: the 50% drop gates as a real regression
+    cp.write_text(json.dumps(dict(cur, machine_model="preset:v5p")))
+    assert bench_compare.main([str(cp), "--baseline", str(bp)]) == 1
+    # legacy baseline without the field still compares (back-compat)
+    bp.write_text(json.dumps({k: v for k, v in base.items()
+                              if k != "machine_model"}))
+    assert bench_compare.main([str(cp), "--baseline", str(bp)]) == 1
+
+
+# ---------------------------------------------- graft-entry degradation
+def test_hybrid_dcn_cpu_degradation_line(capsys):
+    """The CPU-backend hybrid-DCN dryrun degrades to an explicit skip
+    line that still carries a priced number (CHANGES.md PR 2 known
+    failure: 'Multiprocess computations aren't implemented on the CPU
+    backend')."""
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__ as ge
+    finally:
+        sys.path.pop(0)
+    ge._price_hybrid_dcn(8)
+    out = capsys.readouterr().out
+    assert "skipped (cpu backend)" in out
+    assert "est step" in out
+    assert "grad-allreduce" in out
+    assert "2 slices x 4 chips" in out

@@ -12,6 +12,8 @@ over plain data parallelism at batch 8192.
 
 import json
 
+import pytest
+
 from flexflow_tpu import FFConfig, FFModel, MachineMesh
 from flexflow_tpu.fftype import ActiMode
 from flexflow_tpu.models.dlrm import dlrm
@@ -197,6 +199,111 @@ def test_beam_robustness_all_ae_goldens():
             name,
             {b: w for b, w in winners.items()},
         )
+
+
+# ---------------------------------------------------- multi-slice goldens
+def _machines_16dev():
+    """16 chips two ways: one v5p 4x4 torus slice vs 2 DCN-linked slices
+    of (4, 2) — same device count, different network."""
+    from flexflow_tpu.parallel.network import (
+        LinkClass,
+        NetworkedMachineModel,
+        SliceTopology,
+    )
+
+    single = TPUMachineModel(
+        topology=PhysicalTopology((4, 4), wrap=(True, True))
+    )
+    two_slice = NetworkedMachineModel(
+        slice_topology=SliceTopology(
+            (4, 2), wrap=(True, False),
+            links=(LinkClass(9e10), LinkClass(9e10)),
+        ),
+        num_slices=2, hosts_per_slice=2,
+        dcn_bw_per_uplink=6.25e9, dcn_uplinks_per_host=4,
+        dcn_axes=("data",),
+    )
+    return single, two_slice
+
+
+def _dlrm_search(machine, n_devices, budget=6):
+    model = FFModel(FFConfig(batch_size=2048))
+    dlrm(model, batch=2048)
+    st = unity_search(
+        model.layers, MachineMesh((n_devices, 1), ("data", "model")),
+        budget=budget, machine=machine,
+    )
+    return model, st
+
+
+def test_dlrm_16dev_2slice_winner_differs_from_single_slice():
+    """The DCN-aware model changes the searched winner at a fixed device
+    count (ISSUE 3 acceptance): on one 16-chip slice DLRM vocab-shards
+    its tables 16-way; on 2 DCN-linked slices the model axis cannot
+    cross the slice boundary, so the winner confines vocab sharding to a
+    slice (model=8) and spans slices with the data axis only."""
+    single, two_slice = _machines_16dev()
+    m1, st1 = _dlrm_search(single, 16)
+    w1 = _winner(m1, st1)
+    assert w1["mesh"] == {"data": 1, "model": 16}, w1["mesh"]
+    for i in range(4):
+        assert w1[f"emb_{i}"]["kernel"][0] == ["model"], w1[f"emb_{i}"]
+
+    m2, st2 = _dlrm_search(two_slice, 16)
+    w2 = _winner(m2, st2)
+    assert w2["mesh"] == {"data": 2, "model": 8}, w2["mesh"]
+    for i in range(4):
+        assert w2[f"emb_{i}"]["kernel"][0] == ["model"], w2[f"emb_{i}"]
+    assert w1 != w2
+    # the 2-slice search made slice-crossing routing decisions
+    assert sum(two_slice.decision_stats.values()) > 0
+
+
+def test_dlrm_32dev_2slice_golden():
+    """32 chips as 2 x (4, 4) slices: vocab sharding again stops at the
+    slice boundary (model=16), data crosses DCN."""
+    from flexflow_tpu.parallel.network import (
+        LinkClass,
+        NetworkedMachineModel,
+        SliceTopology,
+    )
+
+    machine = NetworkedMachineModel(
+        slice_topology=SliceTopology(
+            (4, 4), wrap=(True, True),
+            links=(LinkClass(9e10), LinkClass(9e10)),
+        ),
+        num_slices=2, hosts_per_slice=4,
+        dcn_bw_per_uplink=6.25e9, dcn_uplinks_per_host=4,
+        dcn_axes=("data",),
+    )
+    model, st = _dlrm_search(machine, 32)
+    w = _winner(model, st)
+    assert w["mesh"] == {"data": 2, "model": 16}, w["mesh"]
+    for i in range(4):
+        assert w[f"emb_{i}"]["kernel"][0] == ["model"], w[f"emb_{i}"]
+
+
+def test_2slice_search_decision_counters_in_trace_summary():
+    """The ring-vs-hierarchical routing decisions the search made are
+    visible in the trace summary (network.* counter glossary,
+    docs/OBSERVABILITY.md)."""
+    from flexflow_tpu.obs import Tracer, get_tracer, set_tracer
+
+    _, two_slice = _machines_16dev()
+    old = get_tracer()
+    set_tracer(Tracer(level="step"))
+    try:
+        _dlrm_search(two_slice, 16, budget=4)
+        counters = get_tracer().summary()["counters"]
+        assert counters["network.hierarchical_collectives"] > 0
+        assert counters["network.ring_collectives"] >= 0
+        assert (
+            counters["network.ring_collectives"]
+            + counters["network.hierarchical_collectives"]
+        ) == pytest.approx(sum(two_slice.decision_stats.values()))
+    finally:
+        set_tracer(old)
 
 
 def test_candle_uno_golden_tp_feature_towers():

@@ -12,8 +12,13 @@ a judge's eyeball pass.  It understands three input shapes:
     record with a ``samples_per_s`` becomes the headline)
 
 Comparisons are backend-matched ONLY: a CPU-fallback run is never gated
-against a TPU baseline (different hardware, not a regression).  The
-measured metrics on both sides:
+against a TPU baseline (different hardware, not a regression).  They are
+also machine-model-matched when both records carry a ``machine_model``
+identity (``preset:<chip>`` / ``file:<sha256/12>`` from the priced
+``--machine-model-file``): a run priced against a different topology is
+a different experiment, not a regression — the gate refuses to compare.
+Records predating the identity field (no ``machine_model`` key) compare
+as before.  The measured metrics on both sides:
 
   * headline ``value`` (samples/s, higher is better)
   * ``secondary.dlrm.samples_per_sec``, ``secondary.bert_large.samples_per_sec``
@@ -165,6 +170,27 @@ def main(argv=None) -> int:
                f"{len(baselines)} candidate(s); nothing to gate against")
         print(msg)
         return 1 if args.strict else 0
+    # machine-model-matched when BOTH sides carry the identity: a run
+    # priced against a different topology (other machine-model file /
+    # chip preset) is a different experiment, never a regression
+    mm = current.get("machine_model")
+    if mm is not None:
+        dropped = [
+            (p, r) for p, r in matched
+            if r.get("machine_model") not in (None, mm)
+        ]
+        matched = [
+            (p, r) for p, r in matched
+            if r.get("machine_model") in (None, mm)
+        ]
+        if dropped and not matched:
+            print(f"bench_compare: refusing to compare — every "
+                  f"{backend!r}-backend baseline was priced against a "
+                  f"different machine model "
+                  f"({dropped[-1][1].get('machine_model')!r} vs {mm!r})")
+            return 1 if args.strict else 0
+        for p, _r in dropped:
+            print(f"bench_compare: skipping {p} (different machine model)")
     base_path, base = matched[-1]
 
     rows = compare(current, base, args.threshold)

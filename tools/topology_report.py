@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Topology report: per-axis link table + collective time matrix for a
+``--machine-model-file``.
+
+For a v2 (multi-slice) config this prints, per mesh axis: the inter-slice
+factor, intra-slice degree, effective ICI ring bandwidth, per-phase
+latency, and whether the axis crosses DCN — then an allreduce and an
+allgather time matrix (tensor sizes x axes) with the flat-ring and
+hierarchical prices side by side and the winner marked (the
+``min(ring, hierarchical)`` decision the search makes per collective,
+docs/MACHINE_MODEL.md).  v1 flat configs print the scalar ICI/DCN rates
+and a single-routing matrix.
+
+Usage:
+  python tools/topology_report.py examples/machine_configs/v5p_2slice.json
+  python tools/topology_report.py CONFIG.json --mesh 4x4 --axes data,model \\
+      --sizes 64KB,1MB,64MB,1GB
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexflow_tpu.parallel.machine import MachineMesh
+from flexflow_tpu.parallel.network import NetworkedMachineModel, load_machine_model
+
+_UNITS = {"KB": 1 << 10, "MB": 1 << 20, "GB": 1 << 30, "B": 1}
+
+
+def _parse_size(s: str) -> float:
+    s = s.strip().upper()
+    for u in ("KB", "MB", "GB", "B"):
+        if s.endswith(u):
+            return float(s[: -len(u)]) * _UNITS[u]
+    return float(s)
+
+
+def _fmt_size(b: float) -> str:
+    for u, m in (("GB", 1 << 30), ("MB", 1 << 20), ("KB", 1 << 10)):
+        if b >= m:
+            return f"{b / m:g}{u}"
+    return f"{b:g}B"
+
+
+def _default_mesh(machine) -> MachineMesh:
+    n = getattr(machine, "total_devices", None)
+    if n is None:
+        n = machine.topology.size if machine.topology is not None else 8
+    # largest power-of-two-ish split across (data, model)
+    d = 1
+    while d * d <= n and n % (d * 2) == 0:
+        d *= 2
+    return MachineMesh((d, n // d), ("data", "model"))
+
+
+def _routing_pair(bound, kind: str, nbytes: float, n: int, axis: str):
+    """(ring_s, hier_s) by pricing under forced single-routing copies —
+    decision_stats tells which branch min() took."""
+    before = dict(bound.decision_stats)
+    fn = getattr(bound, kind)
+    t = fn(nbytes, n, axis=axis)
+    after = bound.decision_stats
+    if after["ring"] > before["ring"]:
+        return t, "ring"
+    if after["hierarchical"] > before["hierarchical"]:
+        return t, "hier"
+    return t, "ici"  # intra-slice axis: no routing decision to make
+
+
+def main(argv: List[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("config", help="machine-model file (v1 or v2 schema)")
+    ap.add_argument("--mesh", default=None,
+                    help="logical mesh shape, e.g. 16x1 (default: all devices)")
+    ap.add_argument("--axes", default=None,
+                    help="comma-separated axis names (default: data,model)")
+    ap.add_argument("--sizes", default="4KB,64KB,1MB,64MB,1GB",
+                    help="comma-separated tensor sizes for the time matrix")
+    args = ap.parse_args(argv)
+
+    machine = load_machine_model(args.config)
+    networked = isinstance(machine, NetworkedMachineModel)
+    axes = tuple((args.axes or "data,model").split(","))
+    if args.mesh:
+        shape = tuple(int(x) for x in args.mesh.split("x"))
+        mesh = MachineMesh(shape, axes[: len(shape)])
+    else:
+        mesh = _default_mesh(machine)
+    sizes = [_parse_size(s) for s in args.sizes.split(",")]
+
+    if networked:
+        t = machine.slice_topology
+        print(
+            f"machine model: {args.config} (v2) — {machine.num_slices} "
+            f"slice(s) x ici {t.dims} (wrap {t.wrap}), "
+            f"{machine.hosts_per_slice} host(s)/slice, dcn "
+            f"{machine.dcn_uplinks_per_host} x "
+            f"{machine.dcn_bw_per_uplink / 1e9:g} GB/s uplinks/host "
+            f"(contention {machine.dcn_contention}), "
+            f"dcn_axes={tuple(machine.dcn_axes)}"
+        )
+        print("per-dim ici link classes:")
+        for i, (d, l) in enumerate(zip(t.dims, t.links)):
+            print(f"  dim{i}: extent {d}  bw {l.bw / 1e9:g} GB/s  "
+                  f"latency {l.latency * 1e6:g} us  wrap {t.wrap[i]}")
+    else:
+        print(
+            f"machine model: {args.config} (v1 flat) — ici "
+            f"{machine.ici_bw / 1e9:g} GB/s, dcn {machine.dcn_bw / 1e9:g} "
+            f"GB/s, latency {machine.latency * 1e6:g}/"
+            f"{machine.dcn_latency * 1e6:g} us, "
+            f"dcn_axes={tuple(machine.dcn_axes)}"
+        )
+
+    if not machine.legal_mesh(mesh):
+        print(f"mesh {tuple(mesh.shape)} does not embed in this topology "
+              f"— pick --mesh from the legal factorizations", file=sys.stderr)
+        return 2
+    bound = machine.for_mesh(mesh)
+
+    print(f"\nlogical mesh {dict(zip(mesh.axis_names, mesh.shape))}:")
+    print(f"  {'axis':<8}{'size':>5}{'slices':>8}{'intra':>7}"
+          f"{'ici-bw GB/s':>13}{'lat us':>8}  crosses-dcn")
+    live_axes = []
+    for name, size in zip(mesh.axis_names, mesh.shape):
+        if size <= 1:
+            continue
+        live_axes.append(name)
+        if networked:
+            b = bound._axis_bind.get(name)
+            s = b.slices if b else 1
+            intra = b.intra if b else size
+            bw = (b.bw if b else machine.ici_bw) / 1e9
+            lat = (b.lat if b else machine.latency) * 1e6
+        else:
+            s, intra = 1, size
+            bw = machine._bw(name) / 1e9
+            lat = machine._lat(name) * 1e6
+            if name in machine.dcn_axes:
+                s = "dcn"
+        crosses = (isinstance(s, str) or s > 1)
+        print(f"  {name:<8}{size:>5}{str(s):>8}{intra:>7}"
+              f"{bw:>13.1f}{lat:>8.1f}  {'yes' if crosses else 'no'}")
+
+    for kind, label in (("all_reduce", "allreduce"), ("all_gather", "allgather")):
+        print(f"\n{label} time (ms) [per axis; v2 marks the min(ring, "
+              "hierarchical) winner]:")
+        hdr = f"  {'size':<8}"
+        for a in live_axes:
+            hdr += f"{a:>16}"
+        print(hdr)
+        for nbytes in sizes:
+            row = f"  {_fmt_size(nbytes):<8}"
+            for a in live_axes:
+                n = mesh.axis_size(a)
+                if networked:
+                    val, won = _routing_pair(bound, kind, nbytes, n, a)
+                    row += f"{val * 1e3:>11.3f}({won})"
+                else:
+                    val = getattr(bound, kind)(nbytes, n, axis=a)
+                    row += f"{val * 1e3:>16.3f}"
+            print(row)
+    if networked:
+        ds = bound.decision_stats
+        print(f"\nrouting decisions this report: ring={ds['ring']} "
+              f"hierarchical={ds['hierarchical']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

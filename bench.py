@@ -144,6 +144,44 @@ def _median_sps(model, xs, y, batch: int, steps: int, windows: int) -> dict:
     }
 
 
+def _fit_sync_async_ab(model, x, y, batch: int, batches: int) -> dict:
+    """Sync-vs-async A/B on the SAME compiled step, driven through the
+    real ``FFModel.fit`` loop in the same process: ``metrics_sync_every=1``
+    forces the reference behavior (one blocking device round-trip per
+    step) vs the async K-step flush (auto K).  Reports per-mode step
+    time, the executor's host-sync count, and the measured host-side
+    stall (wall time blocked in forced fetches) with its fraction of the
+    loop — the direct evidence that the async pipeline removed the
+    per-step pipeline flush."""
+    import time as _time
+
+    import numpy as np
+
+    ex = model.executor
+    X = np.concatenate([x] * batches)
+    Y = np.concatenate([y] * batches)
+    out = {}
+    for mode, k in (("sync", 1), ("async", 0)):
+        h0, s0 = ex.host_syncs, ex.host_stall_s
+        t0 = _time.perf_counter()
+        model.fit(X, Y, batch_size=batch, epochs=1, verbose=False,
+                  metrics_sync_every=k)
+        total = _time.perf_counter() - t0
+        stall = ex.host_stall_s - s0
+        out[mode] = {
+            "steps": batches,
+            "step_time_ms": round(total / batches * 1e3, 3),
+            "host_syncs": ex.host_syncs - h0,
+            "host_stall_s": round(stall, 6),
+            "stall_fraction": round(stall / total, 4) if total > 0 else 0.0,
+        }
+    out["speedup"] = round(
+        out["sync"]["step_time_ms"] / out["async"]["step_time_ms"], 3
+    ) if out["async"]["step_time_ms"] else None
+    out["metrics_sync_every_async"] = model._resolve_metrics_sync_every(0)
+    return out
+
+
 def _bench_dlrm(on_tpu: bool) -> dict:
     """Embedding-bound DLRM single-chip step (VERDICT r3 #4 / BASELINE.json
     north star; shapes from reference examples/cpp/DLRM/dlrm.cc:114-241 —
@@ -386,6 +424,17 @@ def run_bench(backend: str) -> None:
     head = _median_sps(model, [x], y, batch, steps=steps, windows=repeats)
     samples_per_sec = head["samples_per_sec"]
 
+    # sync-vs-async fit-loop A/B (same process, same compiled step):
+    # the ISSUE-4 acceptance number — how much host-side stall the
+    # per-step metric fetch was costing, and that the async K-step
+    # flush removes it
+    try:
+        fit_ab = _fit_sync_async_ab(
+            model, x, y, batch, batches=32 if on_tpu else 8
+        )
+    except Exception as e:  # noqa: BLE001 — never sink the headline
+        fit_ab = {"error": str(e)[:200]}
+
     # fwd FLOPs from the op inventory; train step ~ 3x fwd (fwd + bwd 2x)
     fwd_flops = sum(
         get_op_def(l.op_type).flops(l)
@@ -426,6 +475,12 @@ def run_bench(backend: str) -> None:
         "sps_min": head["sps_min"],
         "sps_max": head["sps_max"],
         "timing_windows": repeats,
+        # async-fit vocabulary: the effective K the untimed default fit
+        # loop would use, plus the measured sync-vs-async A/B.
+        # tools/bench_compare.py treats metrics_sync_every as comparable
+        # metadata — records that predate it still gate.
+        "metrics_sync_every": fit_ab.get("metrics_sync_every_async"),
+        "fit_sync_async_ab": fit_ab,
         # shared observability vocabulary (docs/OBSERVABILITY.md): the
         # same field names a --metrics-out training stream carries, so
         # tools/bench_compare.py reads bench artifacts and metrics

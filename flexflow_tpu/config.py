@@ -82,6 +82,19 @@ class FFConfig:
     health_spike_factor: float = 4.0  # loss > factor * EMA(loss) => spike
     health_ema_decay: float = 0.9
     health_warmup_steps: int = 5  # finite losses seeding the EMA baseline
+    # --- async training pipeline (docs/OBSERVABILITY.md "Sync points") ---
+    # fetch device-accumulated step metrics to host every K steps
+    # (plus at epoch end).  0 = auto: 1 when --health/--metrics-out/
+    # --profiling demand per-step host observation, else
+    # DEFAULT_METRICS_SYNC_EVERY.  1 = the fully synchronous reference
+    # behavior (one forced device round-trip per step).  An enabled
+    # health monitor / --profiling always forces the effective value to
+    # 1 — their whole point is per-step observation.
+    metrics_sync_every: int = 0
+    # input-pipeline look-ahead: how many batches the loader producer
+    # thread (native ffdl.cc ring or the pure-Python fallback) and the
+    # device placement stage each run ahead of the step loop
+    prefetch_depth: int = 3
     # --- simulator (reference config.h:127-136) ---
     # v1 flat scalars or the v2 multi-slice schema (slices, per-axis ICI
     # link classes, DCN uplinks/contention) — docs/MACHINE_MODEL.md; the
@@ -212,6 +225,10 @@ class FFConfig:
                 self.health_window = int(take())
             elif a == "--health-spike-factor":
                 self.health_spike_factor = float(take())
+            elif a == "--metrics-sync-every":
+                self.metrics_sync_every = int(take())
+            elif a == "--prefetch-depth":
+                self.prefetch_depth = int(take())
             elif a == "--export-strategy" or a == "--export":
                 self.export_strategy_file = take()
             elif a == "--import-strategy" or a == "--import":

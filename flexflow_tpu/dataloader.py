@@ -17,7 +17,10 @@ For multi-host runs, each process slices only its addressable portion
 
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Sequence, Tuple
+import collections
+import queue
+import threading
+from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -76,14 +79,25 @@ class SingleDataLoader:
 class BatchIterator:
     """Zips several loaders (inputs + label) into per-step tuples.
 
-    No explicit prefetch: JAX dispatches device transfers and steps
-    asynchronously, which already overlaps host slicing of batch i+1 with
-    device compute of batch i (the role Legion's async task issue plays in
-    the reference)."""
+    With ``prefetch_depth > 0`` a background producer thread assembles
+    batches ahead of the step loop into a bounded queue — the pure-Python
+    analog of the native ring-buffer loader (``native/ffdl.cc``): host
+    row gather / fancy-indexing of batch i+1 overlaps device compute of
+    batch i.  The producer draws batches in the SAME index order as the
+    unprefetched path (``next_batch(0..n)`` against the epoch's fixed
+    shuffle permutation), so prefetching never changes which rows a step
+    sees.  Shutdown is clean: abandoning the iterator mid-epoch (break /
+    GC) stops and joins the producer — it never blocks forever on a full
+    queue (bounded timed puts against a stop event)."""
 
-    def __init__(self, loaders: Sequence[SingleDataLoader]) -> None:
+    def __init__(
+        self,
+        loaders: Sequence[SingleDataLoader],
+        prefetch_depth: int = 0,
+    ) -> None:
         assert loaders
         self.loaders = list(loaders)
+        self.prefetch_depth = int(prefetch_depth)
         n = {l.num_batches for l in loaders}
         assert len(n) == 1, "loaders disagree on batch count"
         self.num_batches = n.pop()
@@ -93,5 +107,95 @@ class BatchIterator:
             l.reset()
 
     def __iter__(self):
-        for i in range(self.num_batches):
-            yield tuple(l.next_batch(i) for l in self.loaders)
+        if self.prefetch_depth <= 0:
+            for i in range(self.num_batches):
+                yield tuple(l.next_batch(i) for l in self.loaders)
+            return
+        yield from self._iter_prefetched()
+
+    def _iter_prefetched(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
+        stop = threading.Event()
+        done = object()  # end-of-epoch sentinel
+        failed = []  # producer exception, re-raised in the consumer
+
+        def _put(item) -> bool:
+            """Bounded put that yields to the stop event instead of
+            blocking forever when the consumer has gone away."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce() -> None:
+            try:
+                for i in range(self.num_batches):
+                    batch = tuple(l.next_batch(i) for l in self.loaders)
+                    if not _put(batch):
+                        return
+            except BaseException as e:  # surface loader errors in the consumer
+                failed.append(e)
+            _put(done)
+
+        t = threading.Thread(
+            target=produce, daemon=True, name="ffdl-py-prefetch"
+        )
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is done:
+                    if failed:
+                        raise failed[0]
+                    break
+                yield item
+        finally:
+            stop.set()
+            try:  # drain so a producer blocked on a full queue exits now
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5.0)
+
+
+class DevicePrefetcher:
+    """Look-ahead device placement: stage 2 of the 3-stage input pipeline
+    (batch assembly -> H2D placement -> step).
+
+    Wraps any batch iterable (:class:`BatchIterator`,
+    ``NativeBatchIterator``, or a generator) and applies ``place_fn`` —
+    typically ``Executor.place_batch`` — to batch i+1..i+depth-1 while the
+    consumer still runs step i.  ``jax.device_put`` dispatches transfers
+    asynchronously, so "placing ahead" just means issuing the H2D copy
+    early enough that it overlaps device compute instead of sitting on the
+    critical path (the role Legion's deferred index-task launches play in
+    the reference's dataloader, ``dataloader.cc:232-300``)."""
+
+    def __init__(
+        self,
+        it: Any,
+        place_fn: Callable[[Any], Any],
+        depth: int = 2,
+    ) -> None:
+        self.it = it
+        self.place_fn = place_fn
+        self.depth = max(1, int(depth))
+        self.num_batches = getattr(it, "num_batches", None)
+
+    def reset(self) -> None:
+        reset = getattr(self.it, "reset", None)
+        if reset is not None:
+            reset()
+
+    def __iter__(self):
+        staged: collections.deque = collections.deque()
+        for batch in self.it:
+            staged.append(self.place_fn(batch))
+            if len(staged) >= self.depth:
+                yield staged.popleft()
+        while staged:
+            yield staged.popleft()

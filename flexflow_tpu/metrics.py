@@ -10,13 +10,23 @@ TPU-native: metrics are computed inside the jitted step (scalar outputs);
 cross-device reduction is a ``jnp.sum`` the compiler turns into a psum.
 ``PerfMetrics`` accumulates on host across steps, mirroring the reference
 struct (``include/flexflow/metrics_functions.h:19-42``).
+
+Async accumulation: a ``float()`` on a per-step device scalar is a
+blocking device round-trip — one forced pipeline flush per step.
+:class:`DeviceMetricAccumulator` keeps the running ``sum += metric * rows``
+ON DEVICE (a tiny jitted add per step, dispatched asynchronously like the
+step itself) so the training loop fetches host values only at its K-step
+flush boundaries; :meth:`PerfMetrics.merge_sums` folds a drained window
+into the host accumulator.  This is the analog of the reference's
+future-chained ``update_metrics_task`` reduction (``model.cc:3388+``) —
+the host never waits on a metrics future it doesn't need yet.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -49,6 +59,24 @@ class PerfMetrics:
         self.rmse_loss += batch_metrics.get("root_mean_squared_error", 0.0) * batch_size
         self.mae_loss += batch_metrics.get("mean_absolute_error", 0.0) * batch_size
 
+    def merge_sums(self, sums: Dict[str, float], count: int) -> None:
+        """Fold a drained :class:`DeviceMetricAccumulator` window — ``sums``
+        is ``Σ metric_i * rows_i`` over the window's steps, ``count`` the
+        total rows.  Same math as ``count`` calls to :meth:`update` with
+        per-row means, minus the per-step host round-trips; per-metric
+        sums are row-weighted on device so the two paths agree to float32
+        tolerance (``accuracy * rows`` is an integer count up to fp error,
+        so one rounding at the flush recovers the same correct-count as
+        per-step rounding)."""
+        self.train_all += count
+        if "accuracy" in sums:
+            self.train_correct += int(sums["accuracy"] + 0.5)
+        self.cce_loss += sums.get("categorical_crossentropy", 0.0)
+        self.sparse_cce_loss += sums.get("sparse_categorical_crossentropy", 0.0)
+        self.mse_loss += sums.get("mean_squared_error", 0.0)
+        self.rmse_loss += sums.get("root_mean_squared_error", 0.0)
+        self.mae_loss += sums.get("mean_absolute_error", 0.0)
+
     @property
     def accuracy(self) -> float:
         return self.train_correct / max(1, self.train_all)
@@ -58,6 +86,58 @@ class PerfMetrics:
         ``metrics_functions.cc:213-216``)."""
         dt = time.time() - self.start_time
         return self.train_all / dt if dt > 0 else 0.0
+
+
+class DeviceMetricAccumulator:
+    """On-device ``Σ metric * rows`` across a window of steps.
+
+    ``add(metrics, rows)`` dispatches one tiny jitted tree-add (donated
+    running sums, so no per-step garbage) and returns immediately — the
+    device scalars are never fetched, so the step pipeline stays
+    dispatch-ahead.  ``drain()`` is the ONE host synchronization point:
+    it blocks on (and returns) the window's weighted sums plus the row
+    count, then resets.  Weights may vary per call (``eval``'s tail batch
+    passes its real row count)."""
+
+    def __init__(self) -> None:
+        self._sums: Optional[Dict[str, jax.Array]] = None
+        self._count: int = 0
+        self._acc = None  # jitted add, built lazily on the second step
+
+    def add(self, metrics: Dict[str, jax.Array], rows: int) -> None:
+        self._count += rows
+        if not metrics:
+            return
+        w = float(rows)
+        if self._sums is None:
+            # first window step: weighted copy (eager async dispatch)
+            self._sums = {
+                k: jnp.asarray(v, jnp.float32) * w for k, v in metrics.items()
+            }
+            return
+        if self._acc is None:
+            self._acc = jax.jit(
+                lambda s, m, w: {
+                    k: s[k] + jnp.asarray(m[k], jnp.float32) * w for k in s
+                },
+                donate_argnums=(0,),
+            )
+        self._sums = self._acc(self._sums, metrics, w)
+
+    @property
+    def count(self) -> int:
+        """Rows accumulated since the last drain (no device access)."""
+        return self._count
+
+    def drain(self) -> Tuple[Dict[str, float], int]:
+        """Fetch the window's ``(sums, rows)`` to host and reset.  This is
+        the deliberate host sync — callers count it (see
+        ``Executor.count_host_sync``)."""
+        sums = {k: float(v) for k, v in (self._sums or {}).items()}
+        count = self._count
+        self._sums = None
+        self._count = 0
+        return sums, count
 
 
 class Metrics:

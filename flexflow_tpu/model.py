@@ -23,13 +23,18 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
 
 from flexflow_tpu.config import FFConfig
-from flexflow_tpu.dataloader import BatchIterator, SingleDataLoader
+from flexflow_tpu.dataloader import (
+    BatchIterator,
+    DevicePrefetcher,
+    SingleDataLoader,
+)
 from flexflow_tpu.fftype import (
     ActiMode,
     AggrMode,
@@ -40,7 +45,7 @@ from flexflow_tpu.fftype import (
     PoolType,
 )
 from flexflow_tpu.initializer import Initializer
-from flexflow_tpu.metrics import Metrics, PerfMetrics
+from flexflow_tpu.metrics import DeviceMetricAccumulator, Metrics, PerfMetrics
 from flexflow_tpu.obs import (
     configure_from_config,
     configure_monitor_from_config,
@@ -58,6 +63,13 @@ from flexflow_tpu.parallel.strategy import (
 from flexflow_tpu.runtime.executor import Executor
 from flexflow_tpu.runtime.recompile import RecompileState
 from flexflow_tpu.tensor import Layer, Tensor
+
+# auto metric-flush cadence for the async fit loop (K in
+# --metrics-sync-every): large enough that the per-flush host round-trip
+# amortizes to noise, small enough that the R17 recompile trigger and an
+# epoch-end verbose print observe loss within a bounded, human-scale
+# window (docs/OBSERVABILITY.md, "Sync points")
+DEFAULT_METRICS_SYNC_EVERY = 32
 
 
 def _load_substitution_xfers(cfg: FFConfig):
@@ -877,7 +889,14 @@ class FFModel:
         # custom optimizers may lack a 'step' entry in opt_state, so carry
         # it explicitly or the stream replays already-used keys
         old_step = self.executor._step_count
+        # the host-sync ledger is per-RUN accounting (bench A/B and the
+        # async-fit tests read deltas across a whole fit), so it survives
+        # the executor swap
+        old_syncs = self.executor.host_syncs
+        old_stall = self.executor.host_stall_s
         self.compile(**self._compile_call)
+        self.executor.host_syncs = old_syncs
+        self.executor.host_stall_s = old_stall
         if preserve_weights:
             self.executor._step_count = old_step
         if snapshot is None:
@@ -971,6 +990,33 @@ class FFModel:
             self.set_weights(keep)
 
     # ------------------------------------------------------------------- fit
+    def _resolve_metrics_sync_every(
+        self, override: Optional[int] = None
+    ) -> int:
+        """Effective K for the K-step metric flush (``--metrics-sync-every``,
+        docs/OBSERVABILITY.md "Sync points").  An enabled health monitor
+        or ``--profiling`` forces K=1 — both exist to observe every step,
+        and the executor's instrumented path syncs per step anyway.
+        Otherwise: the explicit value, or ``DEFAULT_METRICS_SYNC_EVERY``
+        when unset/auto (0)."""
+        if get_monitor().enabled or self.config.profiling:
+            return 1
+        k = override if override is not None else self.config.metrics_sync_every
+        return int(k) if k and k > 0 else DEFAULT_METRICS_SYNC_EVERY
+
+    def _flush_metrics(
+        self, acc: DeviceMetricAccumulator, pm: PerfMetrics, tracer
+    ) -> None:
+        """Drain the device-side metric window into ``pm`` — the async
+        loop's ONE deliberate host sync per K steps, counted and timed."""
+        if acc.count == 0:
+            return
+        t0 = time.perf_counter()
+        sums, count = acc.drain()
+        self.executor.count_host_sync(1, stall_s=time.perf_counter() - t0)
+        pm.merge_sums(sums, count)
+        tracer.counter("fit.metric_flushes")
+
     def fit(
         self,
         x: Union[np.ndarray, Sequence[np.ndarray]],
@@ -981,18 +1027,33 @@ class FFModel:
         shuffle: bool = False,
         seed: int = 0,
         recompile_state: Optional["RecompileState"] = None,
+        metrics_sync_every: Optional[int] = None,
     ) -> PerfMetrics:
         """Canonical training loop (reference ``FFModel.fit``,
         ``flexflow_cffi.py:2062-2104``).  Each iteration is one cached jit
-        call — the analog of replaying a Legion trace.
+        call — the analog of replaying a Legion trace — and the loop is
+        END-TO-END asynchronous, the analog of Legion deferred execution:
+        the host runs ahead of the devices and never blocks on a result
+        it doesn't need yet.
 
-        Batch assembly runs through the native C++ prefetching loader
-        (``native/ffdl.cc``) when its build is available — a producer
-        thread gathers (optionally shuffled) rows into ring buffers ahead
-        of the step loop — falling back to the pure-Python loaders."""
+        Three-stage input pipeline: batch assembly (the native C++
+        prefetching loader ``native/ffdl.cc`` when its build is
+        available, else the pure-Python loaders with a background
+        producer thread) -> device placement (:class:`DevicePrefetcher`
+        dispatches the H2D transfer of batch i+1 while step i runs) ->
+        the jitted step.
+
+        Metrics accumulate ON DEVICE (``DeviceMetricAccumulator``) and are
+        fetched to host only every ``metrics_sync_every`` steps and at
+        epoch end (K resolution: :meth:`_resolve_metrics_sync_every`;
+        K=1 restores the fully synchronous per-step ``float()`` path).
+        The R17 recompile trigger is evaluated under the same window —
+        it fires within K steps of its condition becoming true
+        (``RecompileState.observe_window``)."""
         assert self.executor is not None, "call compile() first"
-        bs = batch_size or self.config.batch_size
-        epochs = epochs or self.config.epochs
+        cfg = self.config
+        bs = batch_size or cfg.batch_size
+        epochs = epochs or cfg.epochs
         xs = list(x) if isinstance(x, (list, tuple)) else [x]
 
         from flexflow_tpu.runtime.native import (
@@ -1000,10 +1061,11 @@ class FFModel:
             native_available,
         )
 
+        depth = max(1, cfg.prefetch_depth)
         if native_available():
             it = NativeBatchIterator(
                 [np.asarray(a) for a in xs] + [np.asarray(y)], bs,
-                shuffle=shuffle, seed=seed,
+                shuffle=shuffle, seed=seed, prefetch_depth=depth,
             )
         else:
             loaders = [
@@ -1011,26 +1073,39 @@ class FFModel:
                 for a in xs
             ] + [SingleDataLoader(y, bs, None, None, shuffle=shuffle, seed=seed)]
             # identical seed => identical permutation => rows stay aligned
-            it = BatchIterator(loaders)
+            it = BatchIterator(loaders, prefetch_depth=depth)
         if it.num_batches == 0:
             raise ValueError(
                 f"dataset has {len(xs[0])} samples < batch_size {bs}: zero batches"
             )
 
         tracer = get_tracer()
-        profiling = self.config.profiling and jax.process_index() == 0
+        profiling = cfg.profiling and jax.process_index() == 0
+        K = self._resolve_metrics_sync_every(metrics_sync_every)
+        nb = it.num_batches
+        # place_fn resolves self.executor LATE so a mid-epoch recompile
+        # (R17) swaps the placement target along with the step program
+        prefetch = DevicePrefetcher(
+            it, lambda b: self.executor.place_batch(b), depth=depth
+        )
         pm = PerfMetrics()
-        with tracer.span("fit", cat="fit", epochs=epochs, batches=it.num_batches):
+        loss = None
+        with tracer.span(
+            "fit", cat="fit", epochs=epochs, batches=nb, metrics_sync_every=K
+        ):
+            if tracer.enabled:
+                tracer.sample("fit.prefetch_depth", float(depth), level="step")
             for epoch in range(epochs):
                 it.reset()
                 # per-EPOCH accumulation, like the reference's reset_metrics()
                 # at each epoch start (flexflow_cffi.py fit / base_model._train)
                 pm = PerfMetrics()
+                acc = DeviceMetricAccumulator()
+                window: List[Any] = []  # raw device (loss, metrics) for R17
                 with tracer.span("epoch", cat="fit", epoch=epoch):
-                    for bi, batch in enumerate(it):
-                        *bx, by = batch
+                    for bi, (inputs, labels) in enumerate(prefetch):
                         with tracer.span("batch", cat="fit", level="op", batch=bi):
-                            loss, m = self.executor.train_step(bx, by)
+                            loss, m = self.executor.train_step(inputs, labels)
                         # reference --profiling per-iteration ELAPSED prints
                         # (model.cc:3650-3653): per-step wall split
                         if profiling and self.executor.last_step_stats:
@@ -1040,18 +1115,37 @@ class FFModel:
                                 f"{s['total_s'] * 1e3:.2f} ms "
                                 f"(dispatch {s['dispatch_s'] * 1e3:.2f} ms, "
                                 f"device {s['device_s'] * 1e3:.2f} ms, "
+                                f"stall {s['host_stall_s'] * 1e3:.2f} ms, "
                                 f"jit {s['jit_cache']})"
                             )
-                        pm.update({k: float(v) for k, v in m.items()}, bs)
-                        # R17 recompile hook: per-iteration trigger/alter,
-                        # like the reference's recompile_on_condition in the
-                        # train loop (moe.cc:180)
-                        if recompile_state is not None:
-                            recompile_state.observe(
-                                float(loss), {k: float(v) for k, v in m.items()}
+                        if K <= 1:
+                            # synchronous reference path: one forced device
+                            # round-trip per step (pipeline flush), counted
+                            t0 = time.perf_counter()
+                            fl = float(loss)
+                            fm = {k: float(v) for k, v in m.items()}
+                            self.executor.count_host_sync(
+                                1, stall_s=time.perf_counter() - t0
                             )
-                            recompile_state.maybe_recompile(self)
+                            pm.update(fm, bs)
+                            # R17 recompile hook: per-iteration trigger/alter,
+                            # like the reference's recompile_on_condition in
+                            # the train loop (moe.cc:180)
+                            if recompile_state is not None:
+                                recompile_state.observe(fl, fm)
+                                recompile_state.maybe_recompile(self)
+                            continue
+                        acc.add(m, bs)
+                        if recompile_state is not None:
+                            window.append((loss, m))
+                        if (bi + 1) % K == 0 or bi + 1 == nb:
+                            self._flush_metrics(acc, pm, tracer)
+                            if recompile_state is not None and window:
+                                recompile_state.observe_window(window, self)
+                                window = []
                 if verbose:
+                    # the flush already forced the epoch's last step to
+                    # completion, so this float() reads a ready scalar
                     print(
                         f"epoch {epoch}: loss={float(loss):.4f} "
                         f"accuracy={pm.accuracy:.4f} "
@@ -1074,7 +1168,10 @@ class FFModel:
         reset metrics, iterate batches, accumulate PerfMetrics).  A tail
         batch shorter than ``batch_size`` is padded to the compiled batch
         shape (one jit trace) but only its real rows enter the metrics,
-        each batch weighted by its actual row count."""
+        each batch weighted by its actual row count.  Reuses fit's async
+        input pipeline (placement look-ahead) and device-side metric
+        accumulation — ONE host sync for the whole pass instead of one
+        per batch."""
         assert self.executor is not None, "call compile() first"
         bs = batch_size or self.config.batch_size
         xs = [
@@ -1091,7 +1188,11 @@ class FFModel:
             f"inputs/labels disagree on sample count: "
             f"{[a.shape[0] for a in xs]} vs labels {ya.shape[0]}"
         )
-        with get_tracer().span("eval", cat="fit", samples=n):
+
+        # same 3-stage pipeline as fit: batch slicing/padding -> device
+        # placement look-ahead -> forward; metrics accumulate on device and
+        # are fetched ONCE at the end (no per-batch float() round-trips)
+        def batches():
             for start in range(0, n, bs):
                 rows = min(bs, n - start)
                 bx = [a[start:start + rows] for a in xs]
@@ -1100,9 +1201,32 @@ class FFModel:
                         np.concatenate([b, np.repeat(b[-1:], bs - rows, axis=0)])
                         for b in bx
                     ]
-                logits = ex.forward(bx)
-                m = ex.metrics.compute(logits[:rows], _jnp.asarray(ya[start:start + rows]))
-                pm.update({k: float(v) for k, v in m.items()}, rows)
+                yield bx, ya[start:start + rows], rows
+
+        def place(item):
+            bx, yb, rows = item
+            placed = [
+                ex._place(b, ex._input_pspec(t), t.shape[0])
+                for b, t in zip(bx, ex.graph_inputs)
+            ]
+            return placed, _jnp.asarray(yb), rows
+
+        prefetch = DevicePrefetcher(
+            batches(), place, depth=max(1, self.config.prefetch_depth)
+        )
+        acc = DeviceMetricAccumulator()
+        with get_tracer().span("eval", cat="fit", samples=n):
+            for placed, yb, rows in prefetch:
+                logits = ex.forward(placed)
+                # only the real rows enter the metrics: a padded tail
+                # batch is sliced back to its actual row count, and each
+                # batch is weighted by that count in the accumulator
+                m = ex.metrics.compute(logits[:rows], yb)
+                acc.add(m, rows)
+            t0 = time.perf_counter()
+            sums, count = acc.drain()
+            ex.count_host_sync(1, stall_s=time.perf_counter() - t0)
+            pm.merge_sums(sums, count)
         if verbose:
             print("eval: " + " ".join(
                 f"{k}={v:.4f}" for k, v in (("accuracy", pm.accuracy),)

@@ -200,6 +200,7 @@ class HealthMonitor:
             host_s=stats.get("host_s"),
             dispatch_s=stats.get("dispatch_s"),
             device_s=stats.get("device_s"),
+            host_stall_s=stats.get("host_stall_s"),
             compile_s=stats.get("compile_s"),
             jit_cache=stats.get("jit_cache"),
             samples=samples,

@@ -17,7 +17,9 @@ Record schema (``METRICS_SCHEMA``; see docs/OBSERVABILITY.md):
     when the monitor ran without diagnostics)
   * throughput — ``samples_per_s``, ``tokens_per_s`` (null when the
     model has no sequence dim), ``step_wall_s``, ``host_s``,
-    ``dispatch_s``, ``device_s``, ``compile_s``, ``jit_cache``
+    ``dispatch_s``, ``device_s``, ``host_stall_s`` (wall time the host
+    spent blocked on a forced device sync — the instrumented path's
+    per-step ``block_until_ready`` window), ``compile_s``, ``jit_cache``
   * memory — ``hbm_peak_bytes`` (``device.memory_stats()`` high-water
     when the backend reports one, else null)
   * ``counters`` — tracer counter DELTAS since the previous record
@@ -53,6 +55,7 @@ RECORD_FIELDS = (
     "host_s",
     "dispatch_s",
     "device_s",
+    "host_stall_s",
     "compile_s",
     "jit_cache",
     "hbm_peak_bytes",
@@ -97,6 +100,7 @@ def step_record(
     host_s: Optional[float] = None,
     dispatch_s: Optional[float] = None,
     device_s: Optional[float] = None,
+    host_stall_s: Optional[float] = None,
     compile_s: Optional[float] = None,
     jit_cache: Optional[str] = None,
     samples: Optional[int] = None,
@@ -120,6 +124,7 @@ def step_record(
         ("host_s", host_s),
         ("dispatch_s", dispatch_s),
         ("device_s", device_s),
+        ("host_stall_s", host_stall_s),
         ("compile_s", compile_s),
         ("hbm_peak_bytes", hbm_peak_bytes),
     ):

@@ -42,6 +42,8 @@ LEVELS = ("off", "step", "op")
 CORE_COUNTERS = (
     "jit.cache_hit",
     "jit.cache_miss",
+    "executor.host_syncs",
+    "fit.metric_flushes",
     "recompile.count",
     "search.candidates_explored",
     "search.rewrites_considered",

@@ -119,6 +119,18 @@ class Executor:
         # path is untouched when both are off.
         self.profiling = profiling
         self.last_step_stats: Optional[Dict[str, Any]] = None
+        # host-sync ledger: every DELIBERATE host-side result fetch the
+        # training/eval loops issue (per-step scalar fetches in sync mode,
+        # K-step metric flushes in async mode) increments host_syncs via
+        # count_host_sync, and the blocking wall time lands in
+        # host_stall_s.  Plain attributes, always on (one int add) — the
+        # tests' zero-per-step-sync guard reads them without a tracer;
+        # count_host_sync mirrors into the tracer counter when enabled.
+        # The instrumented path's block_until_ready is NOT in host_syncs
+        # (it is the documented profiling sync, reported per step as
+        # last_step_stats["host_stall_s"]) but its stall does accumulate.
+        self.host_syncs = 0
+        self.host_stall_s = 0.0
         self._step_compiled = None  # AOT executable (traced path only)
         self._fwd_seqs_seen: set = set()  # fwd jit-cache hit/miss tracking
         # run-health monitor vocabulary: samples (and tokens when the
@@ -472,6 +484,35 @@ class Executor:
         return jax.jit(fwd, static_argnums=(3,))
 
     # --- public API --------------------------------------------------------
+    def count_host_sync(self, n: int = 1, stall_s: float = 0.0) -> None:
+        """Record ``n`` deliberate host syncs (forced device round-trips
+        issued by a training/eval loop) and the wall time the host spent
+        blocked in them.  Mirrors into the ``executor.host_syncs`` tracer
+        counter when tracing is on, so the trace summary shows the sync
+        cadence (docs/OBSERVABILITY.md, "Sync points")."""
+        self.host_syncs += n
+        self.host_stall_s += stall_s
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.counter("executor.host_syncs", float(n))
+
+    def place_batch(self, batch: Sequence[Any]) -> Tuple[List[Any], Any]:
+        """Stage one ``(x0..xk, y)`` batch onto devices: the placement leg
+        of the input pipeline, shared by ``fit``/``eval`` through
+        :class:`flexflow_tpu.dataloader.DevicePrefetcher` so H2D transfer
+        of batch i+1 dispatches while step i runs.  ``train_step`` /
+        ``forward`` re-run ``_place`` on the results, which short-circuits
+        already-committed arrays."""
+        *bx, by = batch
+        inputs = [
+            self._place(x, self._input_pspec(t), t.shape[0])
+            for x, t in zip(bx, self.graph_inputs)
+        ]
+        labels = self._place(
+            by, self._label_pspec(), self.graph_inputs[0].shape[0]
+        )
+        return inputs, labels
+
     def train_step(self, inputs: Sequence[Any], labels: Any) -> Tuple[float, Dict[str, float]]:
         tracer = get_tracer()
         if not (tracer.enabled or self.profiling or get_monitor().enabled):
@@ -573,12 +614,20 @@ class Executor:
         self.params, self.state, self.opt_state, loss, m = out
         self._step_count += 1
         total_s = time.perf_counter() - t_begin
+        # host_stall_s: wall time the host spent BLOCKED waiting on the
+        # device — here exactly the block_until_ready window, because the
+        # instrumented path forces one sync per step by design (that is
+        # what makes the wall split measurable; docs/OBSERVABILITY.md
+        # "Sync points").  The untraced fast path never stalls, so an
+        # async fit loop with instrumentation off accumulates ~0 here.
+        self.host_stall_s += device_s
         self.last_step_stats = {
             "step": step_no,
             "total_s": total_s,
             "host_s": total_s - device_s,
             "dispatch_s": dispatch_s,
             "device_s": device_s,
+            "host_stall_s": device_s,
             "compile_s": compile_s,
             "jit_cache": "miss" if compile_s else "hit",
         }

@@ -79,6 +79,10 @@ class NativeBatchIterator:
     """Drop-in for :class:`flexflow_tpu.dataloader.BatchIterator` backed by
     the C++ prefetching loader: a producer thread assembles (optionally
     shuffled) batches for all arrays into a ring of contiguous buffers.
+    ``prefetch_depth`` is the ring size — ``FFModel.fit`` wires it from
+    ``--prefetch-depth``, the same look-ahead the pure-Python fallback's
+    producer thread and the device-placement stage use, so the 3-stage
+    input pipeline has one depth knob end to end.
 
     Returned numpy arrays are **owned copies** of the ring slots.  They
     must not be views: the CPU backend zero-copy-aliases aligned host

@@ -46,6 +46,28 @@ class RecompileState:
         self.last_loss = loss
         self.last_metrics = metrics
 
+    def observe_window(self, window, model) -> bool:
+        """Windowed observe for the async ``fit`` loop: replay a K-step
+        buffer of raw DEVICE ``(loss, metrics)`` pairs at a flush
+        boundary.  The per-step ``float()`` conversions here read values
+        the flush already forced to completion — host copies of ready
+        scalars, not fresh pipeline stalls — so deferring the trigger
+        costs at most K steps of latency and zero extra syncs.  The
+        trigger is evaluated after EVERY observed step (not once per
+        window), so a trigger keyed on a specific iteration count still
+        sees that exact iteration; it just fires up to K-1 steps after
+        the condition became true (immediately when K=1, where ``fit``
+        calls :meth:`observe` directly).  Returns True when any
+        recompilation fired."""
+        fired = False
+        for loss, metrics in window:
+            self.observe(
+                float(loss), {k: float(v) for k, v in metrics.items()}
+            )
+            if self.maybe_recompile(model):
+                fired = True
+        return fired
+
     def maybe_recompile(self, model) -> bool:
         """Reference ``FFModel::recompile_on_condition`` analog: fire the
         trigger, run alter + recompile when true."""

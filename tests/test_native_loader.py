@@ -67,6 +67,37 @@ def test_pointer_validity_window():
     np.testing.assert_array_equal(first, snapshot)
 
 
+def test_native_and_python_prefetch_share_loader_contract():
+    """The native ring loader and the pure-Python prefetching
+    BatchIterator satisfy the SAME semantics fit relies on (their
+    shuffle RNGs differ — xorshift vs PCG — so exact orders can't
+    match, but the contract must): per-epoch permutation of all rows,
+    row alignment across arrays, deterministic per seed, same batch
+    count."""
+    import numpy as np
+
+    from flexflow_tpu.dataloader import BatchIterator, SingleDataLoader
+
+    n, bs = 128, 16
+    x = np.arange(n, dtype=np.float32).reshape(n, 1)
+    y = np.arange(n, dtype=np.int64).reshape(n, 1)
+    native = NativeBatchIterator([x, y], bs, shuffle=True, seed=5)
+    python = BatchIterator(
+        [SingleDataLoader(x, bs, None, None, shuffle=True, seed=5),
+         SingleDataLoader(y, bs, None, None, shuffle=True, seed=5)],
+        prefetch_depth=3,
+    )
+    assert native.num_batches == python.num_batches == n // bs
+    for it in (native, python):
+        it.reset()
+        pairs = [(bx.copy(), by.copy()) for bx, by in it]
+        all_x = np.concatenate([bx for bx, _ in pairs]).ravel()
+        all_y = np.concatenate([by for _, by in pairs]).ravel()
+        np.testing.assert_array_equal(all_x.astype(np.int64), all_y)
+        np.testing.assert_array_equal(np.sort(all_y), np.arange(n))
+        assert not np.array_equal(all_y, np.arange(n))
+
+
 def test_fit_with_native_loader_converges():
     """End-to-end: FFModel.fit drives the native iterator (shuffled)."""
     from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, MetricsType, SGDOptimizer

@@ -18,7 +18,15 @@ identity (``preset:<chip>`` / ``file:<sha256/12>`` from the priced
 ``--machine-model-file``): a run priced against a different topology is
 a different experiment, not a regression — the gate refuses to compare.
 Records predating the identity field (no ``machine_model`` key) compare
-as before.  The measured metrics on both sides:
+as before.
+
+``metrics_sync_every`` (the async-fit flush cadence, new in r06 records)
+is COMPARABLE metadata, not an identity: a sync-mode and an async-mode
+run measure the same hardware doing the same math, so they still gate
+against each other — a differing value is printed as a note, never a
+refusal, and legacy records without the field gate unchanged.
+
+The measured metrics on both sides:
 
   * headline ``value`` (samples/s, higher is better)
   * ``secondary.dlrm.samples_per_sec``, ``secondary.bert_large.samples_per_sec``
@@ -48,6 +56,11 @@ import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 DEFAULT_THRESHOLD = 0.15
+
+# record keys that may legitimately differ between comparable runs —
+# noted in the output, but never a reason to refuse the comparison
+# (contrast: a machine_model mismatch is a different experiment)
+COMPARABLE_METADATA = ("metrics_sync_every",)
 
 # (label, path into the record, higher_is_better) — the gated metrics
 GATED = (
@@ -192,6 +205,13 @@ def main(argv=None) -> int:
         for p, _r in dropped:
             print(f"bench_compare: skipping {p} (different machine model)")
     base_path, base = matched[-1]
+    for key in COMPARABLE_METADATA:
+        if key in (current.keys() | base.keys()) and (
+            current.get(key) != base.get(key)
+        ):
+            print(f"bench_compare: note — {key} differs "
+                  f"({base.get(key)!r} -> {current.get(key)!r}); comparable "
+                  f"metadata, still gating")
 
     rows = compare(current, base, args.threshold)
     if not rows:

@@ -182,6 +182,81 @@ def _fit_sync_async_ab(model, x, y, batch: int, batches: int) -> dict:
     return out
 
 
+def _compile_stacked_ab(on_tpu: bool) -> dict:
+    """Stacked-vs-unrolled compile A/B (ISSUE 5): the SAME model traced +
+    AOT-compiled with ``--stack-blocks auto`` (repeated transformer
+    blocks execute as one ``jax.lax.scan`` over depth-stacked params)
+    vs ``off`` (today's unrolled path), at BERT-Base depth 12 and a
+    depth-24 variant on the CPU-smoke shapes.  Records per arm:
+    ``trace_s`` (jit lower), ``jit_compile_s`` (XLA compile of the
+    lowered step), and the steady-state ``step_time_ms`` — stacking
+    trades some cross-layer fusion for depth-independent compile, so
+    both sides of that trade are recorded."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
+    from flexflow_tpu.models.transformer import transformer_encoder
+
+    batch, seq, hidden = (8, 128, 256) if on_tpu else (4, 64, 128)
+
+    def arm(stack: str, layers: int) -> dict:
+        cfg = FFConfig(batch_size=batch, stack_blocks=stack)
+        m = FFModel(cfg)
+        transformer_encoder(
+            m, batch=batch, seq=seq, hidden=hidden, heads=8,
+            ff_dim=2 * hidden, num_layers=layers, vocab=1000,
+            num_classes=16, use_flash=False, raw_input=True,
+        )
+        m.compile(
+            optimizer=AdamOptimizer(alpha=1e-4),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY, seed=0,
+        )
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(batch, seq, hidden)).astype(np.float32)
+        y = rng.integers(0, 16, size=(batch, 1)).astype(np.int32)
+        ex = m.executor
+        ex._step_jit = ex._build_step()
+        inputs, labels = ex.place_batch([x, y])
+        args = (ex.params, ex.state, ex.opt_state, inputs, labels, 0)
+        t0 = _time.perf_counter()
+        lowered = ex._step_jit.lower(*args)
+        trace_s = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        compiled = lowered.compile()
+        compile_s = _time.perf_counter() - t0
+        out = jax.block_until_ready(compiled(*args))
+        steps = 5
+        t0 = _time.perf_counter()
+        for i in range(steps):
+            out = compiled(out[0], out[1], out[2], inputs, labels, i + 1)
+        jax.block_until_ready(out)
+        return {
+            "trace_s": round(trace_s, 3),
+            "jit_compile_s": round(compile_s, 3),
+            "step_time_ms": round(
+                (_time.perf_counter() - t0) / steps * 1e3, 2
+            ),
+        }
+
+    out = {"config": f"b={batch} s={seq} h={hidden} (cpu smoke)" if not on_tpu
+           else f"b={batch} s={seq} h={hidden}"}
+    for layers in (12, 24):
+        un = arm("off", layers)
+        st = arm("auto", layers)
+        tot_un = un["trace_s"] + un["jit_compile_s"]
+        tot_st = st["trace_s"] + st["jit_compile_s"]
+        out[f"depth{layers}"] = {
+            "unrolled": un,
+            "stacked": st,
+            "trace_compile_speedup": round(tot_un / tot_st, 2)
+            if tot_st > 0 else None,
+        }
+    return out
+
+
 def _bench_dlrm(on_tpu: bool) -> dict:
     """Embedding-bound DLRM single-chip step (VERDICT r3 #4 / BASELINE.json
     north star; shapes from reference examples/cpp/DLRM/dlrm.cc:114-241 —
@@ -481,6 +556,10 @@ def run_bench(backend: str) -> None:
         # metadata — records that predate it still gate.
         "metrics_sync_every": fit_ab.get("metrics_sync_every_async"),
         "fit_sync_async_ab": fit_ab,
+        # scan-stacked repeated blocks (--stack-blocks, docs/PERF.md):
+        # comparable metadata for the gate, like metrics_sync_every
+        "stack_blocks": cfg.stack_blocks,
+        "compile_stacked_ab": None,
         # shared observability vocabulary (docs/OBSERVABILITY.md): the
         # same field names a --metrics-out training stream carries, so
         # tools/bench_compare.py reads bench artifacts and metrics
@@ -528,6 +607,12 @@ def run_bench(backend: str) -> None:
     # flash vs XLA sdpa at s=512 and s=2048, fwd+bwd.  Chained-scan
     # timing amortizes tunnel dispatch overhead (tools/bench_attention.py).
     record["attn_core_fwdbwd"] = _attention_core_compare() if on_tpu else None
+    # stacked-vs-unrolled compile A/B (ISSUE 5 acceptance): contained so
+    # a failure can never sink the headline
+    try:
+        record["compile_stacked_ab"] = _compile_stacked_ab(on_tpu)
+    except Exception as e:  # noqa: BLE001
+        record["compile_stacked_ab"] = {"error": str(e)[:200]}
     record["secondary"] = _bench_secondary(on_tpu)
     print(json.dumps(record), flush=True)
 

@@ -1,0 +1,347 @@
+"""Repeated-block detection over the PCG.
+
+Deep models are chains of structurally identical blocks (BERT-Large's
+173-layer PCG is ~24 copies of one 7-layer transformer block), yet both
+the executor's trace/compile and the search's frontier DP walk every
+layer.  This module finds maximal chains of repeated blocks so that
+
+  * the executor can run one ``jax.lax.scan`` over depth-stacked
+    parameters (``runtime/executor.py``, ``--stack-blocks``) — compile
+    cost becomes depth-independent, and
+  * the search can price ONE block per (signature, sharding) and
+    multiply by the repeat count (``search/dp.py`` / ``search/cost.py``).
+
+The structure hash follows the ``BatchSiblings._group_key`` discipline
+(``search/algebraic.py``): op type, input/output shapes and dtypes,
+attrs, and *initializer identity* — two separately constructed
+``GlorotUniform(0)`` compare equal, differently parameterized (or
+differently typed) initializers never do, so layers that would draw
+weights from different distributions are never merged.
+
+A chain is valid only when the blocks are *wired* identically:
+
+  * internal edges reference the same relative (layer, output) position;
+  * every cross-block edge goes to the previous block's LAST layer's
+    first output (the scan carry), and block 0's corresponding edges all
+    read one external tensor (the chain input, same shape/dtype as the
+    carry);
+  * any other external input is the SAME tensor in every block (a shared
+    operand — closure-captured by the scan body, e.g. an attention
+    mask);
+  * no intermediate tensor escapes its block, and the chain output is
+    the last block's last output.
+
+Pure graph analysis — no jax imports, usable by both the runtime and the
+host-side search.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from flexflow_tpu.tensor import Layer
+
+
+def _freeze(v) -> object:
+    """Hashable value identity for one attr (``Layer.params_key`` analog
+    that also canonicalizes initializers — see module docstring)."""
+    if v is None:
+        return None
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, (int, float, str, bool, bytes)):
+        return v
+    if hasattr(v, "value") and isinstance(getattr(v, "value"), (str, int)):
+        return v.value  # enums (OperatorType / DataType / ActiMode ...)
+    if callable(v) and hasattr(v, "__dict__"):
+        # initializer identity: type + constructor state (the
+        # BatchSiblings._initializer_key discipline) — never object id
+        return ("init", type(v).__name__) + tuple(
+            sorted((k, repr(x)) for k, x in vars(v).items())
+        )
+    return repr(v)
+
+
+def layer_signature(layer: Layer) -> Tuple:
+    """Structural hash of one layer: everything that determines its math
+    and its weight shapes/distributions EXCEPT its name and the identity
+    of its input tensors (wiring is checked separately).  Memoized on
+    the Layer object — layers are immutable once built (rewrite tiers
+    clone instead of mutating), and the search estimates thousands of
+    graph variants that share layer objects."""
+    sig = layer.__dict__.get("_struct_sig")
+    if sig is None:
+        sig = (
+            layer.op_type.value,
+            tuple(t.shape for t in layer.inputs),
+            tuple(t.dtype.value for t in layer.inputs),
+            tuple(t.shape for t in layer.outputs),
+            tuple(t.dtype.value for t in layer.outputs),
+            tuple(sorted((k, _freeze(v)) for k, v in layer.attrs.items())),
+        )
+        layer.__dict__["_struct_sig"] = sig
+    return sig
+
+
+@dataclasses.dataclass
+class BlockChain:
+    """One maximal run of ``depth`` structurally identical blocks of
+    ``block_len`` layers each, starting at ``layers[start]`` of the
+    owning layer list."""
+
+    start: int
+    block_len: int
+    depth: int
+    layers: List[List[Layer]]  # depth x block_len, topo order
+    carry_in_guid: int  # tensor feeding block 0 at the carry positions
+    shared_guids: Tuple[int, ...]  # external tensors identical across blocks
+
+    @property
+    def template(self) -> List[Layer]:
+        return self.layers[0]
+
+    @property
+    def end(self) -> int:
+        """Index one past the chain's last layer."""
+        return self.start + self.depth * self.block_len
+
+    @property
+    def out_guid(self) -> int:
+        """The chain's output tensor (last block's last layer, output 0)."""
+        return self.layers[-1][-1].outputs[0].guid
+
+    @property
+    def template_out_guid(self) -> int:
+        return self.layers[0][-1].outputs[0].guid
+
+    def member_index(self) -> Dict[str, Tuple[str, int]]:
+        """layer name -> (template layer name, depth index) for every
+        member layer (the executor's stacked-param routing table)."""
+        out: Dict[str, Tuple[str, int]] = {}
+        for d, block in enumerate(self.layers):
+            for j, l in enumerate(block):
+                out[l.name] = (self.template[j].name, d)
+        return out
+
+
+def _try_chain(
+    layers: List[Layer],
+    sigs: List[Tuple],
+    produced: Dict[int, Tuple[int, int]],  # tensor guid -> (layer idx, out idx)
+    consumers: Dict[int, List[int]],  # tensor guid -> consumer layer indices
+    s: int,
+    block_len: int,
+) -> Optional[BlockChain]:
+    """Longest valid chain of period ``block_len`` starting at ``s``
+    (None when fewer than 2 repeats hold)."""
+    n = len(layers)
+    L = block_len
+    tmpl = layers[s : s + L]
+    tmpl_pos = {int(l.layer_guid): j for j, l in enumerate(tmpl)}
+
+    # classify each template input position once: "internal" (produced
+    # within the block), else external — split into carry vs shared by
+    # looking at block 1 (positions where block 1 reads block 0's last
+    # output are the carry; everything else must be guid-identical).
+    internal: Dict[Tuple[int, int], Tuple[int, int]] = {}
+    external: List[Tuple[int, int]] = []
+    for j, l in enumerate(tmpl):
+        for p, t in enumerate(l.inputs):
+            src = produced.get(t.guid)
+            if src is not None and s <= src[0] < s + L:
+                internal[(j, p)] = (src[0] - s, src[1])
+            else:
+                external.append((j, p))
+
+    def block_ok(r: int, carry_pos: Optional[set]) -> Optional[set]:
+        """Validate block ``r`` against the template; returns the carry
+        position set (computed for r==1, verified for r>1)."""
+        base = s + r * L
+        if base + L > n:
+            return None
+        prev_out = layers[base - 1].outputs[0].guid if r > 0 else None
+        pos = set() if carry_pos is None else carry_pos
+        for j in range(L):
+            l = layers[base + j]
+            if sigs[base + j] != sigs[s + j]:
+                return None
+            if len(l.inputs) != len(tmpl[j].inputs):
+                return None
+            for p, t in enumerate(l.inputs):
+                key = (j, p)
+                if key in internal:
+                    src = produced.get(t.guid)
+                    if src is None:
+                        return None
+                    jj, oi = internal[key]
+                    if src != (base + jj, oi):
+                        return None
+                    continue
+                tguid = tmpl[j].inputs[p].guid
+                if r == 0:
+                    continue  # template external inputs classified below
+                if t.guid == tguid:
+                    if carry_pos is not None and key in carry_pos:
+                        return None  # carry in one block, shared in another
+                    continue
+                if t.guid != prev_out:
+                    return None
+                if carry_pos is None:
+                    pos.add(key)
+                elif key not in carry_pos:
+                    return None
+        return pos
+
+    if block_ok(0, None) is None:
+        return None
+    carry_pos = block_ok(1, None)
+    if carry_pos is None or not carry_pos:
+        # no second block, or the blocks share no carry edge (fully
+        # disconnected repeats are not a scan-able chain)
+        return None
+    # all template carry positions must read ONE external tensor of the
+    # same shape/dtype as the block output (the scan carry)
+    carry_guids = {tmpl[j].inputs[p].guid for j, p in carry_pos}
+    if len(carry_guids) != 1:
+        return None
+    carry_in_guid = next(iter(carry_guids))
+    carry_t = next(
+        tmpl[j].inputs[p] for j, p in carry_pos
+    )
+    out_t = tmpl[-1].outputs[0]
+    if carry_t.shape != out_t.shape or carry_t.dtype != out_t.dtype:
+        return None
+    # the carry tensor must not also appear at a non-carry external
+    # position (it would be stale once the scan starts iterating)
+    for j, p in external:
+        if (j, p) not in carry_pos and tmpl[j].inputs[p].guid == carry_in_guid:
+            return None
+
+    depth = 2
+    while block_ok(depth, carry_pos) is not None:
+        depth += 1
+
+    # escape check: no intermediate output consumed outside its block;
+    # each block's last output consumed only by the next block (the last
+    # block's output may flow downstream).  On violation, truncate the
+    # chain just before the offending block.
+    def escapes_ok(k: int) -> Optional[int]:
+        end = s + k * L
+        for r in range(k):
+            base = s + r * L
+            for j in range(L):
+                for o in layers[base + j].outputs:
+                    for ci in consumers.get(o.guid, ()):
+                        if base <= ci < base + L:
+                            continue  # intra-block
+                        if j == L - 1 and o.owner_idx == 0:
+                            if r < k - 1 and base + L <= ci < base + 2 * L:
+                                continue  # the carry edge
+                            if r == k - 1 and ci >= end:
+                                continue  # chain output downstream
+                        return r  # violation: truncate before block r
+        return None
+
+    while depth >= 2:
+        bad = escapes_ok(depth)
+        if bad is None:
+            break
+        depth = bad if bad >= 2 else 0
+    if depth < 2:
+        return None
+
+    blocks = [
+        layers[s + r * L : s + (r + 1) * L] for r in range(depth)
+    ]
+    shared = tuple(
+        sorted(
+            {
+                tmpl[j].inputs[p].guid
+                for j, p in external
+                if (j, p) not in carry_pos
+            }
+        )
+    )
+    return BlockChain(
+        start=s,
+        block_len=L,
+        depth=depth,
+        layers=blocks,
+        carry_in_guid=carry_in_guid,
+        shared_guids=shared,
+    )
+
+
+def invalidate_signatures(layers: List[Layer]) -> None:
+    """Drop the memoized structure hashes for ``layers`` and every
+    cached detection result.  Needed after IN-PLACE layer mutation —
+    the R17 recompile path's alter functions edit ``layer.attrs``
+    directly (e.g. MoE capacity ``alpha``), which the guid-keyed memos
+    cannot see.  ``FFModel.recompile`` calls this before re-detecting."""
+    _DETECT_MEMO.clear()
+    for l in layers:
+        l.__dict__.pop("_struct_sig", None)
+
+
+# (guid tuple, min_depth, max_block_len) -> chains.  The search costs
+# thousands of graph variants per run, most sharing the same layer list
+# — re-detection would dominate estimate_strategy_cost (measured 28 s of
+# a 38 s BERT-Large unity_search before this memo).  Bounded FIFO.
+_DETECT_MEMO: Dict[Tuple, List[BlockChain]] = {}
+_DETECT_MEMO_MAX = 256
+
+
+def detect_block_chains(
+    layers: List[Layer], min_depth: int = 2, max_block_len: Optional[int] = None
+) -> List[BlockChain]:
+    """Greedy left-to-right scan for maximal non-overlapping chains.
+
+    At each start offset every period up to ``max_block_len`` (default:
+    half the remaining graph) is tried and the chain saving the most
+    layers — ``(depth - 1) * block_len``, ties to the shorter period —
+    wins; the scan then resumes past it.  O(n²) signature comparisons
+    with n in the hundreds; memoized per layer-guid tuple.
+    """
+    memo_key = (
+        tuple(int(l.layer_guid) for l in layers), min_depth, max_block_len
+    )
+    hit = _DETECT_MEMO.get(memo_key)
+    if hit is not None:
+        return hit
+    n = len(layers)
+    sigs = [layer_signature(l) for l in layers]
+    produced: Dict[int, Tuple[int, int]] = {}
+    consumers: Dict[int, List[int]] = {}
+    for i, l in enumerate(layers):
+        for t in l.outputs:
+            produced[t.guid] = (i, t.owner_idx)
+        for t in l.inputs:
+            consumers.setdefault(t.guid, []).append(i)
+
+    chains: List[BlockChain] = []
+    s = 0
+    while s < n - 1:
+        best: Optional[BlockChain] = None
+        limit = max_block_len or (n - s) // 2
+        for L in range(1, min(limit, (n - s) // 2) + 1):
+            # quick reject: the second block's signatures must match
+            if sigs[s + L : s + 2 * L] != sigs[s : s + L]:
+                continue
+            c = _try_chain(layers, sigs, produced, consumers, s, L)
+            if c is None or c.depth < min_depth:
+                continue
+            saved = (c.depth - 1) * c.block_len
+            if best is None or saved > (best.depth - 1) * best.block_len:
+                best = c
+        if best is not None:
+            chains.append(best)
+            s = best.end
+        else:
+            s += 1
+    if len(_DETECT_MEMO) >= _DETECT_MEMO_MAX:
+        _DETECT_MEMO.pop(next(iter(_DETECT_MEMO)))
+    _DETECT_MEMO[memo_key] = chains
+    return chains

@@ -125,6 +125,19 @@ class FFConfig:
     # S^2-shaped residuals), or "all" (checkpoint every op).  The TPU form
     # of trading FLOPs for HBM (jax.checkpoint).
     remat_policy: str = "none"
+    # scan-stacked repeated blocks (docs/PERF.md): execute maximal chains
+    # of structurally identical layer blocks as ONE jax.lax.scan over
+    # depth-stacked parameters, making trace/compile cost per unique
+    # block instead of per layer.  "auto" stacks chains of depth >= 4,
+    # "on" stacks any detected chain (depth >= 2), "off" is byte-identical
+    # to the unrolled path.
+    stack_blocks: str = "auto"  # on | off | auto
+    # JAX persistent compilation cache directory (--compile-cache-dir):
+    # compiled step programs are written to / served from disk, so
+    # repeated bench/search runs skip recompiles entirely; a compile
+    # served from disk emits the jit_cache.persistent_hit tracer counter
+    # (docs/OBSERVABILITY.md).  None = in-memory jit cache only.
+    compile_cache_dir: Optional[str] = None
     rng_seed: int = 0
     memory_search_budget: int = -1  # lambda search iterations (graph.cc:2075)
     device_memory_gb: float = -1.0  # per-device HBM budget for λ mem search
@@ -201,6 +214,10 @@ class FFConfig:
                 self.only_data_parallel = True
             elif a == "--remat":
                 self.remat_policy = take()
+            elif a == "--stack-blocks":
+                self.stack_blocks = take()
+            elif a == "--compile-cache-dir":
+                self.compile_cache_dir = take()
             elif a == "--enable-parameter-parallel":
                 self.enable_parameter_parallel = True
             elif a == "--disable-parameter-parallel":
@@ -271,6 +288,47 @@ class FFConfig:
                 rest.append(a)
             i += 1
         return rest
+
+
+def apply_compile_cache(cache_dir: Optional[str]) -> bool:
+    """Enable JAX's persistent compilation cache at ``cache_dir``
+    (``--compile-cache-dir``): compiled executables are keyed by program
+    hash and served from disk across processes, so repeated bench/search
+    runs skip recompiles entirely.  The min-size/min-time gates are
+    zeroed so even smoke-scale step programs cache.  Returns whether the
+    cache was enabled (False when ``cache_dir`` is falsy); unsupported
+    knobs on older jax are skipped silently — the cache then simply
+    applies its defaults."""
+    if not cache_dir:
+        return False
+    import jax as _jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    changed = (
+        getattr(_jax.config, "jax_compilation_cache_dir", None) != cache_dir
+    )
+    _jax.config.update("jax_compilation_cache_dir", cache_dir)
+    for opt, val in (
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+    ):
+        try:
+            _jax.config.update(opt, val)
+        except Exception:  # noqa: BLE001 — knob absent on this jax
+            pass
+    if changed:
+        # jax latches the cache location at the process's FIRST compile;
+        # enabling the dir later (the common case — FFModel parses flags
+        # well after import-time jit use) silently no-ops without a reset
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc,
+            )
+
+            _cc.reset_cache()
+        except Exception:  # noqa: BLE001 — API moved on this jax
+            pass
+    return True
 
 
 def cpu_mesh_env(n: int = 8) -> None:

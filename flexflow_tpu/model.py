@@ -100,6 +100,12 @@ class FFModel:
         # ... and the run-health monitor (--metrics-out / --health);
         # same contract: an off config leaves the current monitor alone
         configure_monitor_from_config(self.config)
+        # persistent compilation cache (--compile-cache-dir): must be
+        # enabled before the first jit dispatch so every compile of this
+        # run is cacheable (docs/OBSERVABILITY.md)
+        from flexflow_tpu.config import apply_compile_cache
+
+        apply_compile_cache(self.config.compile_cache_dir)
         # multi-host bootstrap before any device query (the reference starts
         # the Legion/GASNet runtime in the FFModel ctor, model.cc:1160).
         # Unconditional: initialize_distributed is a no-op when neither
@@ -803,6 +809,7 @@ class FFModel:
             dcn_axis=cfg.dcn_axis,
             zero1=cfg.enable_zero1,
             profiling=cfg.profiling,
+            stack_blocks=cfg.stack_blocks,
         )
         with get_tracer().span("init_params", cat="compile"):
             self.executor.init_params()
@@ -879,12 +886,27 @@ class FFModel:
         altered graph) and restores every weight whose (layer, name,
         shape) survived."""
         assert self.executor is not None, "call compile() first"
+        # alter functions mutate layer attrs IN PLACE (guids unchanged),
+        # which the block-structure memos key past — drop them so chain
+        # detection sees the altered graph (flexflow_tpu.blocks)
+        from flexflow_tpu.blocks import invalidate_signatures
+
+        invalidate_signatures(self.layers)
         snapshot = self.get_weights() if preserve_weights else None
-        old_opt = (
-            jax.tree.map(self._to_numpy, self.executor.opt_state)
-            if preserve_weights
-            else None
-        )
+        old_opt = None
+        if preserve_weights:
+            # per-layer layout (stacked buckets unstacked) so optimizer
+            # moments survive a recompile that changes --stack-blocks or
+            # the chain structure itself
+            old_ex = self.executor
+            old_opt = {
+                key: (
+                    old_ex.unstack_tree(jax.tree.map(self._to_numpy, val))
+                    if isinstance(val, dict)
+                    else self._to_numpy(val)
+                )
+                for key, val in old_ex.opt_state.items()
+            }
         # the host-side step counter seeds the per-step dropout rng stream;
         # custom optimizers may lack a 'step' entry in opt_state, so carry
         # it explicitly or the stream replays already-used keys
@@ -914,13 +936,9 @@ class FFModel:
                 if not isinstance(old_val, dict):  # e.g. the step counter
                     new_opt[key] = jax.device_put(old_val)
                     continue
-                for lname, ws in old_val.items():
-                    for wname, arr in ws.items():
-                        cur = new_opt.get(key, {}).get(lname, {}).get(wname)
-                        if cur is not None and cur.shape == arr.shape:
-                            new_opt[key][lname][wname] = jax.device_put(
-                                np.asarray(arr, cur.dtype), cur.sharding
-                            )
+                # per-layer entries route into the new executor's layout;
+                # shape mismatches (altered layers) silently reset
+                ex.assign_opt_entries(key, old_val, shape_skip=True)
 
     def optimize_for_inference(
         self, budget: int = 32, alpha: float = 1.05
@@ -976,18 +994,12 @@ class FFModel:
     ) -> None:
         """set_weights restricted to entries whose (layer, name, shape)
         exists in the freshly compiled executor — shared by recompile()
-        and optimize_for_inference()."""
-        ex = self.executor
-        keep: Dict[str, Dict[str, np.ndarray]] = {}
-        for lname, ws in weights.items():
-            for wname, arr in ws.items():
-                bucket = self._weight_bucket(ex, lname, wname)
-                if bucket is not None and (
-                    bucket[lname][wname].shape == arr.shape
-                ):
-                    keep.setdefault(lname, {})[wname] = arr
-        if keep:
-            self.set_weights(keep)
+        and optimize_for_inference().  Per-layer in, so weights survive a
+        recompile that flips ``--stack-blocks`` (the executor routes them
+        into whatever layout it now uses)."""
+        self.executor.assign_weight_entries(
+            weights, strict=False, shape_skip=True
+        )
 
     # ------------------------------------------------------------------- fit
     def _resolve_metrics_sync_every(
@@ -1262,12 +1274,17 @@ class FFModel:
     def get_weights(self) -> Dict[str, Dict[str, np.ndarray]]:
         """Host copy of all weights, trainable AND stateful (BN running
         stats) — reference ``ParallelTensorBase::get_tensor``
-        (``parallel_tensor.h:168``)."""
+        (``parallel_tensor.h:168``).  Always the PER-LAYER layout: a
+        stacked executor (``--stack-blocks``) expands its depth-stacked
+        chain buckets, so callers never see the storage layout."""
         assert self.executor is not None
-        out: Dict[str, Dict[str, np.ndarray]] = jax.tree.map(
-            np.asarray, self.executor.params
+        ex = self.executor
+        out: Dict[str, Dict[str, np.ndarray]] = ex.unstack_tree(
+            jax.tree.map(np.asarray, ex.params)
         )
-        for lname, ws in jax.tree.map(np.asarray, self.executor.state).items():
+        for lname, ws in ex.unstack_tree(
+            jax.tree.map(np.asarray, ex.state)
+        ).items():
             out.setdefault(lname, {}).update(ws)
         return out
 
@@ -1277,10 +1294,9 @@ class FFModel:
         buffers with this; ``get_weights`` would materialize every
         table)."""
         if self.executor is not None:
-            for store in (self.executor.params, self.executor.state):
-                arr = store.get(layer_name, {}).get(weight_name)
-                if arr is not None:
-                    return tuple(int(s) for s in arr.shape)
+            shp = self.executor.weight_global_shape(layer_name, weight_name)
+            if shp is not None:
+                return shp
         for l in self.layers:
             if l.name == layer_name:
                 from flexflow_tpu.ops.base import get_op_def
@@ -1290,30 +1306,14 @@ class FFModel:
                         return tuple(int(s) for s in w.shape)
         raise KeyError(f"no weight {layer_name}/{weight_name}")
 
-    @staticmethod
-    def _weight_bucket(ex: Executor, lname: str, wname: str):
-        """The executor store (params vs state) holding weight
-        (lname, wname), or None — single source of routing truth for
-        set_weights and recompile."""
-        if lname in ex.params and wname in ex.params[lname]:
-            return ex.params
-        if lname in ex.state and wname in ex.state[lname]:
-            return ex.state
-        return None
-
     def set_weights(self, weights: Dict[str, Dict[str, np.ndarray]]) -> None:
         """Reference ``set_tensor``/numpy attach
-        (``examples/python/native/mnist_mlp_attach.py`` pattern)."""
+        (``examples/python/native/mnist_mlp_attach.py`` pattern).  Takes
+        the PER-LAYER layout; members of scan-stacked chains are routed
+        into their depth slice of the stacked bucket
+        (``Executor.assign_weight_entries``)."""
         assert self.executor is not None
-        ex = self.executor
-        for lname, ws in weights.items():
-            for wname, arr in ws.items():
-                bucket = self._weight_bucket(ex, lname, wname)
-                assert bucket is not None, f"unknown weight {lname}/{wname}"
-                cur = bucket[lname][wname]
-                bucket[lname][wname] = jax.device_put(
-                    np.asarray(arr, dtype=cur.dtype), cur.sharding
-                )
+        self.executor.assign_weight_entries(weights, strict=True)
 
     @staticmethod
     def _to_numpy(x) -> np.ndarray:
@@ -1341,9 +1341,16 @@ class FFModel:
         flat: Dict[str, np.ndarray] = {}
 
         def put(prefix, tree):
-            for lname, ws in tree.items():
+            # ALWAYS the per-layer layout: a stacked executor
+            # (--stack-blocks) unstacks its chain buckets here, so a
+            # checkpoint written by either layout loads into the other
+            # (and into any strategy — arrays re-place on load)
+            for lname, ws in ex.unstack_tree(
+                {k: {w: self._to_numpy(a) for w, a in v.items()}
+                 for k, v in tree.items()}
+            ).items():
                 for wname, arr in ws.items():
-                    flat[f"{prefix}/{lname}/{wname}"] = self._to_numpy(arr)
+                    flat[f"{prefix}/{lname}/{wname}"] = arr
 
         tracer = get_tracer()
         with tracer.span("checkpoint_save", cat="io", path=path):
@@ -1369,6 +1376,8 @@ class FFModel:
         ex = self.executor
         with get_tracer().span("checkpoint_load", cat="io", path=path), \
                 np.load(path) as z:
+            weights: Dict[str, Dict[str, np.ndarray]] = {}
+            opt: Dict[str, Dict[str, Dict[str, np.ndarray]]] = {}
             for key in z.files:
                 # layer names may themselves contain '/', so parse as
                 # prefix[/okey]/<lname...>/wname with wname = last segment
@@ -1382,13 +1391,17 @@ class FFModel:
                 elif prefix == "opt":
                     okey, rest = rest.split("/", 1)
                     lname, wname = rest.rsplit("/", 1)
-                    cur = ex.opt_state[okey][lname][wname]
-                    ex.opt_state[okey][lname][wname] = jax.device_put(
-                        np.asarray(arr, dtype=cur.dtype), cur.sharding
-                    )
+                    opt.setdefault(okey, {}).setdefault(lname, {})[wname] = arr
                 else:  # params / state
                     lname, wname = rest.rsplit("/", 1)
-                    self.set_weights({lname: {wname: arr}})
+                    weights.setdefault(lname, {})[wname] = arr
+            # batch the writes: the per-layer entries route into whatever
+            # layout the live executor uses (members of scan-stacked
+            # chains land in their depth slice, each full bucket written
+            # with ONE device_put)
+            self.set_weights(weights)
+            for okey, entries in opt.items():
+                ex.assign_opt_entries(okey, entries)
 
     @property
     def num_parameters(self) -> int:

@@ -30,6 +30,7 @@ cost model already assumes (``search/cost.py``).
 from __future__ import annotations
 
 import functools
+import os
 import time
 import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -39,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from flexflow_tpu.blocks import BlockChain, detect_block_chains
 from flexflow_tpu.fftype import LossType, OperatorType
 from flexflow_tpu.loss import get_loss_fn
 from flexflow_tpu.metrics import Metrics
@@ -69,6 +71,7 @@ class Executor:
         dcn_axis: str = "data",
         zero1: bool = False,
         profiling: bool = False,
+        stack_blocks: str = "off",
     ) -> None:
         self.layers = layers
         self.graph_inputs = graph_inputs
@@ -106,6 +109,55 @@ class Executor:
         self._wspecs: Dict[int, List] = {}
         for layer in layers:
             self._wspecs[int(layer.layer_guid)] = get_op_def(layer.op_type).weights(layer)
+
+        # --- scan-stacked repeated blocks (--stack-blocks, docs/PERF.md):
+        # maximal chains of structurally identical blocks execute as ONE
+        # jax.lax.scan over depth-stacked parameters, so trace/compile
+        # cost is per unique block instead of per layer.  "off" keeps the
+        # unrolled path untouched; "auto" stacks chains of depth >= 4;
+        # "on" stacks any chain (depth >= 2).  Chains the scan cannot
+        # express (stateful ops, aux losses, non-uniform per-depth
+        # shardings) are declined — see _chain_executable.
+        assert stack_blocks in ("off", "on", "auto"), (
+            f"unknown --stack-blocks value {stack_blocks!r}"
+        )
+        self.stack_blocks = stack_blocks
+        self._block_chains: List[BlockChain] = []
+        # member layer name -> (stacked bucket = template layer name,
+        # depth index): the per-layer view over stacked param storage
+        # (checkpoints and get/set_weights always speak per-layer)
+        self._stacked_slices: Dict[str, Tuple[str, int]] = {}
+        # bucket name -> member layer names ordered by depth
+        self._bucket_members: Dict[str, List[str]] = {}
+        if stack_blocks != "off":
+            min_depth = 4 if stack_blocks == "auto" else 2
+            for c in detect_block_chains(layers, min_depth=min_depth):
+                if not self._chain_executable(c):
+                    continue
+                self._block_chains.append(c)
+                for j, tl in enumerate(c.template):
+                    if not self._wspecs[int(tl.layer_guid)]:
+                        continue
+                    members = [c.layers[d][j].name for d in range(c.depth)]
+                    self._bucket_members[tl.name] = members
+                    for d, m in enumerate(members):
+                        self._stacked_slices[m] = (tl.name, d)
+        # execution plan: plain layers interleaved with BlockChain segments
+        if self._block_chains:
+            chain_at = {c.start: c for c in self._block_chains}
+            segs: List[Any] = []
+            idx = 0
+            while idx < len(layers):
+                c = chain_at.get(idx)
+                if c is not None:
+                    segs.append(c)
+                    idx = c.end
+                else:
+                    segs.append(layers[idx])
+                    idx += 1
+            self._segments: List[Any] = segs
+        else:
+            self._segments = list(layers)
 
         self._step_jit = None
         self._fwd_jit = None
@@ -217,74 +269,16 @@ class Executor:
 
         aux_losses: List[jax.Array] = []
         new_state: Dict[str, Dict[str, jax.Array]] = {}
-        for layer in self.layers:
-            opdef = get_op_def(layer.op_type)
-            ins = [values[t.guid] for t in layer.inputs]
-            lp32 = dict(params.get(layer.name, {}))
-            lp32.update(state.get(layer.name, {}))
-            lp = {k: self._cast_compute(v) for k, v in lp32.items()}
-            ctx = OpContext(
-                training=training,
-                rng=jax.random.fold_in(rng, zlib.crc32(layer.name.encode()) % (2**31)) if rng is not None else None,
-                mesh=self.mesh,
-                input_shardings=[shardings.get(t.guid) for t in layer.inputs],
-                op_sharding=self.strategy.op_sharding(layer),
-                seq_length=seq_length,
-            )
-            if self.remat_policy == "all" or (
-                self.remat_policy == "attention" and layer.op_type in _REMAT_OPS
-            ):
-                outs = jax.checkpoint(
-                    lambda p, i, _l=layer, _c=ctx: get_op_def(_l.op_type).forward(_l, p, i, _c)
-                )(lp, ins)
-            else:
-                outs = opdef.forward(layer, lp, ins, ctx)
-            # apply sharding constraints on outputs.  Parallel ops derive
-            # their outgoing distribution from the incoming one + attrs (the
-            # resharding vocabulary, SURVEY §2.4); other ops take the
-            # strategy's assignment when one exists.
-            if layer.op_type.is_parallel_op:
-                src = layer.inputs[0]
-                in_sh = shardings.get(src.guid, TensorSharding.replicated(src.ndim))
-                out_sh = resolve_parallel_sharding(layer, in_sh, self.strategy.mesh)
-                t = layer.outputs[0]
-                values[t.guid] = self._constrain(outs[0], out_sh.partition_spec())
-                shardings[t.guid] = out_sh
-                continue
-            op_sh = self.strategy.op_sharding(layer)
-            for i, (t, y) in enumerate(zip(layer.outputs, outs)):
-                if op_sh is not None and i < len(op_sh.output):
-                    ts = op_sh.output[i]
-                    y = self._constrain(y, ts.partition_spec())
-                    shardings[t.guid] = ts
-                else:
-                    shardings[t.guid] = TensorSharding.replicated(t.ndim)
-                values[t.guid] = y
-            # stateful ops (BN running stats) — accumulated in float32 even
-            # under bf16 compute, like the reference's fp32 cudnn stats
-            if training and hasattr(opdef, "state_update") and state.get(layer.name):
-                ins32 = [
-                    x.astype(jnp.float32) if x.dtype == self.compute_dtype else x
-                    for x in ins
-                ] if self._mixed else ins
-                new_state[layer.name] = opdef.state_update(layer, lp32, ins32)
-            # MoE aux (load-balance) loss — reference lambda_bal in aggregate
-            if (
-                layer.op_type
-                in (OperatorType.AGGREGATE, OperatorType.AGGREGATE_SPEC, OperatorType.EXPERTS)
-                and layer.attrs.get("lambda_bal", 0.0) > 0.0
-            ):
-                from flexflow_tpu.ops.moe import Aggregate
-
-                # inputs[3] is the full softmax gate (t, n) — see Aggregate
-                # docstring; inputs[0] of aggregate is only the top-k slice.
-                gate_probs = values[layer.inputs[3].guid]
-                assign = values[layer.inputs[1].guid]
-                n = layer.attrs.get("n", layer.attrs.get("n_experts"))
-                aux_losses.append(
-                    layer.attrs["lambda_bal"]
-                    * Aggregate.aux_loss(gate_probs, assign, n)
+        for seg in self._segments:
+            if isinstance(seg, BlockChain):
+                self._trace_block_scan(
+                    seg, values, shardings, params, training, rng, seq_length
                 )
+                continue
+            self._trace_layer(
+                seg, values, shardings, params, state, training, rng,
+                seq_length, new_state, aux_losses,
+            )
         # carry over unchanged state
         for name, s in state.items():
             if name not in new_state:
@@ -293,6 +287,206 @@ class Executor:
         if self._mixed and logits.dtype == self.compute_dtype:
             logits = logits.astype(jnp.float32)  # loss/metrics in fp32
         return logits, new_state, aux_losses
+
+    def _trace_layer(
+        self,
+        layer: Layer,
+        values: Dict[int, jax.Array],
+        shardings: Dict[int, TensorSharding],
+        params: Dict[str, Dict[str, jax.Array]],
+        state: Dict[str, Dict[str, jax.Array]],
+        training: bool,
+        rng: Optional[jax.Array],
+        seq_length: Optional[int],
+        new_state: Dict[str, Dict[str, jax.Array]],
+        aux_losses: List[jax.Array],
+        rng_key: Optional[jax.Array] = None,
+    ) -> None:
+        """Trace ONE layer into ``values``/``shardings`` — the loop body
+        of the unrolled path, also reused per template position inside a
+        ``block_scan`` body (``rng_key`` then carries the per-depth key
+        derived from the scan's xs instead of the layer-name fold)."""
+        opdef = get_op_def(layer.op_type)
+        ins = [values[t.guid] for t in layer.inputs]
+        lp32 = dict(params.get(layer.name, {}))
+        lp32.update(state.get(layer.name, {}))
+        lp = {k: self._cast_compute(v) for k, v in lp32.items()}
+        if rng_key is None and rng is not None:
+            rng_key = jax.random.fold_in(
+                rng, zlib.crc32(layer.name.encode()) % (2**31)
+            )
+        ctx = OpContext(
+            training=training,
+            rng=rng_key,
+            mesh=self.mesh,
+            input_shardings=[shardings.get(t.guid) for t in layer.inputs],
+            op_sharding=self.strategy.op_sharding(layer),
+            seq_length=seq_length,
+        )
+        if self.remat_policy == "all" or (
+            self.remat_policy == "attention" and layer.op_type in _REMAT_OPS
+        ):
+            outs = jax.checkpoint(
+                lambda p, i, _l=layer, _c=ctx: get_op_def(_l.op_type).forward(_l, p, i, _c)
+            )(lp, ins)
+        else:
+            outs = opdef.forward(layer, lp, ins, ctx)
+        # apply sharding constraints on outputs.  Parallel ops derive
+        # their outgoing distribution from the incoming one + attrs (the
+        # resharding vocabulary, SURVEY §2.4); other ops take the
+        # strategy's assignment when one exists.
+        if layer.op_type.is_parallel_op:
+            src = layer.inputs[0]
+            in_sh = shardings.get(src.guid, TensorSharding.replicated(src.ndim))
+            out_sh = resolve_parallel_sharding(layer, in_sh, self.strategy.mesh)
+            t = layer.outputs[0]
+            values[t.guid] = self._constrain(outs[0], out_sh.partition_spec())
+            shardings[t.guid] = out_sh
+            return
+        op_sh = self.strategy.op_sharding(layer)
+        for i, (t, y) in enumerate(zip(layer.outputs, outs)):
+            if op_sh is not None and i < len(op_sh.output):
+                ts = op_sh.output[i]
+                y = self._constrain(y, ts.partition_spec())
+                shardings[t.guid] = ts
+            else:
+                shardings[t.guid] = TensorSharding.replicated(t.ndim)
+            values[t.guid] = y
+        # stateful ops (BN running stats) — accumulated in float32 even
+        # under bf16 compute, like the reference's fp32 cudnn stats
+        if training and hasattr(opdef, "state_update") and state.get(layer.name):
+            ins32 = [
+                x.astype(jnp.float32) if x.dtype == self.compute_dtype else x
+                for x in ins
+            ] if self._mixed else ins
+            new_state[layer.name] = opdef.state_update(layer, lp32, ins32)
+        # MoE aux (load-balance) loss — reference lambda_bal in aggregate
+        if (
+            layer.op_type
+            in (OperatorType.AGGREGATE, OperatorType.AGGREGATE_SPEC, OperatorType.EXPERTS)
+            and layer.attrs.get("lambda_bal", 0.0) > 0.0
+        ):
+            from flexflow_tpu.ops.moe import Aggregate
+
+            # inputs[3] is the full softmax gate (t, n) — see Aggregate
+            # docstring; inputs[0] of aggregate is only the top-k slice.
+            gate_probs = values[layer.inputs[3].guid]
+            assign = values[layer.inputs[1].guid]
+            n = layer.attrs.get("n", layer.attrs.get("n_experts"))
+            aux_losses.append(
+                layer.attrs["lambda_bal"]
+                * Aggregate.aux_loss(gate_probs, assign, n)
+            )
+
+    # --- scan-stacked repeated blocks --------------------------------------
+    def _chain_executable(self, chain: BlockChain) -> bool:
+        """Can this detected chain run as a single scan?  Declined when a
+        member op is stateful (BN running stats / Cache — their per-layer
+        state cannot ride the carry), carries an aux loss (MoE
+        load-balance terms must sum per layer), or when the strategy
+        assigns DIFFERENT shardings to corresponding layers of different
+        depths (the scan body is traced once, so per-depth layouts must
+        agree — the block-collapsed search guarantees this)."""
+        for block in chain.layers:
+            for l in block:
+                opdef = get_op_def(l.op_type)
+                if hasattr(opdef, "state_update"):
+                    return False
+                if any(not w.trainable for w in self._wspecs[int(l.layer_guid)]):
+                    return False
+                if (
+                    l.op_type in (
+                        OperatorType.AGGREGATE,
+                        OperatorType.AGGREGATE_SPEC,
+                        OperatorType.EXPERTS,
+                    )
+                    and l.attrs.get("lambda_bal", 0.0) > 0.0
+                ):
+                    return False
+        for j in range(chain.block_len):
+            keys = {
+                (
+                    None
+                    if self.strategy.op_sharding(chain.layers[d][j]) is None
+                    else self.strategy.op_sharding(chain.layers[d][j]).key()
+                )
+                for d in range(chain.depth)
+            }
+            if len(keys) != 1:
+                return False
+        return True
+
+    def _trace_block_scan(
+        self,
+        chain: BlockChain,
+        values: Dict[int, jax.Array],
+        shardings: Dict[int, TensorSharding],
+        params: Dict[str, Dict[str, jax.Array]],
+        training: bool,
+        rng: Optional[jax.Array],
+        seq_length: Optional[int],
+    ) -> None:
+        """Trace one repeated-block chain as ``jax.lax.scan`` over its
+        depth-stacked parameters.  The body traces the TEMPLATE block
+        once (via :meth:`_trace_layer`, so remat / mixed precision /
+        sharding constraints are applied exactly as on the unrolled
+        path); per-depth parameters arrive as scan xs, and per-depth rng
+        keys are derived inside the body from the member layer names'
+        crc32 values (also scan xs) so dropout streams match the
+        unrolled path bit for bit."""
+        tmpl = chain.template
+        depth, L = chain.depth, chain.block_len
+        # member-name crc32 per (depth, position): the unrolled path's
+        # per-layer rng fold targets, fed through xs so iteration d
+        # reproduces layer d's stream
+        crcs = np.asarray(
+            [
+                [
+                    zlib.crc32(chain.layers[d][j].name.encode()) % (2**31)
+                    for j in range(L)
+                ]
+                for d in range(depth)
+            ],
+            np.uint32,
+        )
+        xs_params = {
+            tl.name: params[tl.name] for tl in tmpl if tl.name in params
+        }
+        carry0 = values[chain.carry_in_guid]
+        out_sh_box: Dict[int, TensorSharding] = {}
+
+        def body(carry, x):
+            crc_row, p_d = x
+            vals: Dict[int, jax.Array] = {chain.carry_in_guid: carry}
+            shs: Dict[int, TensorSharding] = {}
+            if chain.carry_in_guid in shardings:
+                shs[chain.carry_in_guid] = shardings[chain.carry_in_guid]
+            for g in chain.shared_guids:
+                vals[g] = values[g]  # closure capture: scan-invariant
+                if g in shardings:
+                    shs[g] = shardings[g]
+            for j, tl in enumerate(tmpl):
+                self._trace_layer(
+                    tl, vals, shs, p_d, {}, training, None, seq_length,
+                    {}, [],
+                    rng_key=(
+                        jax.random.fold_in(rng, crc_row[j])
+                        if rng is not None
+                        else None
+                    ),
+                )
+            out_sh_box.update(shs)
+            return vals[chain.template_out_guid], None
+
+        with get_tracer().span(
+            "block_scan", cat="step", level="op", depth=depth, layers=L,
+        ):
+            carry, _ = jax.lax.scan(body, carry0, (crcs, xs_params))
+        values[chain.out_guid] = carry
+        out_t = chain.layers[-1][-1].outputs[0]
+        shardings[chain.out_guid] = out_sh_box.get(
+            chain.template_out_guid, TensorSharding.replicated(out_t.ndim)
+        )
 
     # --- param init --------------------------------------------------------
     def init_params(self, key: Optional[jax.Array] = None) -> None:
@@ -326,12 +520,212 @@ class Executor:
                 bucket.setdefault(layer.name, {})[w.name] = arr
         self.params = params
         self.state = state
-        self.opt_state = self.optimizer.init_state(params)
+        # stacked init: each member layer drew its weights with exactly
+        # the per-layer keys above (bit-parity with the unrolled path);
+        # chains then collapse into ONE (depth, ...) array per template
+        # weight, sharded (None, *per-layer spec) on the mesh
+        self._stack_param_buckets()
+        self.opt_state = self.optimizer.init_state(self.params)
         if self.zero1:
             self._zero1_axes = self._zero1_token_axes()
             self._zero1_specs = jax.tree.map(self._zero1_pspec, self.opt_state)
             self.opt_state = jax.tree.map(
                 self._zero1_place, self.opt_state, self._zero1_specs
+            )
+
+    def _stack_param_buckets(self) -> None:
+        """Collapse per-member param buckets into (depth, ...) stacked
+        arrays keyed by the template layer name (no-op without chains)."""
+        for c in self._block_chains:
+            for j, tl in enumerate(c.template):
+                ws = self._wspecs[int(tl.layer_guid)]
+                if not ws:
+                    continue
+                members = self._bucket_members[tl.name]
+                stacked: Dict[str, jax.Array] = {}
+                for w in ws:
+                    arrs = [self.params[m][w.name] for m in members]
+                    s = jnp.stack(arrs)
+                    if self.mesh is not None:
+                        ps = self.strategy.weight_pspec(
+                            tl, w.name, len(w.shape)
+                        )
+                        s = jax.device_put(
+                            s,
+                            NamedSharding(
+                                self.mesh, PartitionSpec(None, *tuple(ps))
+                            ),
+                        )
+                    stacked[w.name] = s
+                for m in members:
+                    self.params.pop(m, None)
+                self.params[tl.name] = stacked
+
+    # --- per-layer weight view over stacked storage ------------------------
+    def unstack_tree(
+        self, tree: Dict[str, Dict[str, Any]]
+    ) -> Dict[str, Dict[str, Any]]:
+        """Per-layer view of a ``{bucket: {weight: array}}`` tree: stacked
+        buckets expand to one entry per member layer (depth slices);
+        plain buckets pass through.  Checkpoints and ``get_weights``
+        always present THIS layout, so artifacts written by stacked and
+        unrolled executors are interchangeable."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for lname, ws in tree.items():
+            members = self._bucket_members.get(lname)
+            if members is None:
+                out[lname] = dict(ws)
+            else:
+                for d, m in enumerate(members):
+                    out[m] = {wn: arr[d] for wn, arr in ws.items()}
+        return out
+
+    def locate_weight(
+        self, lname: str, wname: str
+    ) -> Optional[Tuple[Dict, str, Optional[int]]]:
+        """(store, bucket name, depth index) for a PER-LAYER weight name;
+        depth index is None for unstacked weights, and the store is
+        ``self.params`` or ``self.state``.  None when unknown."""
+        route = self._stacked_slices.get(lname)
+        if route is not None:
+            bname, d = route
+            if bname in self.params and wname in self.params[bname]:
+                return self.params, bname, d
+            return None
+        for store in (self.params, self.state):
+            if lname in store and wname in store[lname]:
+                return store, lname, None
+        return None
+
+    def weight_global_shape(
+        self, lname: str, wname: str
+    ) -> Optional[Tuple[int, ...]]:
+        """Per-layer logical shape of one weight (stacked buckets report
+        the slice shape, not the (depth, ...) storage shape)."""
+        loc = self.locate_weight(lname, wname)
+        if loc is None:
+            return None
+        store, bname, d = loc
+        shp = store[bname][wname].shape
+        return tuple(int(s) for s in (shp[1:] if d is not None else shp))
+
+    def assign_weight_entries(
+        self,
+        entries: Dict[str, Dict[str, np.ndarray]],
+        strict: bool = True,
+        shape_skip: bool = False,
+    ) -> None:
+        """Write per-layer ``{layer: {weight: array}}`` entries into the
+        stores, routing members of stacked chains into depth slices.  A
+        bucket whose every slice arrives is written with ONE device_put;
+        partial updates read-modify-write the stacked array.  ``strict``
+        errors on unknown names; ``shape_skip`` silently skips
+        shape-mismatched entries (the recompile weight-carry
+        semantics)."""
+        pending: Dict[Tuple[int, str, str], Dict[int, np.ndarray]] = {}
+        stores: Dict[int, Dict] = {}
+        for lname, ws in entries.items():
+            for wname, arr in ws.items():
+                loc = self.locate_weight(lname, wname)
+                if loc is None:
+                    if strict:
+                        raise KeyError(f"unknown weight {lname}/{wname}")
+                    continue
+                store, bname, d = loc
+                cur = store[bname][wname]
+                a = np.asarray(arr)
+                if d is None or a.shape == tuple(cur.shape):
+                    if a.shape != tuple(cur.shape):
+                        if shape_skip:
+                            continue
+                        raise ValueError(
+                            f"weight {lname}/{wname}: got shape {a.shape}, "
+                            f"expected {tuple(cur.shape)}"
+                        )
+                    store[bname][wname] = jax.device_put(
+                        np.asarray(a, cur.dtype), cur.sharding
+                    )
+                    continue
+                if a.shape != tuple(cur.shape[1:]):
+                    if shape_skip:
+                        continue
+                    raise ValueError(
+                        f"weight {lname}/{wname}: got shape {a.shape}, "
+                        f"expected {tuple(cur.shape[1:])} (slice of stacked "
+                        f"{tuple(cur.shape)})"
+                    )
+                key = (id(store), bname, wname)
+                stores[id(store)] = store
+                pending.setdefault(key, {})[d] = np.asarray(a, cur.dtype)
+        for (sid, bname, wname), slices in pending.items():
+            store = stores[sid]
+            cur = store[bname][wname]
+            depth = int(cur.shape[0])
+            if len(slices) == depth:
+                full = np.stack([slices[d] for d in range(depth)])
+            else:
+                full = np.array(np.asarray(cur))
+                for d, a in slices.items():
+                    full[d] = a
+            store[bname][wname] = jax.device_put(
+                full.astype(cur.dtype), cur.sharding
+            )
+
+    def assign_opt_entries(
+        self,
+        okey: str,
+        entries: Dict[str, Dict[str, np.ndarray]],
+        shape_skip: bool = False,
+    ) -> None:
+        """Per-layer restore into ``opt_state[okey]`` (moments mirror the
+        param tree, so stacked buckets route identically)."""
+        tree = self.opt_state.get(okey)
+        if not isinstance(tree, dict):
+            raise KeyError(f"no optimizer slot {okey!r}")
+        pending: Dict[Tuple[str, str], Dict[int, np.ndarray]] = {}
+        for lname, ws in entries.items():
+            for wname, arr in ws.items():
+                route = self._stacked_slices.get(lname)
+                bname, d = route if route is not None else (lname, None)
+                cur = tree.get(bname, {}).get(wname)
+                if cur is None:
+                    if shape_skip:
+                        continue
+                    raise KeyError(f"unknown opt entry {okey}/{lname}/{wname}")
+                a = np.asarray(arr)
+                if d is None or a.shape == tuple(cur.shape):
+                    if a.shape != tuple(cur.shape):
+                        if shape_skip:
+                            continue
+                        raise ValueError(
+                            f"opt {okey}/{lname}/{wname}: shape {a.shape} "
+                            f"!= {tuple(cur.shape)}"
+                        )
+                    tree[bname][wname] = jax.device_put(
+                        np.asarray(a, cur.dtype), cur.sharding
+                    )
+                    continue
+                if a.shape != tuple(cur.shape[1:]):
+                    if shape_skip:
+                        continue
+                    raise ValueError(
+                        f"opt {okey}/{lname}/{wname}: shape {a.shape} != "
+                        f"slice {tuple(cur.shape[1:])}"
+                    )
+                pending.setdefault((bname, wname), {})[d] = np.asarray(
+                    a, cur.dtype
+                )
+        for (bname, wname), slices in pending.items():
+            cur = tree[bname][wname]
+            depth = int(cur.shape[0])
+            if len(slices) == depth:
+                full = np.stack([slices[d] for d in range(depth)])
+            else:
+                full = np.array(np.asarray(cur))
+                for d, a in slices.items():
+                    full[d] = a
+            tree[bname][wname] = jax.device_put(
+                full.astype(cur.dtype), cur.sharding
             )
 
     # --- ZeRO-1 helpers ----------------------------------------------------
@@ -580,6 +974,7 @@ class Executor:
             compile_s = 0.0
             if self._step_compiled is None:
                 t0 = time.perf_counter()
+                cache_before = _compile_cache_entries()
                 with tracer.span("jit_compile", cat="compile", fn="train_step"):
                     try:
                         self._step_compiled = self._step_jit.lower(*args).compile()
@@ -589,6 +984,14 @@ class Executor:
                         self._step_compiled = self._step_jit
                 compile_s = time.perf_counter() - t0
                 tracer.counter("jit.cache_miss")
+                # persistent compilation cache (--compile-cache-dir): a
+                # compile that wrote no new cache entry was served from
+                # disk — count it so repeated bench/search runs can prove
+                # they skipped the recompile (docs/OBSERVABILITY.md)
+                if cache_before is not None:
+                    after = _compile_cache_entries()
+                    if after is not None and after <= cache_before:
+                        tracer.counter("jit_cache.persistent_hit")
                 self._record_memory_snapshot(tracer)
             else:
                 tracer.counter("jit.cache_hit")
@@ -762,3 +1165,22 @@ class Executor:
 
 
 _REMAT_OPS = frozenset({OperatorType.MULTIHEAD_ATTENTION})
+
+
+def _compile_cache_entries() -> Optional[frozenset]:
+    """Names of the persistent compilation cache's entry files, or None
+    when no ``--compile-cache-dir`` is configured.  Only ``*-cache``
+    payloads count — the cache touches ``*-atime`` markers on every hit,
+    which must not read as a new compile."""
+    try:
+        d = jax.config.jax_compilation_cache_dir
+    except AttributeError:
+        return None
+    if not d or not os.path.isdir(d):
+        return None
+    try:
+        return frozenset(
+            f for f in os.listdir(d) if not f.endswith("-atime")
+        )
+    except OSError:
+        return None

@@ -472,11 +472,20 @@ def estimate_strategy_cost(
     lambda_mem: float = 0.0,
     node_time_fn=None,
     cost_cache: Optional[Dict] = None,
+    collapse_blocks: bool = True,
 ) -> float:
     """Per-step time estimate for a whole strategy: node costs (compute +
     weight-grad sync) + per-edge reshard collectives.  Pure function of the
     layer graph + strategy — deterministic and unit-testable (the gap
-    SURVEY §4.7 notes in the reference's device-measured costing)."""
+    SURVEY §4.7 notes in the reference's device-measured costing).
+
+    ``collapse_blocks``: chains of >= 4 structurally identical blocks
+    whose strategy assignment is uniform across repeats are priced ONCE
+    and multiplied — first application at the chain's real boundary
+    sharding, the remaining ``depth - 1`` at the steady-state boundary
+    (carry-in = the block's own output layout).  Identical totals to the
+    unrolled walk, at per-unique-block instead of per-layer host cost
+    (``flexflow_tpu.blocks``, docs/PERF.md)."""
     from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
     from flexflow_tpu.parallel.spec import TensorSharding
 
@@ -486,7 +495,9 @@ def estimate_strategy_cost(
     # track explicit parallel-op distributions (layers are topological)
     pop_out: Dict[int, TensorSharding] = {}  # tensor guid -> sharding
 
-    def producer_sharding(t) -> Optional[TensorSharding]:
+    def producer_sharding(t, override=None) -> Optional[TensorSharding]:
+        if override and t.guid in override:
+            return override[t.guid]
         if t.guid in pop_out:
             return pop_out[t.guid]
         if t.owner_layer is None:
@@ -496,21 +507,23 @@ def estimate_strategy_cost(
             return None
         return prod.output[t.owner_idx]
 
-    for layer in layers:
+    def layer_cost(layer) -> float:
+        """Node + incoming-edge cost of one layer."""
+        c_total = 0.0
         if layer.op_type.is_parallel_op:
-            # explicit reshard: charge the implied collective (mirrors the
-            # DP tier's _transition_cost_parallel)
+            # explicit reshard: charge the implied collective (mirrors
+            # the DP tier's _transition_cost_parallel)
             t = layer.inputs[0]
             src = producer_sharding(t) or TensorSharding.replicated(t.ndim)
             dst = resolve_parallel_sharding(layer, src, mesh)
-            total += reshard_cost(
+            c_total += reshard_cost(
                 t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m,
-                # graph inputs have no cotangent — same rule as dp.py, so the
-                # DP and this estimator optimize the same objective
+                # graph inputs have no cotangent — same rule as dp.py, so
+                # the DP and this estimator optimize the same objective
                 with_backward=t.owner_layer is not None,
             )
             pop_out[layer.outputs[0].guid] = dst
-            continue
+            return c_total
         os_ = strategy.op_sharding(layer)
         if os_ is None:
             os_ = OpSharding(
@@ -528,9 +541,9 @@ def estimate_strategy_cost(
                     compute_time=node_time_fn(layer, os_) if node_time_fn else None,
                 )
                 cost_cache[nk] = c
-            total += c
+            c_total += c
         else:
-            total += node_cost(
+            c_total += node_cost(
                 layer,
                 os_,
                 mesh,
@@ -551,23 +564,69 @@ def estimate_strategy_cost(
                 "model" in src.axes_of(d) for d in range(len(src.spec))
             ):
                 continue
+            bwd = t.owner_layer is not None
             if cost_cache is not None:
-                ek = ("e", t.guid, src.key(), dst.key())
+                ek = ("e", t.guid, src.key(), dst.key(), bwd)
                 c = cost_cache.get(ek)
                 if c is None:
                     c = reshard_cost(
                         t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m,
-                        with_backward=t.owner_layer is not None,
+                        with_backward=bwd,
                     )
                     cost_cache[ek] = c
-                total += c
+                c_total += c
             else:
-                total += reshard_cost(
+                c_total += reshard_cost(
                     t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m,
-                    with_backward=t.owner_layer is not None,
+                    with_backward=bwd,
                 )
+        return c_total
+
+    chain_at = {}
+    if collapse_blocks:
+        from flexflow_tpu.blocks import detect_block_chains
+
+        for ch in detect_block_chains(layers, min_depth=4):
+            if _chain_assignment_uniform(ch, strategy):
+                chain_at[ch.start] = ch
+
+    idx, n = 0, len(layers)
+    while idx < n:
+        chain = chain_at.get(idx)
+        if chain is None:
+            total += layer_cost(layers[idx])
+            idx += 1
+            continue
+        first = sum(layer_cost(l) for l in chain.template)
+        # steady state: price BLOCK 1 — a real interior repeat, so its
+        # carry is a produced tensor (backward collectives and the dgrad
+        # sync of node_cost apply, which a graph-input-fed template would
+        # wrongly exempt) and its producers resolve through the strategy
+        steady = sum(layer_cost(l) for l in chain.layers[1])
+        total += first + (chain.depth - 1) * steady
+        if chain.layers[-1][-1].op_type.is_parallel_op:
+            # downstream consumers resolve the chain output through
+            # pop_out exactly as they would after the unrolled walk;
+            # block 1's resolve is the steady-state layout
+            out_sh = pop_out.get(chain.layers[1][-1].outputs[0].guid)
+            if out_sh is not None:
+                pop_out[chain.out_guid] = out_sh
+        idx = chain.end
     # multi-slice models tally ring-vs-hierarchical routing choices per
     # collective; surface them as tracer counters once per estimate
     if hasattr(m, "flush_decisions"):
         m.flush_decisions()
     return total
+
+
+def _chain_assignment_uniform(chain, strategy: Strategy) -> bool:
+    """Every repeat of the chain carries the same per-position OpSharding
+    (the precondition for price-once-multiply)."""
+    for j in range(chain.block_len):
+        keys = set()
+        for d in range(chain.depth):
+            s = strategy.op_sharding(chain.layers[d][j])
+            keys.add(None if s is None else s.key())
+        if len(keys) != 1:
+            return False
+    return True

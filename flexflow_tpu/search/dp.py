@@ -26,6 +26,7 @@ from __future__ import annotations
 import heapq
 from typing import Dict, List, Optional, Tuple
 
+from flexflow_tpu.blocks import BlockChain, detect_block_chains
 from flexflow_tpu.obs import get_tracer
 from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
 from flexflow_tpu.parallel.machine import MachineMesh
@@ -57,6 +58,7 @@ class SearchHelper:
         beam: int = 16,
         lambda_mem: float = 0.0,
         node_time_fn=None,
+        collapse_blocks: bool = True,
     ) -> None:
         self.layers = layers
         self.graph_inputs = graph_inputs
@@ -76,6 +78,19 @@ class SearchHelper:
         for idx, layer in enumerate(layers):
             for t in layer.inputs:
                 self.consumers.setdefault(t.guid, []).append(idx)
+
+        # block-collapsed search (flexflow_tpu.blocks, docs/PERF.md):
+        # chains of >= 4 structurally identical blocks are priced ONCE —
+        # the frontier DP sweeps the template block, assigns the winner
+        # uniformly to every repeat, and adds (depth-1) x the block's
+        # steady-state cost (carry-in = the block's own output layout, so
+        # the inter-block boundary reshard is still priced per
+        # transition).  BERT-Large's 173-layer DP then visits ~3 unique
+        # segments instead of 173 layers.
+        self._chain_at: Dict[int, BlockChain] = {}
+        if collapse_blocks:
+            for c in detect_block_chains(layers, min_depth=4):
+                self._chain_at[c.start] = c
 
     def _input_sharding(self, t: Tensor) -> TensorSharding:
         """Graph inputs arrive data-sharded when divisible (mirrors
@@ -153,7 +168,11 @@ class SearchHelper:
         key0 = tuple(sorted((g, _sh_key(s)) for g, s in init_front.items()))
         states[key0] = (0.0, {}, init_front)
 
-        for idx, layer in enumerate(self.layers):
+        def advance(states, idx, layer):
+            """One frontier-DP step over layer ``idx`` (the original
+            per-layer loop body, also reused for each template-block
+            position of a collapsed chain)."""
+            nonlocal explored, hit_bound
             new_states: Dict[Tuple, Tuple[float, Dict[int, OpSharding], Dict[int, TensorSharding]]] = {}
             if layer.op_type.is_parallel_op:
                 cand_list = None
@@ -219,11 +238,131 @@ class SearchHelper:
             # frontier width per layer: the state-blowup signal the beam
             # bound exists to cap (log_dp analog)
             tracer.sample("search.frontier_width", float(len(new_states)))
-            states = new_states
+            return new_states
+
+        idx, n = 0, len(self.layers)
+        while idx < n:
+            chain = self._chain_at.get(idx)
+            if chain is not None:
+                states = self._advance_chain(chain, states, advance)
+                idx = chain.end
+            else:
+                states = advance(states, idx, self.layers[idx])
+                idx += 1
 
         tracer.counter("search.candidates_explored", float(explored))
         best_cost, best_assign, _ = min(states.values(), key=lambda v: v[0])
-        return best_cost, best_assign, hit_bound
+        return best_cost, self._expand_chain_assign(best_assign), hit_bound
+
+    # --- block-collapsed chains --------------------------------------------
+    def _advance_chain(self, chain: BlockChain, states, advance):
+        """Sweep the TEMPLATE block only, then charge the remaining
+        ``depth - 1`` repeats at the steady-state block cost (the same
+        assignment re-applied with carry-in = the block's own output
+        sharding, so every inter-block boundary reshard is still priced)
+        and rewire the frontier to the chain's final output tensor."""
+        for j, layer in enumerate(chain.template):
+            states = advance(states, chain.start + j, layer)
+        g0 = chain.template_out_guid
+        idx_end = chain.end - 1
+        chain_input_guids = {
+            t.guid for block in chain.layers for l in block for t in l.inputs
+        }
+        out: Dict[Tuple, Tuple[float, Dict[int, OpSharding], Dict[int, TensorSharding]]] = {}
+        for cost, assign, front in states.values():
+            y = front.get(g0)
+            if y is None:  # defensive: template output must be live
+                continue
+            steady = self._block_cost(chain, assign, front, y)
+            tot = cost + (chain.depth - 1) * steady
+            nf = dict(front)
+            del nf[g0]
+            nf[chain.out_guid] = y
+            # liveness at the chain boundary: tensors whose remaining
+            # consumers all sat inside blocks 1..depth-1 die here (the
+            # per-layer advance saw live consumers at those indices)
+            for g in list(nf.keys()):
+                if g == chain.out_guid or g not in chain_input_guids:
+                    continue
+                if not any(i > idx_end for i in self.consumers.get(g, ())):
+                    del nf[g]
+            key = tuple(sorted((g, _sh_key(s)) for g, s in nf.items()))
+            cur = out.get(key)
+            if cur is None or tot < cur[0]:
+                out[key] = (tot, assign, nf)
+        return out
+
+    def _block_cost(
+        self,
+        chain: BlockChain,
+        assign: Dict[int, OpSharding],
+        front: Dict[int, TensorSharding],
+        carry: TensorSharding,
+    ) -> float:
+        """Cost of ONE steady-state application of the block under the
+        template's assignment: node costs + internal edges + the
+        carry-in boundary edge (from the block's own output layout) +
+        shared-operand edges — exactly what each unrolled repeat would
+        have been charged.  Priced over BLOCK 1's layers (a real
+        interior repeat): its carry and internal tensors are produced
+        tensors, so the backward transpose collectives and node_cost's
+        dgrad-sync term apply — a graph-input-fed TEMPLATE would
+        wrongly exempt them."""
+        rep = chain.layers[1]
+        # block 1's carry input IS the template's output tensor
+        local: Dict[int, TensorSharding] = {chain.template_out_guid: carry}
+        total = 0.0
+        for j, layer in enumerate(rep):
+            in_shs = []
+            for t in layer.inputs:
+                sh = local.get(t.guid)
+                if sh is None:
+                    sh = front.get(t.guid, TensorSharding.replicated(t.ndim))
+                in_shs.append(sh)
+            if layer.op_type.is_parallel_op:
+                out_sh = resolve_parallel_sharding(
+                    layer, in_shs[0], self.mesh
+                )
+                total += self._transition_cost_parallel(
+                    layer, in_shs[0], out_sh
+                )
+                local[layer.outputs[0].guid] = out_sh
+                continue
+            cand = assign[int(chain.template[j].layer_guid)]
+            total += node_cost(
+                layer, cand, self.mesh, self.machine,
+                lambda_mem=self.lambda_mem,
+                compute_time=(
+                    self.node_time_fn(layer, cand)
+                    if self.node_time_fn
+                    else None
+                ),
+            )
+            for i, t in enumerate(layer.inputs):
+                want = cand.inputs[i] if i < len(cand.inputs) else None
+                total += self._edge_cost(t, in_shs[i], want)
+            for i, t in enumerate(layer.outputs):
+                if i < len(cand.output):
+                    local[t.guid] = cand.output[i]
+        return total
+
+    def _expand_chain_assign(
+        self, assign: Dict[int, OpSharding]
+    ) -> Dict[int, OpSharding]:
+        """Copy each template layer's winning OpSharding onto every
+        repeat — deferred to the end of the sweep so DP states carry
+        template-sized assignment dicts."""
+        if not self._chain_at:
+            return assign
+        out = dict(assign)
+        for chain in self._chain_at.values():
+            for j, tl in enumerate(chain.template):
+                a = out.get(int(tl.layer_guid))
+                if a is None:
+                    continue
+                for d in range(1, chain.depth):
+                    out[int(chain.layers[d][j].layer_guid)] = a
+        return out
 
     def _transition_cost_parallel(
         self, layer: Layer, src: TensorSharding, dst: TensorSharding

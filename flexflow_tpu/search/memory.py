@@ -41,32 +41,52 @@ def strategy_memory_per_device(
     analytic (their bytes are exact).  Ops that fail to compile in
     isolation keep the analytic term.
     """
+    from flexflow_tpu.blocks import layer_signature
+
     mesh = strategy.mesh
     total = 0.0
+    # repeated-block memo: structurally identical layers under identical
+    # shardings contribute identical bytes — price one, multiply (the
+    # memory-tier analog of the block-collapsed search; on BERT-Large's
+    # 173-layer PCG this prices ~10 unique (layer, sharding) pairs).
+    # With a profiler this also skips the per-repeat measurement compile.
+    memo: dict = {}
     for layer in layers:
         if layer.op_type.is_parallel_op:
             continue
         opdef = get_op_def(layer.op_type)
         s = strategy.op_sharding(layer)
+        mk = (layer_signature(layer), None if s is None else s.key())
+        cached = memo.get(mk)
+        if cached is not None:
+            total += cached
+            continue
+        contrib = 0.0
         for w in opdef.weights(layer):
             wb = math.prod(w.shape) * _dtype_bytes(w.dtype)
             ws = s.weights.get(w.name) if s else None
             deg = ws.total_degree(mesh) if ws else 1
             factor = optimizer_state_factor if w.trainable else 1.0
-            total += wb * factor / deg
-        if profiler is not None:
-            measured = profiler.measure_memory(layer, s, mesh)
-            if measured > 0:
-                total += measured  # already per-shard (local shapes)
-                continue
-        for i, (shape, dt) in enumerate(opdef.infer(layer)):
-            ob = math.prod(shape) * _dtype_bytes(dt)
-            # NOTE: partial axes do NOT divide memory — a partial-sum tensor
-            # is full (local) size on every device along its partial axes
-            deg = 1
-            if s and i < len(s.output):
-                deg = s.output[i].total_degree(mesh)
-            total += ob / deg
+            contrib += wb * factor / deg
+        measured = (
+            profiler.measure_memory(layer, s, mesh)
+            if profiler is not None
+            else 0.0
+        )
+        if measured > 0:
+            contrib += measured  # already per-shard (local shapes)
+        else:
+            for i, (shape, dt) in enumerate(opdef.infer(layer)):
+                ob = math.prod(shape) * _dtype_bytes(dt)
+                # NOTE: partial axes do NOT divide memory — a partial-sum
+                # tensor is full (local) size per device along its
+                # partial axes
+                deg = 1
+                if s and i < len(s.output):
+                    deg = s.output[i].total_degree(mesh)
+                contrib += ob / deg
+        memo[mk] = contrib
+        total += contrib
     return total
 
 

@@ -37,14 +37,9 @@ def _compile(m, mesh=MESH):
 
 def _transfer(m_dst, weights):
     """set_weights restricted to (name, shape)-surviving entries."""
-    ex = m_dst.executor
-    keep = {}
-    for lname, ws in weights.items():
-        for wname, arr in ws.items():
-            bucket = m_dst._weight_bucket(ex, lname, wname)
-            if bucket is not None and bucket[lname][wname].shape == arr.shape:
-                keep.setdefault(lname, {})[wname] = arr
-    m_dst.set_weights(keep)
+    m_dst.executor.assign_weight_entries(
+        weights, strict=False, shape_skip=True
+    )
 
 
 def _parity(build_fn, rule_name, x, atol=1e-5, inference=True, train=0):

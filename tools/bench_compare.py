@@ -60,11 +60,15 @@ DEFAULT_THRESHOLD = 0.15
 # record keys that may legitimately differ between comparable runs —
 # noted in the output, but never a reason to refuse the comparison
 # (contrast: a machine_model mismatch is a different experiment)
-COMPARABLE_METADATA = ("metrics_sync_every",)
+COMPARABLE_METADATA = ("metrics_sync_every", "stack_blocks")
 
-# (label, path into the record, higher_is_better) — the gated metrics
+# (label, path into the record, higher_is_better) — the gated metrics.
+# jit_compile_s gates LOWER-is-better: a compile-time regression fails
+# like a throughput regression (the scan-stacked block work of r07 made
+# compile a first-class budget — see docs/PERF.md).
 GATED = (
     ("throughput", ("value",), True),
+    ("compile", ("jit_compile_s",), False),
     ("dlrm", ("secondary", "dlrm", "samples_per_sec"), True),
     ("bert_large", ("secondary", "bert_large", "samples_per_sec"), True),
     ("gpt_decode_cached", ("secondary", "gpt_decode", "cached_tok_per_s"), True),
@@ -130,7 +134,7 @@ def compare(
     """Per-metric comparison rows; a row regresses when the current
     value falls more than ``threshold`` below the baseline."""
     rows = []
-    for label, path, _higher in GATED:
+    for label, path, higher in GATED:
         base = _dig(baseline, path)
         cur = _dig(current, path)
         if base is None or cur is None or base <= 0:
@@ -141,7 +145,13 @@ def compare(
             "baseline": base,
             "current": cur,
             "ratio": ratio,
-            "regressed": ratio < (1.0 - threshold),
+            # higher-is-better regresses by dropping below 1-threshold;
+            # lower-is-better (compile time) by rising above 1+threshold
+            "regressed": (
+                ratio < (1.0 - threshold)
+                if higher
+                else ratio > (1.0 + threshold)
+            ),
         })
     return rows
 

@@ -89,6 +89,35 @@ def render(doc: Dict, by: str = "both", top: int = 40) -> str:
     if by in ("name", "both"):
         out.append(_breakdown(_aggregate(events, "name"), wall_us, "span", top))
 
+    # scan-stacked repeated blocks (--stack-blocks, docs/PERF.md): one
+    # block_scan span per chain per trace, carrying depth (repeats) and
+    # layers (block length).  Roll them up per block shape so a stacked
+    # run's trace still answers "how much wall went into which chain" —
+    # the per-layer spans those chains replaced no longer exist.
+    bs = [
+        e for e in events
+        if e.get("ph") == "X" and e.get("name") == "block_scan"
+    ]
+    if bs:
+        agg: Dict[str, List[float]] = {}
+        for e in bs:
+            a = e.get("args") or {}
+            key = (f"depth={a.get('depth', '?')} x "
+                   f"{a.get('layers', '?')} layers")
+            row = agg.setdefault(key, [0, 0.0])
+            row[0] += 1
+            row[1] += float(e.get("dur", 0.0))
+        rows = [
+            [k, int(n), f"{tot / 1e3:.2f}",
+             f"{100.0 * tot / wall_us:.1f}%" if wall_us > 0 else "-"]
+            for k, (n, tot) in sorted(agg.items(), key=lambda kv: -kv[1][1])
+        ]
+        out.append(
+            "block_scan rollup (trace-time per stacked chain; one scan "
+            "compiles the whole chain):\n"
+            + _table(["chain", "spans", "total_ms", "% wall"], rows)
+        )
+
     counters = summary.get("counters")
     if counters is None:  # fall back to final 'C' events
         counters = {}

@@ -16,11 +16,21 @@ import sys
 
 
 def main() -> int:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--serve":
+        # serving demo driver (docs/SERVING.md): continuous batching +
+        # paged KV cache over a gpt_decoder, fed by a synthetic
+        # open-loop traffic generator — no user script involved
+        from flexflow_tpu.serve.driver import main as serve_main
+
+        return serve_main(sys.argv[2:])
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
         print(
             "usage: python -m flexflow_tpu <script.py> [flexflow flags...]\n"
+            "       python -m flexflow_tpu --serve [serve flags...]\n"
             "Runs <script.py> as __main__ with the remaining args on "
-            "sys.argv (FFConfig.parse_args consumes FlexFlow flags).",
+            "sys.argv (FFConfig.parse_args consumes FlexFlow flags); "
+            "--serve runs the continuous-batching serving driver "
+            "(docs/SERVING.md).",
             file=sys.stderr,
         )
         return 0 if len(sys.argv) >= 2 else 2

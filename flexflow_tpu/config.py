@@ -141,6 +141,17 @@ class FFConfig:
     rng_seed: int = 0
     memory_search_budget: int = -1  # lambda search iterations (graph.cc:2075)
     device_memory_gb: float = -1.0  # per-device HBM budget for λ mem search
+    # --- serving (docs/SERVING.md) ---
+    # search objective: "train" minimizes the training step estimate,
+    # "serve" prices forward-only + the ServeObjective (steady-state
+    # decode tokens/s under the --serve-slo-ms p99 per-token bound)
+    search_objective: str = "train"  # train | serve
+    serve_slots: int = 0  # decode lanes (0 = the model's compiled batch)
+    serve_block_size: int = 16  # KV positions per paged block
+    serve_num_blocks: int = 0  # KV pool size (0 = full provisioning)
+    serve_prefill_chunk: int = 32  # prompt positions per prefill call
+    serve_sync_every: int = 4  # decode steps per flush window
+    serve_slo_ms: float = 50.0  # p99 per-token latency SLO (objective)
 
     def __post_init__(self) -> None:
         self._devices = None
@@ -284,6 +295,20 @@ class FFConfig:
                 self.node_id = int(take())
             elif a == "--dcn-axis":
                 self.dcn_axis = take()
+            elif a == "--objective":
+                self.search_objective = take()
+            elif a == "--serve-slots":
+                self.serve_slots = int(take())
+            elif a == "--serve-block-size":
+                self.serve_block_size = int(take())
+            elif a == "--serve-num-blocks":
+                self.serve_num_blocks = int(take())
+            elif a == "--serve-prefill-chunk":
+                self.serve_prefill_chunk = int(take())
+            elif a == "--serve-sync-every":
+                self.serve_sync_every = int(take())
+            elif a == "--serve-slo-ms":
+                self.serve_slo_ms = float(take())
             else:
                 rest.append(a)
             i += 1

@@ -742,6 +742,25 @@ class FFModel:
 
                 extra_xfers = _load_substitution_xfers(cfg)
 
+                serve_spec = None
+                if cfg.search_objective == "serve":
+                    # --objective serve: search placements for the
+                    # decode loop (docs/SERVING.md) — slots/SLO/flush
+                    # cadence from the serving flags, steady-state
+                    # prefix depth = the compiled position range
+                    from flexflow_tpu.serve.objective import ServeSpec
+
+                    serve_spec = ServeSpec(
+                        slots=cfg.serve_slots or cfg.batch_size,
+                        kv_len=(
+                            self.graph_inputs[0].shape[1]
+                            if self.graph_inputs
+                            and self.graph_inputs[0].ndim >= 2
+                            else 512
+                        ),
+                        slo_p99_ms=cfg.serve_slo_ms,
+                        sync_every=cfg.serve_sync_every,
+                    )
                 strategy = unity_search(
                     self.layers,
                     mesh,
@@ -768,6 +787,8 @@ class FFModel:
                         else 8
                     ),
                     extra_xfers=extra_xfers,
+                    objective=cfg.search_objective,
+                    serve=serve_spec,
                 )
             else:
                 strategy = data_parallel_strategy(self.layers, mesh)

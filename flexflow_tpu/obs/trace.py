@@ -54,6 +54,8 @@ CORE_COUNTERS = (
     "checkpoint.bytes_written",
     "network.ring_collectives",
     "network.hierarchical_collectives",
+    "serve.windows",
+    "serve.decode_steps",
 )
 
 

@@ -198,6 +198,9 @@ class Strategy:
         # populated by from_json: exported per-op layer names (guid ->
         # name at export time), consumed by rebind()
         self._op_names: Dict[int, str] = {}
+        # set by unity_search(objective="serve"): the ServeObjective's
+        # pricing of this placement (tok_s / p99_ms / feasible / ...)
+        self.serve_price: Optional[Dict] = None
 
     def op_sharding(self, layer: Layer) -> Optional[OpSharding]:
         return self.ops.get(int(layer.layer_guid))

@@ -221,15 +221,21 @@ _VIEW_OPS = frozenset({
 
 
 def op_compute_time(
-    layer: Layer, degree: int, machine: TPUMachineModel, mxu_util: float = 0.5
+    layer: Layer,
+    degree: int,
+    machine: TPUMachineModel,
+    mxu_util: float = 0.5,
+    fwd_only: bool = False,
 ) -> float:
     """Roofline: max(flops-bound, bandwidth-bound), fwd+bwd (bwd ≈ 2×fwd
-    flops for matmul-type ops — the reference measures both separately)."""
+    flops for matmul-type ops — the reference measures both separately).
+    ``fwd_only`` prices the forward pass alone (inference/serving)."""
     if layer.op_type in _VIEW_OPS:
         return 0.0
     opdef = get_op_def(layer.op_type)
-    flops = 3.0 * opdef.flops(layer) / max(1, degree)
-    mem = 3.0 * opdef.mem_bytes(layer) / max(1, degree)
+    factor = 1.0 if fwd_only else 3.0
+    flops = factor * opdef.flops(layer) / max(1, degree)
+    mem = factor * opdef.mem_bytes(layer) / max(1, degree)
     return max(flops / (machine.peak_flops * mxu_util), mem / machine.hbm_bw)
 
 
@@ -357,6 +363,7 @@ def node_cost(
     machine: Optional[TPUMachineModel] = None,
     lambda_mem: float = 0.0,
     compute_time: Optional[float] = None,
+    forward_only: bool = False,
 ) -> float:
     """Compute + weight-grad-sync time for one op under one sharding choice
     (the DP's leaf cost — reference ``SearchHelper::graph_cost`` leaf at
@@ -365,6 +372,12 @@ def node_cost(
     ``lambda_mem`` adds a memory pressure term (λ·bytes) — the
     multi-objective combination of the reference's memory-aware search
     (``try_one_lambda``, ``src/runtime/graph.cc:1884``).
+
+    ``forward_only`` prices inference: forward roofline only, and the
+    training-only collectives — weight-grad allreduce and the backward
+    dgrad partial resolution — are skipped entirely (there IS no
+    backward pass to run them in).  The λ memory terms stay: weights and
+    activations occupy HBM either way.
     """
     m = machine or TPUMachineModel()
     opdef = get_op_def(layer.op_type)
@@ -373,7 +386,11 @@ def node_cost(
     # splits like fused-Experts EP)
     degree = opdef.shard_degree(layer, sharding, mesh)
     # measured tier (simulator.MeasuredCostModel) overrides the roofline
-    t = compute_time if compute_time is not None else op_compute_time(layer, degree, m)
+    t = (
+        compute_time
+        if compute_time is not None
+        else op_compute_time(layer, degree, m, fwd_only=forward_only)
+    )
     # gradient sync: weight grads are partial over every mesh axis that
     # shards the op's *data* (batch/seq) but not the weight itself
     data_axes = set()
@@ -394,7 +411,7 @@ def node_cost(
             sync *= mesh.axis_size(a)
             if a in m.dcn_axes:
                 sync_axis = a  # DCN participant dominates the ring
-        if sync > 1:
+        if sync > 1 and not forward_only:
             t += m.all_reduce(wb / wd, sync, axis=sync_axis)
         if lambda_mem > 0.0:
             t += lambda_mem * (wb / wd)
@@ -409,6 +426,17 @@ def node_cost(
     # its input spec carries the axis.  Integer inputs (embedding ids)
     # are not differentiated, so vocab-sharded embeddings charge nothing.
     part_deg = 1
+    if forward_only:
+        # no backward pass: the dgrad partial-resolution term below is
+        # dead — but forward partial sums (Megatron row-parallel) are
+        # still resolved by the EDGE cost, which stays priced
+        if lambda_mem > 0.0 and out0 is not None:
+            out_b = sum(
+                math.prod(s) * _dtype_nbytes(dt)
+                for s, dt in opdef.infer(layer)
+            )
+            t += lambda_mem * (out_b / max(1, out0.total_degree(mesh)))
+        return t
     for a in (out0.partial_axes if out0 is not None else ()):
         part_deg *= mesh.axis_size(a)
     out_deg_full = (out0.total_degree(mesh) if out0 is not None else 1) * part_deg
@@ -473,11 +501,16 @@ def estimate_strategy_cost(
     node_time_fn=None,
     cost_cache: Optional[Dict] = None,
     collapse_blocks: bool = True,
+    forward_only: bool = False,
 ) -> float:
     """Per-step time estimate for a whole strategy: node costs (compute +
     weight-grad sync) + per-edge reshard collectives.  Pure function of the
     layer graph + strategy — deterministic and unit-testable (the gap
     SURVEY §4.7 notes in the reference's device-measured costing).
+
+    ``forward_only`` prices an inference step (no backward collectives,
+    1× forward roofline — see :func:`node_cost`); the serving objective
+    (``unity_search --objective serve``) searches under this pricing.
 
     ``collapse_blocks``: chains of >= 4 structurally identical blocks
     whose strategy assignment is uniform across repeats are priced ONCE
@@ -520,7 +553,7 @@ def estimate_strategy_cost(
                 t.shape, _dtype_nbytes(t.dtype), src, dst, mesh, m,
                 # graph inputs have no cotangent — same rule as dp.py, so
                 # the DP and this estimator optimize the same objective
-                with_backward=t.owner_layer is not None,
+                with_backward=t.owner_layer is not None and not forward_only,
             )
             pop_out[layer.outputs[0].guid] = dst
             return c_total
@@ -533,12 +566,13 @@ def estimate_strategy_cost(
                 ]
             )
         if cost_cache is not None:
-            nk = ("n", int(layer.layer_guid), os_.key())
+            nk = ("n", int(layer.layer_guid), os_.key(), forward_only)
             c = cost_cache.get(nk)
             if c is None:
                 c = node_cost(
                     layer, os_, mesh, m, lambda_mem=lambda_mem,
                     compute_time=node_time_fn(layer, os_) if node_time_fn else None,
+                    forward_only=forward_only,
                 )
                 cost_cache[nk] = c
             c_total += c
@@ -550,6 +584,7 @@ def estimate_strategy_cost(
                 m,
                 lambda_mem=lambda_mem,
                 compute_time=node_time_fn(layer, os_) if node_time_fn else None,
+                forward_only=forward_only,
             )
         for i, t in enumerate(layer.inputs):
             src = producer_sharding(t)
@@ -564,7 +599,7 @@ def estimate_strategy_cost(
                 "model" in src.axes_of(d) for d in range(len(src.spec))
             ):
                 continue
-            bwd = t.owner_layer is not None
+            bwd = t.owner_layer is not None and not forward_only
             if cost_cache is not None:
                 ek = ("e", t.guid, src.key(), dst.key(), bwd)
                 c = cost_cache.get(ek)
@@ -617,6 +652,100 @@ def estimate_strategy_cost(
     if hasattr(m, "flush_decisions"):
         m.flush_decisions()
     return total
+
+
+def estimate_decode_step_time(
+    layers: List[Layer],
+    strategy: Strategy,
+    machine: Optional[TPUMachineModel] = None,
+    *,
+    slots: int,
+    kv_len: int,
+    train_tokens: int,
+    mxu_util: float = 0.5,
+) -> Dict[str, float]:
+    """Analytic ONE-token decode step time under a strategy — the
+    serving analog of :func:`estimate_strategy_cost` (docs/SERVING.md,
+    "The SLO objective").
+
+    Decode is a different roofline regime from training: per step every
+    weight streams from HBM once while only ``slots`` activation rows
+    flow through it, so dense layers are weight-bandwidth-bound; the
+    attention term reads each slot's ``kv_len``-deep K/V pages; and
+    tensor-parallel shardings buy weight-stream time with one partial-sum
+    allreduce per sharded layer at decode-activation size (tiny bytes —
+    latency-dominated, which is exactly why a DCN-crossing model axis is
+    poison for serving and the 2-slice golden pins that the objective
+    knows it).
+
+    Activation/collective bytes scale from the graph's training shapes
+    by ``slots / train_tokens`` (the graph carries (B, S, H) tensors;
+    a decode step moves one token per slot).  Pure host math —
+    deterministic, golden-testable, no TPU required.
+
+    Returns ``{"step_s", "mem_s", "flops_s", "coll_s"}``.
+    """
+    mesh = strategy.mesh
+    m = (machine or TPUMachineModel()).for_mesh(mesh)
+    mem_s = flops_s = coll_s = 0.0
+    for layer in layers:
+        if layer.op_type.is_parallel_op or layer.op_type in _VIEW_OPS:
+            continue
+        opdef = get_op_def(layer.op_type)
+        os_ = strategy.op_sharding(layer) or default_op_sharding(layer)
+        out0 = os_.output[0] if os_.output else None
+        # slot parallelism: mesh axes sharding the output's batch dim
+        slot_deg = 1
+        if out0 is not None and len(out0.spec):
+            for a in out0.axes_of(0):
+                slot_deg *= mesh.axis_size(a)
+        local_slots = max(1.0, slots / max(1, slot_deg))
+        lmem = lflops = 0.0
+        for w in opdef.weights(layer):
+            wd = 1
+            ws = os_.weights.get(w.name)
+            if ws is not None:
+                wd = max(1, ws.total_degree(mesh))
+            elems = math.prod(w.shape)
+            lmem += elems * _dtype_nbytes(w.dtype) / wd
+            lflops += 2.0 * elems / wd * local_slots
+        if layer.op_type == OperatorType.MULTIHEAD_ATTENTION:
+            e = layer.attrs.get("embed_dim", 0)
+            tp = 1
+            ws = os_.weights.get("wq")
+            if ws is not None:
+                tp = max(1, ws.total_degree(mesh))
+            nb = _dtype_nbytes(layer.outputs[0].dtype)
+            lmem += 2.0 * local_slots * kv_len * e * nb / tp
+            lflops += 2.0 * 2.0 * local_slots * kv_len * e / tp
+        mem_s += lmem / m.hbm_bw
+        flops_s += lflops / (m.peak_flops * mxu_util)
+        # partial-sum resolution per step (the TP allreduce), at
+        # decode-activation bytes
+        if out0 is not None and out0.partial_axes:
+            out_b = sum(
+                math.prod(s) * _dtype_nbytes(dt)
+                for s, dt in opdef.infer(layer)
+            )
+            per_tok = out_b / max(1, train_tokens)
+            shard_deg = max(1, out0.total_degree(mesh))
+            for a in out0.partial_axes:
+                n = mesh.axis_size(a)
+                if n > 1:
+                    coll_s += m.all_reduce(
+                        per_tok * local_slots / shard_deg, n, axis=a
+                    )
+    if hasattr(m, "flush_decisions"):
+        m.flush_decisions()
+    # dense compute and weight streaming overlap on real hardware only
+    # partially; the roofline takes the max per step, serialized with
+    # the collectives (same convention as op_compute_time)
+    return {
+        "step_s": max(mem_s, flops_s) + coll_s,
+        "mem_s": mem_s,
+        "flops_s": flops_s,
+        "coll_s": coll_s,
+    }
 
 
 def _chain_assignment_uniform(chain, strategy: Strategy) -> bool:

@@ -59,6 +59,7 @@ class SearchHelper:
         lambda_mem: float = 0.0,
         node_time_fn=None,
         collapse_blocks: bool = True,
+        forward_only: bool = False,
     ) -> None:
         self.layers = layers
         self.graph_inputs = graph_inputs
@@ -67,6 +68,12 @@ class SearchHelper:
         self.machine = (machine or TPUMachineModel()).for_mesh(mesh)
         self.beam = beam
         self.lambda_mem = lambda_mem
+        # inference pricing (unity_search --objective serve): forward
+        # roofline only, no backward transpose/grad-sync collectives —
+        # node_cost/reshard_cost are gated the same way estimate_
+        # strategy_cost's forward_only is, so the DP and the estimator
+        # keep optimizing the same objective
+        self.forward_only = forward_only
         # measured-cost tier (reference: search driven by on-device kernel
         # timing, ``src/runtime/simulator.cc:537-577``): when provided, leaf
         # compute times come from (layer, sharding) -> seconds instead of
@@ -115,7 +122,7 @@ class SearchHelper:
             t.shape, _dtype_nbytes(t.dtype), src, dst, self.mesh, self.machine,
             # graph inputs have no cotangent (grad is w.r.t. params only),
             # so their edges carry no backward transpose collective
-            with_backward=t.owner_layer is not None,
+            with_backward=t.owner_layer is not None and not self.forward_only,
         )
 
     def solve(self) -> Tuple[float, Dict[int, OpSharding]]:
@@ -205,6 +212,7 @@ class SearchHelper:
                                 if self.node_time_fn
                                 else None
                             ),
+                            forward_only=self.forward_only,
                         )
                         for i, t in enumerate(layer.inputs):
                             want = cand.inputs[i] if i < len(cand.inputs) else None
@@ -337,6 +345,7 @@ class SearchHelper:
                     if self.node_time_fn
                     else None
                 ),
+                forward_only=self.forward_only,
             )
             for i, t in enumerate(layer.inputs):
                 want = cand.inputs[i] if i < len(cand.inputs) else None
@@ -370,7 +379,7 @@ class SearchHelper:
         t = layer.inputs[0]
         return reshard_cost(
             t.shape, _dtype_nbytes(t.dtype), src, dst, self.mesh, self.machine,
-            with_backward=t.owner_layer is not None,
+            with_backward=t.owner_layer is not None and not self.forward_only,
         )
 
     def to_strategy(self, assign: Dict[int, OpSharding]) -> Strategy:

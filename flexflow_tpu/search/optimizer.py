@@ -26,6 +26,17 @@ from flexflow_tpu.search.substitution import graph_optimize
 from flexflow_tpu.tensor import Layer
 
 
+def _train_tokens(graph_inputs) -> int:
+    """Tokens one training step of this graph moves (batch x seq of the
+    first sequence-shaped input, else batch) — the scale factor the
+    ServeObjective uses to turn training-shaped activation bytes into
+    per-decode-token bytes."""
+    for t in graph_inputs:
+        if t.ndim >= 2:
+            return int(t.shape[0] * t.shape[1])
+    return int(graph_inputs[0].shape[0]) if graph_inputs else 1
+
+
 def unity_search(
     layers: List[Layer],
     mesh: MachineMesh,
@@ -42,6 +53,8 @@ def unity_search(
     extra_xfers=None,
     struct_xfers="default",
     inference: bool = False,
+    objective: str = "train",
+    serve=None,
 ) -> Strategy:
     """Pick the cheapest (mesh factorization, per-op sharding) pair.
 
@@ -71,6 +84,17 @@ def unity_search(
     training-illegal rules (BN folding).  When the winner applied
     rewrites, the returned Strategy carries ``rewritten_layers`` /
     ``output_remap`` — callers must execute that layer list.
+
+    ``objective``: ``"train"`` (default) minimizes the training step-time
+    estimate; ``"serve"`` searches placements for INFERENCE — the DP and
+    rewrite tiers price forward-only (no backward/grad-sync collectives),
+    and each mesh's winner is re-priced by the
+    :class:`~flexflow_tpu.serve.objective.ServeObjective` (steady-state
+    decode tokens/s subject to a p99 per-token latency SLO — see
+    docs/SERVING.md).  ``serve`` is the
+    :class:`~flexflow_tpu.serve.objective.ServeSpec` (slots, kv_len,
+    SLO, flush cadence); None uses its defaults.  The winner carries a
+    ``serve_price`` dict (tok_s / p99_ms / feasible / breakdown).
     """
     from flexflow_tpu.obs import get_tracer
     from flexflow_tpu.search.candidates import SearchOptions, search_options
@@ -88,15 +112,16 @@ def unity_search(
         return _unity_search_impl(
             layers, mesh, graph_inputs, budget, alpha, machine,
             mem_budget_bytes, explore_meshes, beam, profiler, mem_search_iters,
-            extra_xfers, struct_xfers, inference,
+            extra_xfers, struct_xfers, inference, objective, serve,
         )
 
 
 def _unity_search_impl(
     layers, mesh, graph_inputs, budget, alpha, machine,
     mem_budget_bytes, explore_meshes, beam, profiler, mem_search_iters,
-    extra_xfers, struct_xfers, inference,
+    extra_xfers, struct_xfers, inference, objective="train", serve=None,
 ) -> Strategy:
+    assert objective in ("train", "serve"), objective
     if graph_inputs is None:
         seen = set()
         graph_inputs = []
@@ -106,6 +131,14 @@ def _unity_search_impl(
                 if t.guid not in produced and t.guid not in seen:
                     seen.add(t.guid)
                     graph_inputs.append(t)
+    serve_obj = None
+    if objective == "serve":
+        from flexflow_tpu.serve.objective import ServeObjective, ServeSpec
+
+        serve_obj = ServeObjective(
+            machine, serve or ServeSpec(),
+            train_tokens=_train_tokens(graph_inputs),
+        )
 
     meshes = mesh.enumerate_views() if explore_meshes else [mesh]
     # keep the device total fixed; dedupe degenerate permutations; reject
@@ -153,6 +186,9 @@ def _unity_search_impl(
                 node_time_fn=_ntf, extra_xfers=extra_xfers,
                 struct_xfers=struct_xfers, inference=inference,
                 return_joint=True,
+                # a serve search prices the DP/rewrite tiers forward-only
+                # (there is no backward pass at inference time)
+                forward_only=serve_obj is not None,
             )
 
         try:
@@ -176,8 +212,21 @@ def _unity_search_impl(
             # parallel-op attrs (fixed degree/axis) — skip, like the
             # reference skips invalid MachineViews
             continue
-        if res.cost < best_cost:
-            best_cost = res.cost
+        cost = res.cost
+        price = None
+        if serve_obj is not None:
+            # mesh selection under the SERVING objective: steady-state
+            # decode tokens/s subject to the p99 per-token SLO — a mesh
+            # that wins the forward-pass DP can still lose here when its
+            # per-step collective rides DCN latency
+            st_tmp = Strategy(mv)
+            st_tmp.ops = res.assign
+            price = serve_obj.price(
+                res.layers if res.layers is not layers else layers, st_tmp
+            )
+            cost = price["cost"]
+        if cost < best_cost:
+            best_cost = cost
             st = Strategy(mv)
             st.ops = res.assign
             if res.layers is not layers:
@@ -185,6 +234,8 @@ def _unity_search_impl(
                 st.output_remap = res.remap
                 st.applied_rewrites = tuple(res.applied)
                 st.applied_detail = tuple(res.applied_detail)
+            if price is not None:
+                st.serve_price = price
             best = st
     assert best is not None, "no feasible mesh factorization"
     if profiler is not None:

@@ -385,6 +385,7 @@ def base_optimize(
     struct_xfers: Optional[Sequence] = None,
     inference: bool = False,
     return_joint: bool = False,
+    forward_only: bool = False,
 ):
     """Best-first backtracking over xfer applications (reference
     ``base_optimize``, ``substitution.cc:2229-2311``): pop the cheapest
@@ -422,7 +423,7 @@ def base_optimize(
         st.ops = assign
         return estimate_strategy_cost(
             lyrs, st, m, lambda_mem=lambda_mem, node_time_fn=node_time_fn,
-            cost_cache=cost_cache,
+            cost_cache=cost_cache, forward_only=forward_only,
         )
 
     shard_xfers = generate_all_pcg_xfers(mesh) + [
@@ -607,6 +608,7 @@ def graph_optimize(
     struct_xfers: Optional[Sequence] = None,
     inference: bool = False,
     return_joint: bool = False,
+    forward_only: bool = False,
     _depth: int = 0,
 ):
     """Recursive optimize (reference ``GraphSearchHelper::graph_optimize``,
@@ -623,6 +625,7 @@ def graph_optimize(
             node_time_fn, extra_xfers,
             struct_xfers=struct_xfers if _depth == 0 else None,
             inference=inference, return_joint=True,
+            forward_only=forward_only,
         )
         if res.applied:
             # the joint winner changed the graph: its carried assignment
@@ -636,12 +639,13 @@ def graph_optimize(
             h2 = SearchHelper(
                 res.layers, graph_inputs, mesh, machine, beam=beam,
                 lambda_mem=lambda_mem, node_time_fn=node_time_fn,
+                forward_only=forward_only,
             )
             _, a2 = h2.solve()
             res2 = base_optimize(
                 res.layers, mesh, {**a2, **res.assign}, machine, budget,
                 alpha, lambda_mem, node_time_fn, extra_xfers,
-                return_joint=True,
+                return_joint=True, forward_only=forward_only,
             )
             res = dataclasses.replace(
                 res2, layers=res.layers, remap=res.remap,
@@ -657,20 +661,20 @@ def graph_optimize(
             _, a1 = graph_optimize(
                 pre, graph_inputs, mesh, machine, budget // 2 or 1, alpha,
                 beam, lambda_mem, node_time_fn, extra_xfers,
-                _depth=_depth + 1,
+                forward_only=forward_only, _depth=_depth + 1,
             )
             post_inputs = [t for l in post for t in l.inputs
                            if t.owner_layer is None or t.owner_layer in pre]
             _, a2 = graph_optimize(
                 post, post_inputs, mesh, machine, budget // 2 or 1, alpha,
                 beam, lambda_mem, node_time_fn, extra_xfers,
-                _depth=_depth + 1,
+                forward_only=forward_only, _depth=_depth + 1,
             )
             return finish({**a1, **a2})
 
     helper = SearchHelper(
         layers, graph_inputs, mesh, machine, beam=beam, lambda_mem=lambda_mem,
-        node_time_fn=node_time_fn,
+        node_time_fn=node_time_fn, forward_only=forward_only,
     )
     _, assign = helper.solve()
     return finish(assign)

@@ -1,0 +1,51 @@
+"""Production serving subsystem (docs/SERVING.md).
+
+The reference ships a Legion inference backend (``triton/``, ~18k LoC)
+because an auto-parallelizing training framework is only half a
+production story.  This package is the TPU-native analog over the
+compiled decode path (:mod:`flexflow_tpu.models.gpt_decode`):
+
+* :mod:`flexflow_tpu.serve.kvcache` — paged/block KV-cache allocator:
+  the (L, B, H, S, D) cache becomes fixed-size blocks with a free list
+  and per-request block tables, so long and short conversations share
+  HBM instead of each reserving max-S.
+* :mod:`flexflow_tpu.serve.scheduler` — continuous-batching scheduler:
+  variable-length requests admitted FIFO into a shared fixed-slot
+  decode step; finished sequences free their slot mid-flight and a
+  queued request takes it without recompiling.
+* :mod:`flexflow_tpu.serve.engine` — the compiled paged decode step +
+  chunked prefill programs and the zero-per-step-sync serve loop
+  (device-chained tokens, one host sync per flush window — the
+  async-fit machinery applied to serving).
+* :mod:`flexflow_tpu.serve.traffic` — synthetic open-loop traffic
+  generator for CPU-smoke A/Bs (`bench.py serve_continuous_ab`).
+* :mod:`flexflow_tpu.serve.objective` — ``ServeObjective``: prices
+  steady-state decode tokens/s subject to a p99 per-token latency SLO,
+  so ``unity_search --objective serve`` emits placements for inference.
+* :mod:`flexflow_tpu.serve.driver` — the ``python -m flexflow_tpu
+  --serve`` entry point.
+"""
+
+from flexflow_tpu.serve.engine import ServeEngine, ServeReport
+from flexflow_tpu.serve.kvcache import KVCacheOOM, PagedKVCache
+from flexflow_tpu.serve.objective import ServeObjective, ServeSpec
+from flexflow_tpu.serve.scheduler import (
+    ContinuousBatchingScheduler,
+    Request,
+    RequestState,
+)
+from flexflow_tpu.serve.traffic import TrafficSpec, synthetic_requests
+
+__all__ = [
+    "PagedKVCache",
+    "KVCacheOOM",
+    "Request",
+    "RequestState",
+    "ContinuousBatchingScheduler",
+    "ServeEngine",
+    "ServeReport",
+    "ServeSpec",
+    "ServeObjective",
+    "TrafficSpec",
+    "synthetic_requests",
+]

@@ -1,0 +1,144 @@
+"""``python -m flexflow_tpu --serve`` — the serving demo driver.
+
+Builds a :func:`~flexflow_tpu.models.transformer.gpt_decoder`, compiles
+it (Unity-searched when ``--search-budget`` is set — with
+``--objective serve`` the search prices the ServeObjective), stands up
+the continuous-batching :class:`~flexflow_tpu.serve.engine.ServeEngine`,
+replays a seeded synthetic open-loop workload against it, and prints
+ONE JSON summary line (plus the ``--metrics-out`` ffmetrics/1 stream
+that ``tools/serve_report.py`` renders).
+
+Defaults are CPU-smoke sized; pass model flags for anything real.
+
+    python -m flexflow_tpu --serve --requests 32 --rate 50 \\
+        --serve-slots 4 --serve-sync-every 4 --metrics-out serve.jsonl
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Optional
+
+__all__ = ["main"]
+
+
+def _int_pair(s: str) -> tuple:
+    lo, _, hi = s.partition(":")
+    return (int(lo), int(hi or lo))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from flexflow_tpu.config import FFConfig
+
+    cfg = FFConfig()
+    rest = cfg.parse_args(list(argv if argv is not None else sys.argv[1:]))
+
+    # driver-local flags
+    opts = dict(
+        requests=16, rate=0.0, prompt_len=(4, 12), gen_len=(4, 24),
+        hidden=64, heads=4, ff_dim=128, num_layers=2, vocab=256, seq=64,
+        traffic_seed=0,
+    )
+    i = 0
+    while i < len(rest):
+        a = rest[i]
+
+        def take():
+            nonlocal i
+            i += 1
+            return rest[i]
+
+        if a == "--requests":
+            opts["requests"] = int(take())
+        elif a == "--rate":
+            opts["rate"] = float(take())
+        elif a == "--prompt-len":
+            opts["prompt_len"] = _int_pair(take())
+        elif a == "--gen-len":
+            opts["gen_len"] = _int_pair(take())
+        elif a == "--hidden":
+            opts["hidden"] = int(take())
+        elif a == "--heads":
+            opts["heads"] = int(take())
+        elif a == "--ff-dim":
+            opts["ff_dim"] = int(take())
+        elif a == "--num-layers":
+            opts["num_layers"] = int(take())
+        elif a == "--vocab":
+            opts["vocab"] = int(take())
+        elif a == "--seq":
+            opts["seq"] = int(take())
+        elif a == "--traffic-seed":
+            opts["traffic_seed"] = int(take())
+        elif a in ("-h", "--help"):
+            print(__doc__, file=sys.stderr)
+            return 0
+        else:
+            print(f"--serve: unknown flag {a!r}", file=sys.stderr)
+            return 2
+        i += 1
+
+    from flexflow_tpu import FFModel
+    from flexflow_tpu.models.transformer import gpt_decoder
+    from flexflow_tpu.serve import ServeEngine, TrafficSpec, synthetic_requests
+
+    slots = cfg.serve_slots or 4
+    cfg.batch_size = slots
+    model = FFModel(cfg)
+    gpt_decoder(
+        model, slots, opts["seq"], hidden=opts["hidden"],
+        heads=opts["heads"], ff_dim=opts["ff_dim"],
+        num_layers=opts["num_layers"], vocab=opts["vocab"],
+        use_flash=False,
+    )
+    model.compile(seed=cfg.rng_seed)
+
+    engine = ServeEngine(
+        model,
+        slots=slots,
+        block_size=cfg.serve_block_size,
+        num_blocks=cfg.serve_num_blocks or None,
+        prefill_chunk=cfg.serve_prefill_chunk,
+        sync_every=cfg.serve_sync_every,
+        metrics_out=cfg.metrics_out,
+    )
+    spec = TrafficSpec(
+        n_requests=opts["requests"], seed=opts["traffic_seed"],
+        rate_rps=opts["rate"], prompt_len=opts["prompt_len"],
+        max_new=opts["gen_len"], vocab=opts["vocab"],
+    )
+    # clamp generated budgets to the compiled position range
+    reqs = synthetic_requests(spec)
+    for r in reqs:
+        # a budget past the compiled range would be (gracefully)
+        # rejected; the demo clamps instead so every request serves
+        r.max_new_tokens = max(
+            1, min(r.max_new_tokens, opts["seq"] - r.prompt_len)
+        )
+    report = engine.run(reqs)
+
+    out = {
+        "metric": "serve_demo",
+        "serve_traffic": spec.identity,
+        "model": (
+            f"gpt L{opts['num_layers']} h{opts['hidden']} "
+            f"v{opts['vocab']} s{opts['seq']}"
+        ),
+        "slots": slots,
+        "block_size": engine.kv.block_size,
+        "num_blocks": engine.kv.num_blocks,
+        "sync_every": engine.sync_every,
+        **report.to_dict(),
+    }
+    sp = getattr(model.strategy, "serve_price", None)
+    if sp is not None:
+        out["serve_price"] = {
+            k: sp[k] for k in ("tok_s", "p99_ms", "feasible")
+        }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,562 @@
+"""Paged decode/prefill programs + the zero-per-step-sync serve loop.
+
+Execution model (docs/SERVING.md):
+
+* ONE jitted **decode step** serves every slot every step: inputs are
+  the paged K/V pools ``(L, num_blocks, H, block_size, D)`` (donated —
+  XLA scatters in place), per-slot tokens/positions, and the per-slot
+  block tables.  Inactive lanes carry an all-zero table row, so their
+  writes land in the trash block (kvcache.py) — no masking, no
+  recompile when the active set changes.
+* A **chunked prefill program** ingests one request's prompt ``P``
+  positions at a time (static chunk size — ONE compile serves every
+  prompt length; the final chunk is padded and padded rows write to the
+  trash block).  Chunks are scheduled between decode windows so a long
+  prompt never stalls running decodes for its whole length.
+* The loop runs in **flush windows** (the async-fit discipline of
+  ``FFModel.fit`` applied to serving): within a window, decode steps
+  chain the next-token array device-to-device — greedy argmax happens
+  ON device — and the host fetches nothing.  One host sync per window
+  (``Executor.count_host_sync`` ledger, same as training) drains the
+  buffered tokens, detects EOS/budget finishes, recycles slots, admits
+  queued requests, and emits one ``ffmetrics/1`` record.  Window length
+  adapts to ``min(sync_every, tokens remaining)`` so a finishing
+  request is recycled the step its budget ends.
+
+The observable-latency consequence is deliberate and documented: a
+token becomes visible at its window's flush, so TTFT/TPOT include up to
+``sync_every`` steps of batching delay — the same latency/throughput
+knob the ServeObjective prices (objective.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from flexflow_tpu.dataloader import DevicePrefetcher
+from flexflow_tpu.models.gpt_decode import GPTSpec, layer_norm, make_cast
+from flexflow_tpu.obs import MetricsStream, get_tracer, step_record
+from flexflow_tpu.serve.kvcache import PagedKVCache
+from flexflow_tpu.serve.scheduler import (
+    ContinuousBatchingScheduler,
+    Request,
+    RequestState,
+)
+
+__all__ = ["ServeEngine", "ServeReport"]
+
+
+def _pct(vals: Sequence[float], q: float) -> Optional[float]:
+    vals = [v for v in vals if v is not None]
+    if not vals:
+        return None
+    return float(np.percentile(np.asarray(vals, np.float64), q))
+
+
+@dataclasses.dataclass
+class ServeReport:
+    """End-of-run aggregate (the bench/driver artifact payload)."""
+
+    wall_s: float
+    new_tokens: int
+    tok_s: float
+    requests_finished: int
+    requests_rejected: int
+    ttft_p50_ms: Optional[float]
+    ttft_p99_ms: Optional[float]
+    tpot_p50_ms: Optional[float]
+    tpot_p99_ms: Optional[float]
+    occupancy_mean: float
+    windows: int
+    decode_steps: int
+    prefill_chunks: int
+    host_syncs: int
+    per_request: List[Dict[str, Any]]
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d.pop("per_request")
+        return d
+
+
+class ServeEngine:
+    """Continuous-batching serving over one compiled gpt_decoder model.
+
+    ``slots`` defaults to the model's compiled batch; the KV pool
+    defaults to full provisioning (``num_blocks`` =
+    slots x blocks-per-max-seq + trash) — pass a smaller ``num_blocks``
+    to oversubscribe HBM (requests then share the pool and admission
+    waits on the free list; see the HBM-sharing test).
+    """
+
+    def __init__(
+        self,
+        model,
+        *,
+        slots: Optional[int] = None,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        prefill_chunk: int = 32,
+        sync_every: int = 4,
+        temperature: float = 0.0,
+        seed: int = 0,
+        eos_id: Optional[int] = None,
+        metrics_out: Optional[str] = None,
+        prefetch_depth: int = 2,
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self._jax, self._jnp = jax, jnp
+        self.model = model
+        self.spec = GPTSpec.from_model(model)
+        self.slots = int(slots or self.spec.batch)
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        self.sync_every = max(1, int(sync_every))
+        self.temperature = float(temperature)
+        if self.temperature > 0.0:
+            # sampling needs the distribution on host before the next
+            # token can be fed — that is a per-step sync by definition
+            self.sync_every = 1
+        self._rng = np.random.default_rng(seed)
+        self.eos_id = eos_id
+        dt = model.executor.compute_dtype
+        self.kv = PagedKVCache(
+            self.spec.num_layers, self.spec.heads, self.spec.head_dim,
+            slots=self.slots, block_size=block_size,
+            num_blocks=num_blocks, max_seq_len=self.spec.seq, dtype=dt,
+        )
+        self.sched = ContinuousBatchingScheduler(self.slots, self.kv)
+        self.metrics = MetricsStream(metrics_out)
+        self.prefetch_depth = max(1, int(prefetch_depth))
+
+        # --- build the two compiled programs -----------------------------
+        spec = self.spec
+        L, H, D = spec.num_layers, spec.heads, spec.head_dim
+        B, MB, BS = self.slots, self.kv.max_blocks_per_seq, block_size
+        SV = MB * BS  # virtual (paged) sequence length
+        S_pos = spec.seq  # pos_embed table height
+        has_bias, eps = spec.has_bias, spec.eps
+        scale = 1.0 / math.sqrt(D)
+        cast = make_cast(jnp, dt)
+        P = self.prefill_chunk
+
+        def ln(p, x):
+            return layer_norm(jax, jnp, p, x, eps)
+
+        def attend(q, keys, vals, mask):
+            # q (..., H, D) vs keys/vals (..., H, SV, D); mul+reduce
+            # scores — the same contraction form as the dense session
+            # (models/gpt_decode.py), so paged and dense decode agree
+            # to the ulp the shared formulation allows
+            scores = (q[..., None, :] * keys).sum(-1) * scale
+            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+            w = jax.nn.softmax(scores, axis=-1)
+            return (w[..., None] * vals).sum(-2)
+
+        def decode(params, ck, cv, tok, pos, bt):
+            # tok/pos (B,) int32; bt (B, MB) int32 block tables
+            params = jax.tree.map(cast, params)
+            x = params["tok_embed"]["kernel"][tok]  # (B, hidden)
+            x = x + params["pos_embed"]["value"][
+                jnp.clip(pos, 0, S_pos - 1)
+            ]
+            lane = jnp.arange(B)
+            blk = bt[lane, jnp.clip(pos // BS, 0, MB - 1)]  # (B,)
+            off = jnp.clip(pos % BS, 0, BS - 1)
+            mask = (jnp.arange(SV)[None, :] <= pos[:, None])[:, None, :]
+            for i in range(L):
+                p_at = params[f"dec{i}_attn"]
+                h = ln(params[f"dec{i}_ln0"], x)
+                q = h @ p_at["wq"]
+                k = h @ p_at["wk"]
+                v = h @ p_at["wv"]
+                if has_bias:
+                    q, k, v = q + p_at["bq"], k + p_at["bk"], v + p_at["bv"]
+                q = q.reshape(B, H, D)
+                k = k.reshape(B, H, D)
+                v = v.reshape(B, H, D)
+                # scatter this position's k/v into each lane's block
+                ck = ck.at[i, blk, :, off, :].set(k)
+                cv = cv.at[i, blk, :, off, :].set(v)
+                # gather each lane's pages: (B, MB, H, BS, D) ->
+                # (B, H, SV, D) in logical position order
+                keys = ck[i][bt].transpose(0, 2, 1, 3, 4).reshape(B, H, SV, D)
+                vals = cv[i][bt].transpose(0, 2, 1, 3, 4).reshape(B, H, SV, D)
+                o = attend(q, keys, vals, mask)
+                o = o.reshape(B, H * D) @ p_at["wo"]
+                if has_bias:
+                    o = o + p_at["bo"]
+                x = x + o
+                h = ln(params[f"dec{i}_ln1"], x)
+                p0, p1 = params[f"dec{i}_ff0"], params[f"dec{i}_ff1"]
+                f = jax.nn.gelu(h @ p0["kernel"] + p0["bias"])
+                f = f @ p1["kernel"] + p1["bias"]
+                x = x + f
+            x = jax.lax.optimization_barrier(x)  # same boundary as dense
+            x = ln(params["final_ln"], x)
+            logits = x @ params["lm_head"]["kernel"]
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            nxt = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+            return nxt, probs, ck, cv
+
+        def prefill(params, ck, cv, toks, start, n_valid, bt):
+            # ONE slot's chunk: toks (P,), start/n_valid (), bt (MB,)
+            params = jax.tree.map(cast, params)
+            pos = start + jnp.arange(P)  # (P,)
+            valid = jnp.arange(P) < n_valid
+            x = params["tok_embed"]["kernel"][toks]  # (P, hidden)
+            x = x + params["pos_embed"]["value"][jnp.clip(pos, 0, S_pos - 1)]
+            # padded rows write to the trash block
+            blk = jnp.where(valid, bt[jnp.clip(pos // BS, 0, MB - 1)], 0)
+            off = jnp.where(valid, pos % BS, 0)
+            mask = (jnp.arange(SV)[None, :] <= pos[:, None])[:, None, :]
+            for i in range(L):
+                p_at = params[f"dec{i}_attn"]
+                h = ln(params[f"dec{i}_ln0"], x)
+                q = h @ p_at["wq"]
+                k = h @ p_at["wk"]
+                v = h @ p_at["wv"]
+                if has_bias:
+                    q, k, v = q + p_at["bq"], k + p_at["bk"], v + p_at["bv"]
+                q = q.reshape(P, H, D)
+                k = k.reshape(P, H, D)
+                v = v.reshape(P, H, D)
+                ck = ck.at[i, blk, :, off, :].set(k)
+                cv = cv.at[i, blk, :, off, :].set(v)
+                keys = ck[i][bt].transpose(1, 0, 2, 3).reshape(H, SV, D)
+                vals = cv[i][bt].transpose(1, 0, 2, 3).reshape(H, SV, D)
+                # q rows attend the slot's whole visible prefix:
+                # (P, H, SV) scores via the shared mul+reduce form
+                o = attend(q, keys[None], vals[None], mask)
+                o = o.reshape(P, H * D) @ p_at["wo"]
+                if has_bias:
+                    o = o + p_at["bo"]
+                x = x + o
+                h = ln(params[f"dec{i}_ln1"], x)
+                p0, p1 = params[f"dec{i}_ff0"], params[f"dec{i}_ff1"]
+                f = jax.nn.gelu(h @ p0["kernel"] + p0["bias"])
+                f = f @ p1["kernel"] + p1["bias"]
+                x = x + f
+            x = jax.lax.optimization_barrier(x)
+            # distribution after the chunk's LAST VALID row
+            x = ln(params["final_ln"], jnp.take(x, n_valid - 1, axis=0))
+            logits = x @ params["lm_head"]["kernel"]
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            nxt = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+            return nxt, probs, ck, cv
+
+        self._decode = jax.jit(decode, donate_argnums=(1, 2))
+        self._prefill = jax.jit(prefill, donate_argnums=(1, 2))
+
+        # warmup both programs once so the cache layout/sharding
+        # stabilizes (same rationale as GPTDecodeSession) and steady
+        # state replays compiled code only
+        z = jnp.zeros((B,), jnp.int32)
+        bt0 = jnp.zeros((B, MB), jnp.int32)
+        nt, _, ck, cv = self._decode(
+            model.executor.params, self.kv.cache_k, self.kv.cache_v,
+            z, z, bt0,
+        )
+        _, _, ck, cv = self._prefill(
+            model.executor.params, ck, cv,
+            jnp.zeros((P,), jnp.int32), jnp.asarray(0, jnp.int32),
+            jnp.asarray(1, jnp.int32), bt0[0],
+        )
+        # chain one more decode on the prefill's outputs so BOTH
+        # programs have seen the other's cache layout — steady state
+        # then replays compiled code regardless of phase interleaving
+        _, _, ck, cv = self._decode(
+            model.executor.params, ck, cv, z, z, bt0,
+        )
+        self._cache_sharding = (ck.sharding, cv.sharding)
+        # keep the CHAINED warmup buffers as the live pool: the warmup
+        # only ever wrote the trash block (all tables were zero), so
+        # every real block still holds zeros — and replacing them with
+        # fresh device_put arrays would introduce a second buffer
+        # layout, recompiling both donated programs once per layout
+        self.kv.cache_k, self.kv.cache_v = ck, cv
+
+        # --- loop state ---------------------------------------------------
+        self.windows = 0
+        self.decode_steps = 0
+        self.prefill_chunks = 0
+        self._occ_sum = 0.0
+        self._t0: Optional[float] = None
+
+    # --- submission --------------------------------------------------------
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        *,
+        req_id: int = -1,
+        eos_id: Optional[int] = None,
+        arrival_s: float = 0.0,
+    ) -> Request:
+        req = Request(
+            prompt=prompt, max_new_tokens=max_new_tokens, id=req_id,
+            eos_id=eos_id if eos_id is not None else self.eos_id,
+            arrival_s=arrival_s,
+        )
+        # a budget past the compiled position range / pool size comes
+        # back REJECTED with a reason (graceful, never a crash)
+        return self.sched.submit(req, now=self._now())
+
+    def _now(self) -> float:
+        return time.perf_counter()
+
+    # --- the serve loop ----------------------------------------------------
+    def run(self, requests: Optional[Sequence[Request]] = None) -> ServeReport:
+        """Serve ``requests`` (plus anything already submitted) until
+        the queue drains.  Requests carry open-loop ``arrival_s``
+        offsets relative to run start; the loop submits each when its
+        arrival time passes (and never waits on completions to do so —
+        open loop)."""
+        ex = self.model.executor
+        pending = sorted(requests or (), key=lambda r: (r.arrival_s, r.id))
+        t0 = self._t0 = self._now()
+        syncs0 = ex.host_syncs
+        # the engine is reusable across runs; counters and the report
+        # are per-run (the compiled programs and the pool persist)
+        self.windows = self.decode_steps = self.prefill_chunks = 0
+        self._occ_sum = 0.0
+        fin0 = len(self.sched.finished)
+        rej0 = len(self.sched.rejected)
+        # requests queued via submit() before run() count as arriving
+        # at run start for TTFT purposes
+        for r in self.sched.queue:
+            if r.arrival_abs_s is None:
+                r.arrival_abs_s = t0
+        n_sub = 0
+        while True:
+            now = self._now() - t0
+            while n_sub < len(pending) and pending[n_sub].arrival_s <= now:
+                r = pending[n_sub]
+                self.sched.submit(r, now=now)
+                r.arrival_abs_s = t0 + r.arrival_s
+                n_sub += 1
+            self.sched.admit(now=now)
+            if self.sched.idle:
+                if n_sub >= len(pending):
+                    break
+                # open loop: idle until the next arrival is due
+                dt_next = pending[n_sub].arrival_s - (self._now() - t0)
+                if dt_next > 0:
+                    time.sleep(min(dt_next, 0.05))
+                continue
+            self._window()
+        wall = self._now() - t0
+        return self._report(
+            wall, ex.host_syncs - syncs0,
+            self.sched.finished[fin0:], len(self.sched.rejected) - rej0,
+        )
+
+    # --- one flush window ---------------------------------------------------
+    def _window(self) -> None:
+        jnp = self._jnp
+        ex = self.model.executor
+        tracer = get_tracer()
+        t_win = self._now()
+        B, MB = self.slots, self.kv.max_blocks_per_seq
+        fin_before = len(self.sched.finished)
+
+        # 1) prefill: ONE chunk per mid-prefill slot, chunk arrays staged
+        #    H2D ahead of compute through the shared DevicePrefetcher
+        prefill_done: List[Any] = []  # (req, next0_device, probs_device)
+        chunks = []
+        for slot in self.sched.prefill_slots():
+            req = self.sched.active[slot]
+            lo = req.prefill_pos
+            hi = min(lo + self.prefill_chunk, req.prompt_len)
+            toks = np.zeros((self.prefill_chunk,), np.int32)
+            toks[: hi - lo] = req.prompt[lo:hi]
+            chunks.append((req, toks, lo, hi - lo, self.kv.table_row(slot)))
+
+        def place(c):
+            req, toks, lo, n, row = c
+            return (
+                req,
+                self._jax.device_put(jnp.asarray(toks)),
+                jnp.asarray(lo, jnp.int32),
+                jnp.asarray(n, jnp.int32),
+                self._jax.device_put(jnp.asarray(row)),
+            )
+
+        for req, toks_d, lo_d, n_d, row_d in DevicePrefetcher(
+            chunks, place, depth=self.prefetch_depth
+        ):
+            nxt, probs, ck, cv = self._prefill(
+                ex.params, self.kv.cache_k, self.kv.cache_v,
+                toks_d, lo_d, n_d, row_d,
+            )
+            self.kv.cache_k, self.kv.cache_v = ck, cv
+            self.prefill_chunks += 1
+            req.prefill_pos = min(
+                req.prefill_pos + self.prefill_chunk, req.prompt_len
+            )
+            if req.prefill_pos >= req.prompt_len:
+                prefill_done.append((req, nxt, probs))
+
+        # 2) decode: chain device tokens for an adaptive window
+        dec_slots = self.sched.decode_slots()
+        buffered: List[Any] = []  # per-step (B,) next-token device arrays
+        probs_last = None
+        steps = 0
+        if dec_slots:
+            remaining = [
+                self.sched.active[s].max_new_tokens
+                - self.sched.active[s].done_tokens
+                for s in dec_slots
+            ]
+            steps = max(1, min(self.sync_every, min(remaining)))
+            cur = np.zeros((B,), np.int32)
+            pos = np.zeros((B,), np.int32)
+            bt = np.zeros((B, MB), np.int32)
+            for s in dec_slots:
+                r = self.sched.active[s]
+                cur[s] = r.tokens[-1]
+                pos[s] = r.prompt_len + r.done_tokens - 1
+                bt[s] = self.kv.tables[s]
+            bt_d = self._jax.device_put(jnp.asarray(bt))
+            cur_d = self._jax.device_put(jnp.asarray(cur))
+            for _ in range(steps):
+                nxt, probs_last, ck, cv = self._decode(
+                    ex.params, self.kv.cache_k, self.kv.cache_v,
+                    cur_d, jnp.asarray(pos), bt_d,
+                )
+                self.kv.cache_k, self.kv.cache_v = ck, cv
+                buffered.append(nxt)
+                cur_d = nxt  # device-to-device chain: NO host fetch
+                for s in dec_slots:
+                    pos[s] += 1
+            self.decode_steps += steps
+
+        # 3) flush: the window's ONE deliberate host sync
+        t_sync = self._now()
+        host_tok = [np.asarray(b) for b in buffered]
+        host_pre = [
+            (req, int(np.asarray(nxt)), np.asarray(probs))
+            for req, nxt, probs in prefill_done
+        ]
+        stall = self._now() - t_sync
+        ex.count_host_sync(1, stall)
+        flushed_tokens = 0
+
+        # decode lanes: assign buffered tokens in step order
+        for k in range(len(host_tok)):
+            for s in dec_slots:
+                req = self.sched.active.get(s)
+                if req is None or req.state is not RequestState.DECODE:
+                    continue  # finished earlier in this flush (EOS)
+                if self.temperature > 0.0 and probs_last is not None:
+                    # sampling mode runs 1-step windows; draw on host
+                    from flexflow_tpu.models.transformer import sample_next
+
+                    tok = int(sample_next(
+                        np.asarray(probs_last)[s][None],
+                        self.temperature, self._rng,
+                    )[0])
+                else:
+                    tok = int(host_tok[k][s])
+                req.tokens.append(tok)
+                flushed_tokens += 1
+                self._finish_if_done(req, tok)
+
+        # prefill completions: first generated token becomes visible now
+        for req, tok, probs in host_pre:
+            if self.temperature > 0.0:
+                from flexflow_tpu.models.transformer import sample_next
+
+                tok = int(sample_next(
+                    probs[None], self.temperature, self._rng,
+                )[0])
+            req.state = RequestState.DECODE
+            req.tokens.append(int(tok))
+            flushed_tokens += 1
+            req.t_first_token = self._now()
+            self._finish_if_done(req, int(tok))
+
+        self.windows += 1
+        self._occ_sum += self.sched.occupancy
+        win_wall = self._now() - t_win
+        if tracer.enabled:
+            tracer.counter("serve.windows", 1.0)
+            if steps:
+                tracer.counter("serve.decode_steps", float(steps))
+        if self.metrics.enabled:
+            fin = [
+                {
+                    "id": r.id, "tokens": r.done_tokens,
+                    "reason": r.finish_reason, **r.latency_ms(),
+                }
+                for r in self.sched.finished[fin_before:]
+            ]
+            self.metrics.append(step_record(
+                step=self.windows - 1,
+                t=time.time(),
+                step_wall_s=win_wall,
+                host_stall_s=stall,
+                tokens=flushed_tokens,
+                samples=len(dec_slots),
+                metrics={"serve": {
+                    "queue_depth": self.sched.queue_depth,
+                    "occupancy": self.sched.occupancy,
+                    "decode_steps": steps,
+                    "prefill_chunks": len(chunks),
+                    "active": len(self.sched.active),
+                    "finished": fin,
+                    "rejected_total": len(self.sched.rejected),
+                }},
+            ))
+
+    def _finish_if_done(self, req: Request, tok: int) -> None:
+        if req.eos_id is not None and tok == req.eos_id:
+            self.sched.finish(req, self._now(), "eos")
+        elif req.done_tokens >= req.max_new_tokens:
+            self.sched.finish(req, self._now(), "length")
+
+    # --- report -------------------------------------------------------------
+    def _report(
+        self, wall: float, host_syncs: int, fin=None, rejected=None,
+    ) -> ServeReport:
+        fin = self.sched.finished if fin is None else fin
+        lat = [r.latency_ms() for r in fin]
+        ttft = [d["ttft_ms"] for d in lat]
+        tpot = [d["tpot_ms"] for d in lat]
+        new_tokens = sum(r.done_tokens for r in fin)
+        rep = ServeReport(
+            wall_s=wall,
+            new_tokens=new_tokens,
+            tok_s=new_tokens / wall if wall > 0 else 0.0,
+            requests_finished=len(fin),
+            requests_rejected=(
+                len(self.sched.rejected) if rejected is None else rejected
+            ),
+            ttft_p50_ms=_pct(ttft, 50),
+            ttft_p99_ms=_pct(ttft, 99),
+            tpot_p50_ms=_pct(tpot, 50),
+            tpot_p99_ms=_pct(tpot, 99),
+            occupancy_mean=(
+                self._occ_sum / self.windows if self.windows else 0.0
+            ),
+            windows=self.windows,
+            decode_steps=self.decode_steps,
+            prefill_chunks=self.prefill_chunks,
+            host_syncs=host_syncs,
+            per_request=[
+                {
+                    "id": r.id, "prompt_len": r.prompt_len,
+                    "tokens": list(r.tokens), "reason": r.finish_reason,
+                    **r.latency_ms(),
+                }
+                for r in fin
+            ],
+        )
+        self.metrics.close()
+        return rep

@@ -1,0 +1,219 @@
+"""Paged/block KV-cache allocator (docs/SERVING.md).
+
+The dense decode session reserves a monolithic ``(L, B, H, S_max, D)``
+cache — every slot pays max-S HBM whether its conversation is 8 tokens
+or 8000.  This module carves the same capacity into fixed-size blocks
+(``block_size`` positions each, all layers and heads of one slot's
+position range together) with a free list and per-request block tables:
+physically the cache is ``(L, num_blocks, H, block_size, D)``, and a
+request's logical position ``p`` lives in physical block
+``table[p // block_size]`` at offset ``p % block_size``.  Short and
+long requests then share HBM — the pool only needs to cover the sum of
+*actual* reserved lengths, not slots x max-S (the admission test in
+tests/test_serve.py pins a workload whose summed max-lengths exceed the
+monolithic footprint).
+
+Allocation policy: blocks for a request's full declared budget
+(``prompt_len + max_new_tokens``) are reserved at admission, so
+mid-flight exhaustion cannot happen — a request that fits is never
+killed for blocks.  The trade-off (vs vLLM-style lazy growth +
+preemption) is documented in docs/SERVING.md; reservation keeps the
+zero-sync decode windows free of allocation faults.  Exhaustion
+surfaces in exactly two graceful forms: :meth:`PagedKVCache.can_reserve`
+= False (scheduler keeps the request queued, FIFO) and
+:exc:`KVCacheOOM` on a reserve that was not pre-checked.
+
+Physical block 0 is the TRASH block: never allocated, it absorbs the
+writes of inactive decode lanes and padded prefill rows (their block
+tables are all-zero), so the jitted step needs no masking scatter.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PagedKVCache", "KVCacheOOM"]
+
+
+class KVCacheOOM(RuntimeError):
+    """Raised when a reservation asks for more blocks than the free list
+    holds.  The scheduler pre-checks :meth:`PagedKVCache.can_reserve`,
+    so under the FIFO admission policy this surfaces only on misuse —
+    it exists so exhaustion is an explicit, catchable condition, never
+    a corrupted table."""
+
+
+class PagedKVCache:
+    """Free-list block allocator + the device-side paged K/V arrays.
+
+    Host side: the free list, per-slot block tables, and the invariant
+    checks (a block is owned by at most one slot, double-free rejected).
+    Device side: ``cache_k``/``cache_v`` of shape
+    ``(L, num_blocks, H, block_size, D)``, written/read by the serving
+    programs in :mod:`flexflow_tpu.serve.engine` through gather/scatter
+    indices derived from the block tables.
+    """
+
+    def __init__(
+        self,
+        num_layers: int,
+        heads: int,
+        head_dim: int,
+        *,
+        slots: int,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        max_blocks_per_seq: Optional[int] = None,
+        max_seq_len: Optional[int] = None,
+        dtype=None,
+    ) -> None:
+        import jax.numpy as jnp
+
+        assert block_size >= 1 and slots >= 1
+        self.num_layers = num_layers
+        self.heads = heads
+        self.head_dim = head_dim
+        self.slots = slots
+        self.block_size = block_size
+        if max_blocks_per_seq is None:
+            assert max_seq_len is not None, (
+                "need max_blocks_per_seq or max_seq_len"
+            )
+            max_blocks_per_seq = -(-max_seq_len // block_size)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        # positions a request may occupy: the table's reach, tightened
+        # to the model's compiled position range when given (a block
+        # boundary may overshoot it) — admission rejects past this
+        self.position_limit = self.max_blocks_per_seq * block_size
+        if max_seq_len is not None:
+            self.position_limit = min(self.position_limit, int(max_seq_len))
+        if num_blocks is None:
+            # default: full provisioning (every slot can hold max length)
+            # + the trash block; tests/benches pass a smaller pool to
+            # exercise HBM sharing
+            num_blocks = slots * self.max_blocks_per_seq + 1
+        assert num_blocks >= 2, "need at least the trash block + one real"
+        self.num_blocks = int(num_blocks)
+        self.dtype = dtype if dtype is not None else jnp.float32
+
+        # block 0 is the trash block — never enters the free list
+        self._free: deque = deque(range(1, self.num_blocks))
+        self._owned: Dict[int, List[int]] = {}  # slot -> blocks, in order
+        # per-slot block tables; row = logical block idx -> physical id
+        self.tables = np.zeros(
+            (slots, self.max_blocks_per_seq), np.int32
+        )
+        shape = (
+            num_layers, self.num_blocks, heads, block_size, head_dim,
+        )
+        self.cache_k = jnp.zeros(shape, self.dtype)
+        self.cache_v = jnp.zeros(shape, self.dtype)
+
+    # --- capacity queries --------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def allocatable_blocks(self) -> int:
+        """Total blocks a single request could ever hold (pool minus
+        trash) — the *permanent* rejection bound."""
+        return self.num_blocks - 1
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.position_limit
+
+    def blocks_for(self, seq_len: int) -> int:
+        return -(-int(seq_len) // self.block_size)
+
+    def can_reserve(self, seq_len: int) -> bool:
+        return self.blocks_for(seq_len) <= len(self._free)
+
+    def fits_ever(self, seq_len: int) -> bool:
+        """Could this length be served by an EMPTY pool?  False means
+        the request must be rejected outright (graceful, not queued)."""
+        n = self.blocks_for(seq_len)
+        return n <= self.allocatable_blocks and seq_len <= self.max_seq_len
+
+    # --- reserve / release -------------------------------------------------
+    def reserve(self, slot: int, seq_len: int) -> List[int]:
+        """Take ``blocks_for(seq_len)`` blocks off the free list and map
+        them into ``slot``'s table.  Raises :exc:`KVCacheOOM` when the
+        free list is short (callers pre-check :meth:`can_reserve`)."""
+        assert 0 <= slot < self.slots
+        assert slot not in self._owned, f"slot {slot} already reserved"
+        n = self.blocks_for(seq_len)
+        assert n <= self.max_blocks_per_seq, (
+            f"seq_len {seq_len} exceeds max_blocks_per_seq "
+            f"{self.max_blocks_per_seq} x block_size {self.block_size}"
+        )
+        if n > len(self._free):
+            raise KVCacheOOM(
+                f"need {n} KV blocks for seq_len {seq_len}, "
+                f"{len(self._free)} free "
+                f"(pool {self.allocatable_blocks}, block {self.block_size})"
+            )
+        blocks = [self._free.popleft() for _ in range(n)]
+        assert 0 not in blocks, "trash block leaked into the free list"
+        self._owned[slot] = blocks
+        self.tables[slot, :] = 0
+        self.tables[slot, :n] = blocks
+        return blocks
+
+    def release(self, slot: int) -> None:
+        """Return ``slot``'s blocks to the free list (mid-flight slot
+        recycling — the freed blocks are immediately reservable by a
+        queued request, no recompile)."""
+        blocks = self._owned.pop(slot, None)
+        assert blocks is not None, f"slot {slot} holds no reservation"
+        free_set = set(self._free)
+        for b in blocks:
+            assert b not in free_set, f"double-free of block {b}"
+            self._free.append(b)
+        self.tables[slot, :] = 0
+
+    def owned(self, slot: int) -> Tuple[int, ...]:
+        return tuple(self._owned.get(slot, ()))
+
+    def check_invariants(self) -> None:
+        """Every block is either free or owned by exactly one slot, and
+        the trash block is neither."""
+        free = list(self._free)
+        owned = [b for bs in self._owned.values() for b in bs]
+        assert 0 not in free and 0 not in owned, "trash block allocated"
+        all_ = free + owned
+        assert len(all_) == len(set(all_)), "block owned twice"
+        assert sorted(all_) == list(range(1, self.num_blocks)), (
+            "blocks leaked or invented"
+        )
+
+    # --- device-side views -------------------------------------------------
+    def table_row(self, slot: int):
+        """One slot's (max_blocks_per_seq,) block table, for prefill."""
+        return self.tables[slot].copy()
+
+    def gather_dense(self, slot: int, seq_len: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Host-side re-assembly of ``slot``'s first ``seq_len`` cached
+        positions into dense ``(L, H, seq_len, D)`` arrays — the
+        bit-parity bridge the tests use to compare paged contents
+        against the dense session's cache."""
+        ck = np.asarray(self.cache_k)
+        cv = np.asarray(self.cache_v)
+        row = self.tables[slot]
+        L, H, BS, D = (
+            self.num_layers, self.heads, self.block_size, self.head_dim,
+        )
+        n = self.blocks_for(seq_len)
+        k = ck[:, row[:n]]  # (L, n, H, BS, D)
+        v = cv[:, row[:n]]
+        k = k.transpose(0, 2, 1, 3, 4).reshape(L, H, n * BS, D)[:, :, :seq_len]
+        v = v.transpose(0, 2, 1, 3, 4).reshape(L, H, n * BS, D)[:, :, :seq_len]
+        return k, v
+
+    def hbm_bytes(self) -> int:
+        """Physical pool footprint (both caches)."""
+        return 2 * self.cache_k.size * self.cache_k.dtype.itemsize

@@ -1,0 +1,95 @@
+"""Serving objective for the Unity search (docs/SERVING.md).
+
+Training search minimizes step time; serving wants **steady-state decode
+tokens/s subject to a p99 per-token latency SLO**.  This module turns
+that into a scalar the existing mesh/placement search can argmin:
+
+* ``step_s`` — the analytic one-token decode step time under a strategy
+  (:func:`flexflow_tpu.search.cost.estimate_decode_step_time`:
+  weight-streaming roofline + per-slot KV reads + TP partial-sum
+  allreduces priced on the machine model, multi-slice DCN included);
+* ``tok_s = slots / step_s`` — every decode step emits one token per
+  occupied slot;
+* ``p99_ms = step_s * sync_every * 1e3`` — the engine's flush-window
+  discipline (engine.py) makes a token observable at its window flush,
+  so the worst-case per-token latency is a full window; that IS the p99
+  under saturation (queueing beyond the window is an admission-control
+  problem, not a step-time one);
+* ``cost`` — ``1 / tok_s`` when the SLO holds, smoothly penalized
+  (x(1 + 9·excess)) when it doesn't, so infeasible placements still
+  order and the search degrades gracefully when NO mesh meets the SLO
+  instead of failing.
+
+PALM-style simulation (PAPERS.md) is the template: price the serving
+loop's shape analytically so placement search needs no hardware in the
+loop; the measured tier can later calibrate the same numbers from
+``ffmetrics/1`` serve records.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from flexflow_tpu.search.cost import TPUMachineModel, estimate_decode_step_time
+from flexflow_tpu.tensor import Layer
+
+__all__ = ["ServeSpec", "ServeObjective"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeSpec:
+    """The serving shape a placement is priced for."""
+
+    slots: int = 8  # concurrent decode lanes
+    kv_len: int = 512  # steady-state prefix depth for the KV-read term
+    slo_p99_ms: float = 50.0  # p99 per-token latency bound
+    sync_every: int = 4  # engine flush cadence (observable-latency window)
+
+
+class ServeObjective:
+    """Prices (layers, strategy) pairs for serving; see module docstring.
+
+    ``train_tokens`` is batch x seq of the graph the layers were built
+    with — the divisor that converts the graph's training-shaped
+    activation byte counts into per-decode-token bytes.
+    """
+
+    def __init__(
+        self,
+        machine: Optional[TPUMachineModel],
+        spec: ServeSpec,
+        train_tokens: int,
+    ) -> None:
+        self.machine = machine
+        self.spec = spec
+        self.train_tokens = max(1, int(train_tokens))
+
+    def price(self, layers: List[Layer], strategy) -> Dict[str, Any]:
+        d = estimate_decode_step_time(
+            layers, strategy, self.machine,
+            slots=self.spec.slots, kv_len=self.spec.kv_len,
+            train_tokens=self.train_tokens,
+        )
+        step_s = max(d["step_s"], 1e-12)
+        tok_s = self.spec.slots / step_s
+        p99_ms = step_s * self.spec.sync_every * 1e3
+        feasible = p99_ms <= self.spec.slo_p99_ms
+        cost = 1.0 / tok_s
+        if not feasible:
+            cost *= 1.0 + 9.0 * (p99_ms / self.spec.slo_p99_ms - 1.0)
+        return {
+            "objective": "serve",
+            "cost": cost,
+            "tok_s": tok_s,
+            "p99_ms": p99_ms,
+            "feasible": feasible,
+            "slo_p99_ms": self.spec.slo_p99_ms,
+            "slots": self.spec.slots,
+            "kv_len": self.spec.kv_len,
+            "sync_every": self.spec.sync_every,
+            "step_s": d["step_s"],
+            "breakdown": {
+                k: d[k] for k in ("mem_s", "flops_s", "coll_s")
+            },
+        }

@@ -1,0 +1,207 @@
+"""Continuous-batching scheduler (docs/SERVING.md).
+
+The reference's inference story is a Legion backend serving one model
+instance per request stream; here the unit of batching is the SLOT — a
+lane of the fixed-slot compiled decode step.  Requests of any length
+are admitted FIFO into free slots, each with a KV-block reservation for
+its full declared budget; a sequence that finishes (EOS or token
+budget) releases its slot and blocks *mid-flight*, and the next queued
+request takes them without recompiling anything — the compiled step's
+shapes never change, only the block tables and position vectors fed
+through it.
+
+Admission policy (pinned by tests/test_serve.py):
+
+* **strict FIFO** — the queue head blocks admission until both a slot
+  and its KV reservation are available (no reordering, no starvation of
+  long requests behind short ones);
+* **graceful rejection** — a request whose budget could never fit the
+  pool (``prompt + max_new_tokens`` over the per-sequence table limit,
+  or more blocks than the whole pool owns) is rejected at submit with a
+  reason, not crashed on later;
+* **reservation at admission** — blocks for the full budget are taken
+  up front (see kvcache.py), so decode windows never fault on
+  allocation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from flexflow_tpu.serve.kvcache import PagedKVCache
+
+__all__ = ["Request", "RequestState", "ContinuousBatchingScheduler"]
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    FINISHED = "finished"
+    REJECTED = "rejected"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request: a prompt, a token budget, an optional
+    EOS, and the latency bookkeeping the metrics stream reports."""
+
+    prompt: np.ndarray  # (prompt_len,) int32
+    max_new_tokens: int
+    id: int = -1
+    eos_id: Optional[int] = None
+    arrival_s: float = 0.0  # open-loop arrival offset (traffic.py)
+
+    # --- filled in by the scheduler/engine ---
+    state: RequestState = RequestState.QUEUED
+    slot: int = -1
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    prefill_pos: int = 0  # prompt tokens ingested so far
+    finish_reason: Optional[str] = None  # "eos" | "length" | "rejected:*"
+    t_submit: Optional[float] = None
+    arrival_abs_s: Optional[float] = None  # engine clock: t0 + arrival_s
+    t_admitted: Optional[float] = None
+    t_first_token: Optional[float] = None  # TTFT clock stop
+    t_done: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        assert len(self.prompt) >= 1, "empty prompt"
+        assert self.max_new_tokens >= 1
+
+    @property
+    def prompt_len(self) -> int:
+        return int(len(self.prompt))
+
+    @property
+    def max_len(self) -> int:
+        """Positions this request may ever occupy (= KV reservation)."""
+        return self.prompt_len + self.max_new_tokens
+
+    @property
+    def done_tokens(self) -> int:
+        return len(self.tokens)
+
+    def latency_ms(self) -> Dict[str, Optional[float]]:
+        ttft = (
+            (self.t_first_token - self.arrival_abs_s) * 1e3
+            if self.t_first_token is not None
+            and self.arrival_abs_s is not None
+            else None
+        )
+        tpot = None
+        if (
+            self.t_done is not None
+            and self.t_first_token is not None
+            and len(self.tokens) > 1
+        ):
+            tpot = (
+                (self.t_done - self.t_first_token)
+                / (len(self.tokens) - 1) * 1e3
+            )
+        return {"ttft_ms": ttft, "tpot_ms": tpot}
+
+
+class ContinuousBatchingScheduler:
+    """FIFO admission of :class:`Request`s into ``slots`` decode lanes
+    backed by a :class:`PagedKVCache` (see module docstring)."""
+
+    def __init__(self, slots: int, kvcache: PagedKVCache) -> None:
+        assert kvcache.slots == slots, (kvcache.slots, slots)
+        self.slots = slots
+        self.kv = kvcache
+        self.queue: deque = deque()
+        self.free_slots: deque = deque(range(slots))
+        self.active: Dict[int, Request] = {}  # slot -> request
+        self.finished: List[Request] = []
+        self.rejected: List[Request] = []
+        self._next_id = 0
+
+    # --- submission --------------------------------------------------------
+    def submit(self, req: Request, now: float = 0.0) -> Request:
+        """Queue a request, or reject it outright when its budget could
+        never be served by this cache (graceful — the request comes back
+        marked REJECTED, nothing raises)."""
+        if req.id < 0:
+            req.id = self._next_id
+        self._next_id = max(self._next_id, req.id) + 1
+        req.t_submit = now
+        if not self.kv.fits_ever(req.max_len):
+            req.state = RequestState.REJECTED
+            req.finish_reason = (
+                f"rejected: max_len {req.max_len} needs "
+                f"{self.kv.blocks_for(req.max_len)} blocks, pool holds "
+                f"{self.kv.allocatable_blocks} "
+                f"(table limit {self.kv.max_seq_len} positions)"
+            )
+            self.rejected.append(req)
+            return req
+        req.state = RequestState.QUEUED
+        self.queue.append(req)
+        return req
+
+    # --- admission ---------------------------------------------------------
+    def admit(self, now: float = 0.0) -> List[Request]:
+        """Admit queue-head requests into free slots while both a slot
+        and the full KV reservation are available (strict FIFO: a head
+        that doesn't fit YET blocks everything behind it until running
+        requests release blocks)."""
+        out: List[Request] = []
+        while self.queue and self.free_slots:
+            req = self.queue[0]
+            if not self.kv.can_reserve(req.max_len):
+                break
+            self.queue.popleft()
+            slot = self.free_slots.popleft()
+            self.kv.reserve(slot, req.max_len)
+            req.slot = slot
+            req.state = RequestState.PREFILL
+            req.prefill_pos = 0
+            req.t_admitted = now
+            self.active[slot] = req
+            out.append(req)
+        return out
+
+    def finish(self, req: Request, now: float, reason: str) -> None:
+        """Mid-flight slot recycling: release the slot + blocks; the
+        very next :meth:`admit` can hand them to a queued request —
+        the compiled step is untouched."""
+        assert self.active.get(req.slot) is req, (req.id, req.slot)
+        del self.active[req.slot]
+        self.kv.release(req.slot)
+        self.free_slots.append(req.slot)
+        req.state = RequestState.FINISHED
+        req.finish_reason = reason
+        req.t_done = now
+        req.slot = -1
+        self.finished.append(req)
+
+    # --- introspection -----------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    @property
+    def occupancy(self) -> float:
+        return len(self.active) / float(self.slots)
+
+    def decode_slots(self) -> List[int]:
+        return sorted(
+            s for s, r in self.active.items()
+            if r.state is RequestState.DECODE
+        )
+
+    def prefill_slots(self) -> List[int]:
+        return sorted(
+            s for s, r in self.active.items()
+            if r.state is RequestState.PREFILL
+        )
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and not self.active

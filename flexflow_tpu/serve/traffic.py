@@ -1,0 +1,64 @@
+"""Synthetic open-loop traffic generator (docs/SERVING.md).
+
+Open loop means arrivals are INDEPENDENT of completions — the generator
+draws exponential inter-arrival gaps at ``rate_rps`` and never waits for
+the server, so queue depth under overload is a real signal instead of
+being hidden by closed-loop back-pressure (the standard serving-bench
+pitfall).  Prompt and generation lengths draw uniformly from declared
+ranges; everything is seeded, so a (seed, shape) pair identifies a
+workload exactly — ``bench.py`` records that identity
+(``serve_traffic``) and ``tools/bench_compare.py`` treats it as
+comparable metadata, the same pattern as ``stack_blocks``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+import numpy as np
+
+from flexflow_tpu.serve.scheduler import Request
+
+__all__ = ["TrafficSpec", "synthetic_requests"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficSpec:
+    """Identity of one synthetic workload.  ``rate_rps <= 0`` means all
+    requests arrive at t=0 (the batch-saturation A/B shape)."""
+
+    n_requests: int = 16
+    seed: int = 0
+    rate_rps: float = 0.0
+    prompt_len: Tuple[int, int] = (4, 12)  # inclusive range
+    max_new: Tuple[int, int] = (4, 24)  # inclusive range
+    vocab: int = 256
+
+    @property
+    def identity(self) -> str:
+        """The bench-record metadata string (seed + shape)."""
+        return (
+            f"seed{self.seed}/n{self.n_requests}"
+            f"/p{self.prompt_len[0]}-{self.prompt_len[1]}"
+            f"/g{self.max_new[0]}-{self.max_new[1]}"
+            f"/r{self.rate_rps:g}/v{self.vocab}"
+        )
+
+
+def synthetic_requests(spec: TrafficSpec) -> List[Request]:
+    """Deterministic workload for ``spec`` (same spec -> same token
+    streams and arrival times, any process)."""
+    rng = np.random.default_rng(spec.seed)
+    out: List[Request] = []
+    t = 0.0
+    for i in range(spec.n_requests):
+        if spec.rate_rps > 0:
+            t += float(rng.exponential(1.0 / spec.rate_rps))
+        plen = int(rng.integers(spec.prompt_len[0], spec.prompt_len[1] + 1))
+        gen = int(rng.integers(spec.max_new[0], spec.max_new[1] + 1))
+        prompt = rng.integers(0, spec.vocab, size=(plen,)).astype(np.int32)
+        out.append(Request(
+            prompt=prompt, max_new_tokens=gen, id=i, arrival_s=t,
+        ))
+    return out

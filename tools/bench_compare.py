@@ -59,16 +59,25 @@ DEFAULT_THRESHOLD = 0.15
 
 # record keys that may legitimately differ between comparable runs —
 # noted in the output, but never a reason to refuse the comparison
-# (contrast: a machine_model mismatch is a different experiment)
-COMPARABLE_METADATA = ("metrics_sync_every", "stack_blocks")
+# (contrast: a machine_model mismatch is a different experiment).
+# serve_traffic (the traffic generator's seed/shape identity, new in
+# r08) rides the same rule: a different synthetic workload shifts the
+# serving numbers for benign reasons, so the gate prints the change
+# and still compares.
+COMPARABLE_METADATA = ("metrics_sync_every", "stack_blocks", "serve_traffic")
 
 # (label, path into the record, higher_is_better) — the gated metrics.
 # jit_compile_s gates LOWER-is-better: a compile-time regression fails
 # like a throughput regression (the scan-stacked block work of r07 made
-# compile a first-class budget — see docs/PERF.md).
+# compile a first-class budget — see docs/PERF.md).  The serving pair
+# (r08, docs/SERVING.md): serve_tok_s higher-is-better, serve_p99_ms
+# LOWER-is-better — a latency regression fails even when aggregate
+# throughput held.
 GATED = (
     ("throughput", ("value",), True),
     ("compile", ("jit_compile_s",), False),
+    ("serve_tok_s", ("serve_tok_s",), True),
+    ("serve_p99_ms", ("serve_p99_ms",), False),
     ("dlrm", ("secondary", "dlrm", "samples_per_sec"), True),
     ("bert_large", ("secondary", "bert_large", "samples_per_sec"), True),
     ("gpt_decode_cached", ("secondary", "gpt_decode", "cached_tok_per_s"), True),

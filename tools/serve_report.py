@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Render a serve-run ``ffmetrics/1`` JSONL into latency/occupancy tables.
+
+Usage:
+    python tools/serve_report.py METRICS.jsonl [--windows N]
+
+Reads the ``--metrics-out`` stream a
+:class:`flexflow_tpu.serve.engine.ServeEngine` run writes (one record
+per flush window, the serve vocabulary nested under ``metrics.serve`` —
+docs/SERVING.md) and prints:
+
+  * per-request latency percentiles — TTFT and TPOT p50/p90/p99 over
+    every finished request in the stream;
+  * the run's aggregate: new tokens, tokens/s, windows, finish reasons;
+  * a per-window table (queue depth, batch occupancy, decode steps,
+    prefill chunks, tokens) — ``--windows`` caps the rows, newest last.
+
+Pure stdlib + the repo's metrics reader — runnable without jax
+(``read_metrics`` only parses JSONL).  The trace_report.py sibling for
+serving.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> str:
+    if not rows:
+        return "  (empty)"
+    widths = [
+        max(len(str(h)), *(len(str(r[i])) for r in rows))
+        for i, h in enumerate(headers)
+    ]
+
+    def fmt(vals):
+        return "  " + "  ".join(str(v).ljust(w) for v, w in zip(vals, widths))
+
+    sep = "  " + "  ".join("-" * w for w in widths)
+    return "\n".join([fmt(headers), sep] + [fmt(r) for r in rows])
+
+
+def _pct(vals: List[float], q: float) -> float:
+    if not vals:
+        return float("nan")
+    vals = sorted(vals)
+    idx = (len(vals) - 1) * q / 100.0
+    lo = int(idx)
+    hi = min(lo + 1, len(vals) - 1)
+    frac = idx - lo
+    return vals[lo] * (1 - frac) + vals[hi] * frac
+
+
+def render(records: List[Dict], max_windows: int = 30) -> str:
+    serve = [
+        (r, r["metrics"]["serve"])
+        for r in records
+        if isinstance(r.get("metrics"), dict) and "serve" in r["metrics"]
+    ]
+    if not serve:
+        return "serve_report: no serve records in this stream"
+
+    finished = [f for _, s in serve for f in s.get("finished", ())]
+    ttft = [f["ttft_ms"] for f in finished if f.get("ttft_ms") is not None]
+    tpot = [f["tpot_ms"] for f in finished if f.get("tpot_ms") is not None]
+    reasons: Dict[str, int] = {}
+    for f in finished:
+        reasons[str(f.get("reason"))] = reasons.get(str(f.get("reason")), 0) + 1
+
+    tokens = sum(
+        int(round((r.get("tokens_per_s") or 0.0) * (r.get("step_wall_s") or 0.0)))
+        for r, _ in serve
+    )
+    wall = sum(r.get("step_wall_s") or 0.0 for r, _ in serve)
+    occ = [s.get("occupancy", 0.0) for _, s in serve]
+    out = []
+    out.append(
+        f"serve run: {len(serve)} windows, {len(finished)} requests "
+        f"finished, {tokens} new tokens over {wall:.3f} s busy wall "
+        f"({tokens / wall:.1f} tok/s)" if wall > 0 else
+        f"serve run: {len(serve)} windows, {len(finished)} requests finished"
+    )
+    out.append(
+        "finish reasons: "
+        + (", ".join(f"{k}={v}" for k, v in sorted(reasons.items())) or "none")
+    )
+
+    rows = []
+    for label, vals in (("ttft_ms", ttft), ("tpot_ms", tpot)):
+        if vals:
+            rows.append([
+                label, len(vals),
+                f"{_pct(vals, 50):.3f}", f"{_pct(vals, 90):.3f}",
+                f"{_pct(vals, 99):.3f}", f"{max(vals):.3f}",
+            ])
+    out.append(
+        "latency percentiles (measured at window flush — the "
+        "observability point; docs/SERVING.md):\n"
+        + _table(["metric", "n", "p50", "p90", "p99", "max"], rows)
+    )
+    if occ:
+        out.append(
+            f"occupancy: mean {sum(occ) / len(occ):.3f}, "
+            f"min {min(occ):.3f}, max {max(occ):.3f}"
+        )
+
+    rows = []
+    for r, s in serve[-max_windows:]:
+        rows.append([
+            r.get("step", "?"),
+            s.get("queue_depth", "?"),
+            f"{s.get('occupancy', 0.0):.2f}",
+            s.get("decode_steps", 0),
+            s.get("prefill_chunks", 0),
+            int(round(
+                (r.get("tokens_per_s") or 0.0) * (r.get("step_wall_s") or 0.0)
+            )),
+            len(s.get("finished", ())),
+        ])
+    out.append(
+        f"per-window (last {min(len(serve), max_windows)}):\n"
+        + _table(
+            ["window", "queue", "occ", "decode", "prefill", "tokens", "done"],
+            rows,
+        )
+    )
+    return "\n\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("metrics", help="ffmetrics JSONL written by --metrics-out")
+    ap.add_argument("--windows", type=int, default=30,
+                    help="max per-window rows (newest kept)")
+    args = ap.parse_args(argv)
+    # read_metrics only parses JSONL (no jax import), but the package
+    # must be importable when this runs from a checkout without install
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from flexflow_tpu.obs.metrics import read_metrics
+
+    print(render(read_metrics(args.metrics), max_windows=args.windows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

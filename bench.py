@@ -672,11 +672,42 @@ def run_bench(backend: str) -> None:
     # against different topologies
     from flexflow_tpu.search.cost import TPUMachineModel
 
-    machine_id = (
-        TPUMachineModel.from_file(cfg.machine_model_file).source
+    machine = (
+        TPUMachineModel.from_file(cfg.machine_model_file)
         if cfg.machine_model_file
-        else TPUMachineModel.detect().source
+        else TPUMachineModel.detect()
     )
+    machine_id = machine.source
+    # cost-model accuracy vocabulary (docs/OBSERVABILITY.md "Calibration
+    # loop"): MAPE of the search's predicted step time vs the measured
+    # median — LOWER is better, gated by tools/bench_compare.py so a
+    # cost-model accuracy regression fails like a throughput one.
+    # FFTPU_BENCH_CALIBRATION points at a CalibrationStore to score the
+    # calibrated tier instead of the raw analytic one (cost_model_tier
+    # records which was scored — comparable metadata for the gate).
+    cost_model_tier = cfg.cost_model
+    cost_model_mape = None
+    try:
+        from flexflow_tpu.search.cost import estimate_strategy_cost
+
+        pred_s = estimate_strategy_cost(
+            model.layers, model.executor.strategy, machine
+        )
+        cal_path = os.environ.get("FFTPU_BENCH_CALIBRATION")
+        if cal_path:
+            from flexflow_tpu.search.calibration import CalibrationStore
+
+            pred_s = CalibrationStore.load(
+                cal_path, expect_identity=machine_id,
+                expect_backend=jax.default_backend(),
+                expect_dtype=dtype,
+            ).correct_step("fit", pred_s)
+            cost_model_tier = "calibrated"
+        obs_s = head["step_time_ms"] / 1e3
+        if obs_s > 0 and pred_s and pred_s > 0:
+            cost_model_mape = round(abs(obs_s - pred_s) / obs_s, 6)
+    except Exception:  # noqa: BLE001 — never sink the headline metric
+        pass
     record = {
         "metric": "bert_base_train_throughput",
         "value": round(samples_per_sec, 2),
@@ -705,6 +736,11 @@ def run_bench(backend: str) -> None:
         # scan-stacked repeated blocks (--stack-blocks, docs/PERF.md):
         # comparable metadata for the gate, like metrics_sync_every
         "stack_blocks": cfg.stack_blocks,
+        # cost-model accuracy (calibration loop): predicted-vs-measured
+        # MAPE of the headline step, gated LOWER-is-better; the tier that
+        # produced the prediction is comparable metadata
+        "cost_model_tier": cost_model_tier,
+        "cost_model_mape": cost_model_mape,
         "compile_stacked_ab": None,
         # shared observability vocabulary (docs/OBSERVABILITY.md): the
         # same field names a --metrics-out training stream carries, so

@@ -82,6 +82,12 @@ class FFConfig:
     health_spike_factor: float = 4.0  # loss > factor * EMA(loss) => spike
     health_ema_decay: float = 0.9
     health_warmup_steps: int = 5  # finite losses seeding the EMA baseline
+    # prediction-drift watchdog (docs/OBSERVABILITY.md "Calibration
+    # loop"): EMA of observed/predicted step-time ratio, fires ONCE per
+    # run when it leaves [1/factor, factor]; "dump" reuses the one-bundle
+    # flight-recorder machinery
+    drift: str = "off"  # off | warn | dump
+    drift_factor: float = 2.0  # ratio band half-width (multiplicative)
     # --- async training pipeline (docs/OBSERVABILITY.md "Sync points") ---
     # fetch device-accumulated step metrics to host every K steps
     # (plus at epoch end).  0 = auto: 1 when --health/--metrics-out/
@@ -107,6 +113,17 @@ class FFConfig:
     # distinct (op, local shape)
     use_measured_cost: bool = False
     cost_cache_file: Optional[str] = None
+    # cost-model tier (docs/OBSERVABILITY.md "Calibration loop"):
+    # "analytic" = the roofline machine model; "measured" = compile-and-
+    # time per-op (same as --measured-cost); "calibrated" = per-op-class
+    # + per-objective corrections from a CalibrationStore applied ON TOP
+    # of whichever base tier is active (calibrated + --measured-cost
+    # composes: corrections scale the measured leaf times)
+    cost_model: str = "analytic"  # analytic | measured | calibrated
+    # versioned calibration-store JSON (tools/calibration_report.py);
+    # load REFUSES a store fit for a different machine-model identity,
+    # backend, or compute dtype
+    calibration_store_file: Optional[str] = None
     # --- TPU-specific (replaces Legion -ll:gpu etc.) ---
     mesh_shape: Optional[Tuple[int, ...]] = None  # e.g. (2, 4)
     mesh_axis_names: Tuple[str, ...] = ("data", "model")
@@ -275,6 +292,14 @@ class FFConfig:
                 self.use_measured_cost = True
             elif a == "--cost-cache":
                 self.cost_cache_file = take()
+            elif a == "--cost-model":
+                self.cost_model = take()
+            elif a == "--calibration-store":
+                self.calibration_store_file = take()
+            elif a == "--drift":
+                self.drift = take()
+            elif a == "--drift-factor":
+                self.drift_factor = float(take())
             elif a == "--mesh-shape":
                 self.mesh_shape = tuple(int(x) for x in take().split("x"))
             elif a == "--dtype":

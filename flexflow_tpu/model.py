@@ -711,11 +711,38 @@ class FFModel:
         # at DCN bandwidth
         if jax.process_count() > 1 and not machine.dcn_axes:
             machine.dcn_axes = (cfg.dcn_axis,)
+        # cost-model tier (--cost-model analytic|measured|calibrated,
+        # docs/OBSERVABILITY.md "Calibration loop").  "calibrated"
+        # composes with the measured tier: corrections apply on top of
+        # whichever base is active.
+        assert cfg.cost_model in ("analytic", "measured", "calibrated"), (
+            f"unknown --cost-model {cfg.cost_model!r}"
+        )
         profiler = None
-        if cfg.use_measured_cost:
+        if cfg.use_measured_cost or cfg.cost_model == "measured":
             from flexflow_tpu.search.simulator import OpProfiler
 
             profiler = OpProfiler(cfg.cost_cache_file)
+        calibration = None
+        if cfg.cost_model == "calibrated":
+            from flexflow_tpu.search.calibration import CalibrationStore
+
+            if cfg.calibration_store_file:
+                # load REFUSES a store fit for a different machine-model
+                # identity / backend / dtype (CalibrationMismatch) — a
+                # wrong store must fail loudly, not silently mis-price
+                calibration = CalibrationStore.load(
+                    cfg.calibration_store_file,
+                    expect_identity=machine.source,
+                    expect_backend=jax.default_backend(),
+                    expect_dtype=cfg.compute_dtype,
+                )
+            else:
+                # empty store: the calibrated tier with identity
+                # corrections — prices byte-identically to the base tier
+                calibration = CalibrationStore(
+                    machine.source, jax.default_backend(), cfg.compute_dtype
+                )
 
         if strategy is None:
             if cfg.import_strategy_file:
@@ -789,10 +816,34 @@ class FFModel:
                     extra_xfers=extra_xfers,
                     objective=cfg.search_objective,
                     serve=serve_spec,
+                    calibration=calibration,
                 )
             else:
                 strategy = data_parallel_strategy(self.layers, mesh)
         self.strategy = strategy
+        # calibration loop: an instrumented run (--metrics-out / --health
+        # / --drift) pairs every step record with the strategy's priced
+        # cost.  Strategies the search priced already carry it; imported
+        # / data-parallel / hand-built ones are estimated here (pure host
+        # math) so the prediction corpus grows on EVERY observed run.
+        # The disabled path skips this entirely — zero-overhead guards
+        # stay byte-identical.
+        if (
+            getattr(strategy, "predicted_step_s", None) is None
+            and get_monitor().enabled
+        ):
+            try:
+                from flexflow_tpu.search.cost import estimate_strategy_cost
+
+                pred = estimate_strategy_cost(
+                    strategy.rewritten_layers or self.layers,
+                    strategy, machine,
+                )
+                if calibration is not None:
+                    pred = calibration.correct_step("fit", pred)
+                strategy.predicted_step_s = pred
+            except Exception:  # noqa: BLE001 — pricing must never block a run
+                pass
         if strategy.rewritten_layers is not None:
             # the search's joint (rewrite x placement) winner changed the
             # graph structure (reference Graph::graph_optimize returning

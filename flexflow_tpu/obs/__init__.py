@@ -11,7 +11,9 @@ detectors (``--health``), and the debug-bundle flight recorder.
 """
 
 from flexflow_tpu.obs.health import (
+    DRIFT_POLICIES,
     HEALTH_POLICIES,
+    DriftDetector,
     HealthError,
     HealthMonitor,
     SpikeDetector,
@@ -47,7 +49,9 @@ __all__ = [
     "HealthMonitor",
     "HealthError",
     "SpikeDetector",
+    "DriftDetector",
     "HEALTH_POLICIES",
+    "DRIFT_POLICIES",
     "get_monitor",
     "set_monitor",
     "configure_monitor",

@@ -47,6 +47,7 @@ from flexflow_tpu.obs.metrics import (
 from flexflow_tpu.obs.trace import get_tracer
 
 HEALTH_POLICIES = ("off", "warn", "dump", "raise")
+DRIFT_POLICIES = ("off", "warn", "dump")
 
 
 class HealthError(RuntimeError):
@@ -96,6 +97,58 @@ class SpikeDetector:
         return fired
 
 
+class DriftDetector:
+    """Prediction-drift watchdog for the calibration loop
+    (docs/OBSERVABILITY.md, "Calibration loop"): tracks an EMA of the
+    observed/predicted step-time ratio and fires ONCE per run when the
+    EMA leaves ``[1/factor, factor]`` after ``warmup`` observations.
+
+    Why EMA-then-once: a calibrated store is fit from past runs, so
+    drift means the corpus went stale (new chip, new XLA, new workload
+    shape) — the actionable event is "this run's predictions are
+    systematically off", not a per-step nag on a diverged ratio.  Like
+    :class:`SpikeDetector`, the math is isolated here so the test suite
+    pins it independently of the monitor plumbing."""
+
+    def __init__(
+        self, factor: float = 2.0, decay: float = 0.9, warmup: int = 3
+    ):
+        assert factor > 1.0 and 0.0 < decay < 1.0 and warmup >= 1
+        self.factor = factor
+        self.decay = decay
+        self.warmup = warmup
+        self.ema: Optional[float] = None
+        self.seen = 0
+        self.fired = False
+
+    def observe(
+        self, predicted_s: Optional[float], observed_s: Optional[float]
+    ) -> bool:
+        """Feed one (predicted, observed) step pair; True exactly once,
+        at the first post-warmup step whose ratio EMA breaches the
+        band.  Non-usable pairs (missing / non-finite / non-positive)
+        are skipped without touching the EMA."""
+        if predicted_s is None or observed_s is None:
+            return False
+        if not (math.isfinite(predicted_s) and math.isfinite(observed_s)):
+            return False
+        if predicted_s <= 0 or observed_s <= 0:
+            return False
+        ratio = observed_s / predicted_s
+        self.ema = (
+            ratio
+            if self.ema is None
+            else self.decay * self.ema + (1.0 - self.decay) * ratio
+        )
+        self.seen += 1
+        if self.fired or self.seen < self.warmup:
+            return False
+        if self.ema > self.factor or self.ema < 1.0 / self.factor:
+            self.fired = True  # fires-once: one alarm per run
+            return True
+        return False
+
+
 class HealthMonitor:
     """Flight recorder + detectors + bundle writer (see module doc)."""
 
@@ -108,16 +161,31 @@ class HealthMonitor:
         spike_factor: float = 4.0,
         ema_decay: float = 0.9,
         warmup_steps: int = 5,
+        drift: str = "off",
+        drift_factor: float = 2.0,
+        drift_decay: float = 0.9,
+        drift_warmup: int = 3,
     ):
         assert policy in HEALTH_POLICIES, (
             f"health policy must be one of {HEALTH_POLICIES}, got {policy!r}"
+        )
+        assert drift in DRIFT_POLICIES, (
+            f"drift policy must be one of {DRIFT_POLICIES}, got {drift!r}"
         )
         self.policy = policy
         self.stream = stream or MetricsStream(None)
         # detectors run only under an explicit policy; a bare
         # --metrics-out records the stream without judging it
         self.detecting = policy != "off"
-        self.enabled = self.detecting or self.stream.enabled
+        # prediction-drift watchdog (--drift off|warn|dump): watches the
+        # observed/predicted step-time ratio the calibration loop pairs
+        # into every record; "dump" reuses the ONE-bundle flight-recorder
+        # machinery below
+        self.drift_policy = drift
+        self.drift = DriftDetector(drift_factor, drift_decay, drift_warmup)
+        self.enabled = (
+            self.detecting or self.stream.enabled or drift != "off"
+        )
         # grad/param norms are worth their in-step compute whenever the
         # monitor is on at all — the stream without them is half-blind
         self.wants_diagnostics = self.enabled
@@ -181,11 +249,16 @@ class HealthMonitor:
         metrics: Dict[str, float],
         samples: Optional[int] = None,
         tokens: Optional[int] = None,
+        predicted_step_s: Optional[float] = None,
+        predicted_tok_s: Optional[float] = None,
     ) -> Optional[str]:
         """Record one step and run the detectors.  ``stats`` is the
         executor's ``last_step_stats`` dict; ``metrics`` may carry the
-        in-step ``grad_norm``/``param_norm`` scalars.  Returns the
-        anomaly reason (after applying the policy) or None."""
+        in-step ``grad_norm``/``param_norm`` scalars;
+        ``predicted_step_s`` is the search's priced cost for the running
+        strategy (pairs prediction with observation in every record and
+        feeds the drift watchdog).  Returns the anomaly reason (after
+        applying the policy) or None."""
         metrics = dict(metrics)
         grad_norm = metrics.pop("grad_norm", None)
         param_norm = metrics.pop("param_norm", None)
@@ -206,24 +279,32 @@ class HealthMonitor:
             samples=samples,
             tokens=tokens,
             hbm_peak_bytes=hbm_high_water(),
+            predicted_step_s=predicted_step_s,
+            predicted_tok_s=predicted_tok_s,
             counters=self.counter_deltas(dict(tracer.counters)),
             metrics=metrics,
         )
         self.ring.append(rec)
         if self._is_primary():
             self.stream.append(rec)
-        if not self.detecting:
-            return None
-        reason = None
-        if loss is not None and not math.isfinite(loss):
-            reason = "non_finite_loss"
-        elif grad_norm is not None and not math.isfinite(grad_norm):
-            reason = "non_finite_grad"
-        elif self.spike.observe(loss):
-            reason = "loss_spike"
-        if reason is None:
-            return None
-        return self._on_anomaly(reason, rec)
+        if self.detecting:
+            reason = None
+            if loss is not None and not math.isfinite(loss):
+                reason = "non_finite_loss"
+            elif grad_norm is not None and not math.isfinite(grad_norm):
+                reason = "non_finite_grad"
+            elif self.spike.observe(loss):
+                reason = "loss_spike"
+            if reason is not None:
+                return self._on_anomaly(reason, rec)
+        # prediction-drift watchdog: compile steps measure the compiler,
+        # not the strategy, so they never feed the EMA
+        if self.drift_policy != "off" and predicted_step_s is not None:
+            from flexflow_tpu.search.calibration import observed_step_s
+
+            if self.drift.observe(predicted_step_s, observed_step_s(rec)):
+                return self._on_drift(rec)
+        return None
 
     # --- anomaly handling ---------------------------------------------------
     def _on_anomaly(self, reason: str, rec: Dict[str, Any]) -> str:
@@ -244,6 +325,35 @@ class HealthMonitor:
             path = self.dump_bundle(reason, rec)
         if self.policy == "raise":
             raise HealthError(reason, step, path or self.bundle_path)
+        return reason
+
+    def _on_drift(self, rec: Dict[str, Any]) -> str:
+        """The drift watchdog fired (once per run — DriftDetector holds
+        the latch): warn + tracer counter, and under ``--drift dump``
+        reuse the one-bundle flight-recorder machinery so the evidence
+        (config, strategy, last-N records with their prediction pairs)
+        lands in the same bundle layout a NaN would produce."""
+        reason = "prediction_drift"
+        step = rec["step"]
+        if len(self.anomalies) < 1000:
+            self.anomalies.append({
+                "reason": reason, "step": step, "ratio_ema": self.drift.ema,
+            })
+        tracer = get_tracer()
+        tracer.counter("health.drift_events")
+        tracer.instant(
+            "health_drift", cat="health", step=step,
+            ratio_ema=self.drift.ema,
+        )
+        print(
+            f"[health] {reason} at step {step}: observed/predicted EMA "
+            f"{self.drift.ema:.3g} outside [1/{self.drift.factor:g}, "
+            f"{self.drift.factor:g}] (policy={self.drift_policy}) — the "
+            f"calibration store is stale for this run",
+            flush=True,
+        )
+        if self.drift_policy == "dump":
+            self.dump_bundle(reason, rec)
         return reason
 
     def dump_bundle(self, reason: str, rec: Dict[str, Any]) -> Optional[str]:
@@ -330,14 +440,15 @@ def configure_monitor(
 
 def configure_monitor_from_config(cfg) -> HealthMonitor:
     """Wire the process monitor to ``FFConfig`` (``--metrics-out`` /
-    ``--health`` / ``--health-dir`` / ``--health-window`` /
-    ``--health-spike-factor``).  A config with everything off leaves the
-    current monitor untouched, so an explicitly configured monitor
+    ``--health`` / ``--drift`` / ``--health-dir`` / ``--health-window``
+    / ``--health-spike-factor``).  A config with everything off leaves
+    the current monitor untouched, so an explicitly configured monitor
     survives auxiliary FFModel constructions (same contract as
     ``configure_from_config`` for the tracer)."""
     policy = getattr(cfg, "health", "off")
     out = getattr(cfg, "metrics_out", None)
-    if policy == "off" and not out:
+    drift = getattr(cfg, "drift", "off")
+    if policy == "off" and not out and drift == "off":
         return _MONITOR
     return configure_monitor(
         policy=policy,
@@ -347,4 +458,6 @@ def configure_monitor_from_config(cfg) -> HealthMonitor:
         spike_factor=getattr(cfg, "health_spike_factor", 4.0),
         ema_decay=getattr(cfg, "health_ema_decay", 0.9),
         warmup_steps=getattr(cfg, "health_warmup_steps", 5),
+        drift=drift,
+        drift_factor=getattr(cfg, "drift_factor", 2.0),
     )

@@ -59,6 +59,14 @@ RECORD_FIELDS = (
     "compile_s",
     "jit_cache",
     "hbm_peak_bytes",
+    # search-prediction pairing (nullable — the calibration loop,
+    # docs/OBSERVABILITY.md): the priced cost of the strategy this step
+    # ran under, so every record pairs prediction with observation.
+    # ADDING these keeps the schema at ffmetrics/1 (consumers ignore
+    # unknown keys; step_record pre-seeds them to None so old readers of
+    # new streams and new readers of old streams both interoperate).
+    "predicted_step_s",
+    "predicted_tok_s",
 )
 
 
@@ -106,6 +114,8 @@ def step_record(
     samples: Optional[int] = None,
     tokens: Optional[int] = None,
     hbm_peak_bytes: Optional[float] = None,
+    predicted_step_s: Optional[float] = None,
+    predicted_tok_s: Optional[float] = None,
     counters: Optional[Dict[str, float]] = None,
     metrics: Optional[Dict[str, float]] = None,
 ) -> Dict[str, Any]:
@@ -127,6 +137,8 @@ def step_record(
         ("host_stall_s", host_stall_s),
         ("compile_s", compile_s),
         ("hbm_peak_bytes", hbm_peak_bytes),
+        ("predicted_step_s", predicted_step_s),
+        ("predicted_tok_s", predicted_tok_s),
     ):
         if v is not None:
             rec[k] = float(v)

@@ -201,6 +201,15 @@ class Strategy:
         # set by unity_search(objective="serve"): the ServeObjective's
         # pricing of this placement (tok_s / p99_ms / feasible / ...)
         self.serve_price: Optional[Dict] = None
+        # the search's priced cost for THIS strategy (seconds per
+        # training step / per decode step, calibration-corrected when a
+        # CalibrationStore was active) — threaded into every ffmetrics/1
+        # record so observation pairs with prediction
+        # (docs/OBSERVABILITY.md "Calibration loop").  Nullable: an
+        # imported or hand-built strategy carries no price until
+        # FFModel.compile estimates one.
+        self.predicted_step_s: Optional[float] = None
+        self.predicted_tok_s: Optional[float] = None
 
     def op_sharding(self, layer: Layer) -> Optional[OpSharding]:
         return self.ops.get(int(layer.layer_guid))

@@ -1049,6 +1049,17 @@ class Executor:
                 {k: float(v) for k, v in m.items()},
                 samples=self._samples_per_step,
                 tokens=self._tokens_per_step,
+                # pair the search's priced cost with this observation
+                # (calibration loop, docs/OBSERVABILITY.md); read late
+                # off the strategy so a prediction attached after
+                # construction (imported/data-parallel strategies priced
+                # by FFModel.compile) still lands in every record
+                predicted_step_s=getattr(
+                    self.strategy, "predicted_step_s", None
+                ),
+                predicted_tok_s=getattr(
+                    self.strategy, "predicted_tok_s", None
+                ),
             )
         return loss, m
 

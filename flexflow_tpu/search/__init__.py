@@ -13,6 +13,12 @@
   optimizer      — ``unity_search`` top-level driver
 """
 
+from flexflow_tpu.search.calibration import (
+    CalibratedCostModel,
+    CalibrationMismatch,
+    CalibrationStore,
+    prediction_mape,
+)
 from flexflow_tpu.search.cost import TPUMachineModel, estimate_strategy_cost
 from flexflow_tpu.search.dp import SearchHelper
 from flexflow_tpu.search.memory import strategy_memory_per_device
@@ -37,11 +43,15 @@ from flexflow_tpu.search.substitution import (
 )
 
 __all__ = [
+    "CalibratedCostModel",
+    "CalibrationMismatch",
+    "CalibrationStore",
     "GraphXfer",
     "JointResult",
     "StructXfer",
     "apply_rewrite",
     "default_struct_xfers",
+    "prediction_mape",
     "MeasuredCostModel",
     "NetworkedMachineModel",
     "OpProfiler",

@@ -1,0 +1,538 @@
+"""Calibrated cost-model tier: close the telemetry loop (ROADMAP #3).
+
+The Unity search prices strategies analytically (``search/cost.py``) or
+by compiling-and-timing ops in isolation (``search/simulator.py``).  The
+repo also *observes* reality: every ``--metrics-out`` run emits
+schema-versioned ``ffmetrics/1`` step records, ``OpProfiler`` persists
+measured per-op times, and ``ServeEngine`` emits per-window serve
+records.  This module reads that corpus back and turns it into
+corrections the next search applies — the learned-over-analytic recipe
+of "A Learned Performance Model for TPUs" and PALM (PAPERS.md), reduced
+to its robust core: per-op-class and per-objective **scale/offset fits
+over the analytic prediction**, so a calibrated prediction is always a
+monotone transform of the analytic one (golden winners survive identity
+corrections by construction).
+
+Flow (docs/OBSERVABILITY.md, "Calibration loop"):
+
+  run with --metrics-out           → ffmetrics/1 records carrying BOTH
+                                     ``predicted_step_s`` (the search's
+                                     priced cost) and the observed wall
+                                     split
+  CalibrationStore.ingest_*        → (predicted, observed) step samples,
+                                     (analytic, measured) per-op-class
+                                     samples from OpProfiler caches,
+                                     serve-window decode samples
+  CalibrationStore.fit             → scale/offset per key (least squares
+                                     when >= MIN_LSQ_SAMPLES well-spread
+                                     samples, median-of-ratios fallback
+                                     otherwise — robust to the outliers
+                                     a live stream always contains)
+  CalibratedCostModel              → plugs into the same ``node_time_fn``
+                                     provider slot as MeasuredCostModel
+                                     (``--cost-model calibrated``;
+                                     composable — corrections apply on
+                                     top of the analytic OR measured
+                                     base tier)
+  DriftDetector (obs/health.py)    → watches live observed/predicted
+                                     ratios so a stale store is an
+                                     alarm, not a silent mis-search
+
+The store is versioned JSON **keyed by pricing identity** — machine-model
+source (``preset:<chip>`` / ``file:<sha256/12>``), jax backend, and
+compute dtype.  Corrections fit on one (machine, backend, dtype) triple
+are meaningless on another; :meth:`CalibrationStore.load` refuses a
+mismatch instead of silently mis-correcting.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from flexflow_tpu.ops.base import get_op_def
+from flexflow_tpu.search.cost import (
+    TPUMachineModel,
+    _VIEW_OPS,
+    op_compute_time,
+)
+
+__all__ = [
+    "CALIBRATION_SCHEMA",
+    "CalibrationMismatch",
+    "CalibrationStore",
+    "CalibratedCostModel",
+    "fit_scale_offset",
+    "prediction_mape",
+    "observed_step_s",
+]
+
+# bump when a field changes meaning; a version-mismatched store file is
+# REFUSED on load (explicit invalidation beats silent mis-correction)
+CALIBRATION_SCHEMA = "ffcal/1"
+
+# below this many samples the least-squares scale/offset fit is noise;
+# fall back to the median of per-sample observed/predicted ratios
+MIN_LSQ_SAMPLES = 8
+
+# samples whose ratio sits this many times outside the median ratio are
+# trimmed before the least-squares fit (a compile hiccup or paging stall
+# in a live stream must not own the slope)
+_OUTLIER_RATIO = 8.0
+
+
+class CalibrationMismatch(ValueError):
+    """A store file whose schema version or pricing identity (machine
+    model / backend / compute dtype) does not match the requesting run.
+    Corrections do not transfer across pricing identities — refuse."""
+
+
+def fit_scale_offset(
+    pairs: Sequence[Tuple[float, float]],
+    min_samples: int = MIN_LSQ_SAMPLES,
+) -> Optional[Dict[str, Any]]:
+    """Fit ``observed ≈ scale * predicted + offset`` over (predicted,
+    observed) pairs.
+
+    Robustness ladder:
+      * non-finite / non-positive samples are dropped up front;
+      * with >= ``min_samples`` survivors, ratio-outliers are trimmed and
+        ordinary least squares fits (scale, offset);
+      * with fewer survivors — or when LS degenerates (zero predictor
+        variance, non-positive scale) — the fit falls back to
+        ``scale = median(observed / predicted), offset = 0``.
+
+    Scale is ALWAYS positive, so a calibrated prediction is a monotone
+    transform of the analytic one: applying corrections can never invert
+    a strategy ranking (the validate_costmodel rank gate leans on this).
+    Returns None when no usable sample survives.
+    """
+    clean = [
+        (float(p), float(o))
+        for p, o in pairs
+        if math.isfinite(p) and math.isfinite(o) and p > 0 and o > 0
+    ]
+    if not clean:
+        return None
+    ratios = sorted(o / p for p, o in clean)
+    med = ratios[len(ratios) // 2]
+
+    def median_fit(n_used: int) -> Dict[str, Any]:
+        return {
+            "scale": med, "offset": 0.0, "n": len(clean),
+            "n_used": n_used, "method": "median_ratio",
+        }
+
+    if len(clean) < min_samples:
+        return median_fit(len(clean))
+    kept = [
+        (p, o) for p, o in clean
+        if med / _OUTLIER_RATIO <= o / p <= med * _OUTLIER_RATIO
+    ]
+    if len(kept) < min_samples:
+        return median_fit(len(kept))
+    n = float(len(kept))
+    sp = sum(p for p, _ in kept)
+    so = sum(o for _, o in kept)
+    spp = sum(p * p for p, _ in kept)
+    spo = sum(p * o for p, o in kept)
+    denom = n * spp - sp * sp
+    if denom <= 0:
+        return median_fit(len(kept))
+    scale = (n * spo - sp * so) / denom
+    offset = (so - scale * sp) / n
+    if scale <= 0:  # pathological corpus — keep predictions monotone
+        return median_fit(len(kept))
+    return {
+        "scale": scale, "offset": offset, "n": len(clean),
+        "n_used": len(kept), "method": "lsq",
+    }
+
+
+def observed_step_s(rec: Dict[str, Any]) -> Optional[float]:
+    """The observed step time a prediction should be compared against:
+    the dispatch + block window (``dispatch_s`` + ``device_s``) when the
+    instrumented path measured both — the wall from args-ready to
+    results-ready.  On a real accelerator dispatch is enqueue-only, so
+    the sum ≈ device time; on CPU the executor's compute lands on
+    whichever side of the dispatch/block race XLA chose that step, and
+    ONLY the sum is stable (``device_s`` alone flips ~15x run to run).
+    Falls back to ``device_s`` then ``step_wall_s``.  None for compile
+    steps (``compile_s`` > 0 / jit miss) — a step that paid an XLA
+    compile measures the compiler, not the strategy."""
+    if rec.get("compile_s") or rec.get("jit_cache") == "miss":
+        return None
+    v = rec.get("device_s")
+    if v is not None:
+        disp = rec.get("dispatch_s")
+        if (
+            isinstance(disp, (int, float))
+            and math.isfinite(disp)
+            and disp > 0
+        ):
+            v = float(v) + float(disp)
+    else:
+        v = rec.get("step_wall_s")
+    if v is None or not math.isfinite(v) or v <= 0:
+        return None
+    return float(v)
+
+
+def prediction_mape(
+    records: Sequence[Dict[str, Any]],
+    predicted_override: Optional[float] = None,
+) -> Optional[float]:
+    """Mean absolute percentage error of ``predicted_step_s`` vs the
+    observed step time over a metrics stream (compile steps excluded).
+    ``predicted_override`` scores a hypothetical prediction against the
+    same observations (the before/after comparison of the flywheel
+    demo).  None when no record is scoreable."""
+    errs = []
+    for rec in records:
+        obs = observed_step_s(rec)
+        pred = (
+            predicted_override
+            if predicted_override is not None
+            else rec.get("predicted_step_s")
+        )
+        if obs is None or pred is None or not math.isfinite(pred) or pred <= 0:
+            continue
+        errs.append(abs(obs - pred) / obs)
+    return sum(errs) / len(errs) if errs else None
+
+
+class CalibrationStore:
+    """Versioned corpus of (predicted, observed) evidence + the fitted
+    corrections, keyed by pricing identity (see module docstring).
+
+    Sample kinds:
+      * ``step`` — per-objective ("fit" / "serve") whole-step pairs from
+        ``ffmetrics/1`` streams; correct the search's final price.
+      * ``op_class`` — per-``OperatorType`` (analytic roofline, measured)
+        pairs from OpProfiler cost caches; correct DP leaf times through
+        :class:`CalibratedCostModel`.
+      * ``mem_class`` — per-op-class (analytic activation bytes, measured
+        temp bytes) pairs from the profiler's measured-memory tier;
+        recorded for the calibration report (the λ memory search already
+        consumes measured bytes directly when a profiler is present).
+    """
+
+    def __init__(
+        self,
+        identity: str,
+        backend: str = "unknown",
+        compute_dtype: str = "float32",
+    ) -> None:
+        self.identity = str(identity)
+        self.backend = str(backend)
+        self.compute_dtype = str(compute_dtype)
+        self.step_samples: Dict[str, List[Tuple[float, float]]] = {}
+        self.op_samples: Dict[str, List[Tuple[float, float]]] = {}
+        self.mem_samples: Dict[str, List[Tuple[float, float]]] = {}
+        self._fits: Optional[Dict[str, Any]] = None
+
+    # --- ingestion ----------------------------------------------------------
+    def _count_ingest(self, n: int) -> int:
+        if n:
+            self._fits = None  # corrections refit lazily on next query
+            from flexflow_tpu.obs import get_tracer
+
+            get_tracer().counter("calibration.samples_ingested", float(n))
+        return n
+
+    def add_step_sample(
+        self, kind: str, predicted: float, observed: float
+    ) -> None:
+        self.step_samples.setdefault(kind, []).append(
+            (float(predicted), float(observed))
+        )
+        self._count_ingest(1)
+
+    def ingest_metrics(
+        self, records: Sequence[Dict[str, Any]], kind: str = "fit"
+    ) -> int:
+        """Ingest a training metrics stream (``read_metrics`` output):
+        every record pairing a ``predicted_step_s`` with an observed
+        step time becomes one step sample.  Old-schema records (no
+        prediction fields) and compile steps are skipped, not errors —
+        mixed streams are the norm."""
+        n = 0
+        for rec in records:
+            pred = rec.get("predicted_step_s")
+            obs = observed_step_s(rec)
+            if pred is None or obs is None:
+                continue
+            if not (isinstance(pred, (int, float)) and math.isfinite(pred)):
+                continue
+            if pred <= 0:
+                continue
+            self.step_samples.setdefault(kind, []).append((float(pred), obs))
+            n += 1
+        return self._count_ingest(n)
+
+    def ingest_serve_metrics(self, records: Sequence[Dict[str, Any]]) -> int:
+        """Ingest a ``ServeEngine`` window stream: pure-decode windows
+        (no prefill chunks mixed into the wall time) yield one sample of
+        (predicted one-token decode step, observed wall / decode steps)
+        under the ``"serve"`` key — the corpus that calibrates the
+        decode roofline (``estimate_decode_step_time``)."""
+        n = 0
+        for rec in records:
+            pred = rec.get("predicted_step_s")
+            wall = rec.get("step_wall_s")
+            serve = (rec.get("metrics") or {}).get("serve") or {}
+            steps = serve.get("decode_steps") or 0
+            if serve.get("prefill_chunks"):
+                continue  # window wall includes prefill compute
+            if pred is None or wall is None or steps <= 0:
+                continue
+            if not (isinstance(pred, (int, float)) and math.isfinite(pred)):
+                continue
+            if pred <= 0 or wall <= 0:
+                continue
+            self.step_samples.setdefault("serve", []).append(
+                (float(pred), float(wall) / float(steps))
+            )
+            n += 1
+        return self._count_ingest(n)
+
+    def ingest_profiler(
+        self,
+        profiler,
+        layers,
+        mesh,
+        machine: Optional[TPUMachineModel] = None,
+        strategy=None,
+    ) -> int:
+        """Pair the OpProfiler's CACHED measurements (never triggers new
+        compiles — read-only over ``profiler.cache``) with the analytic
+        roofline at the same per-shard shapes, one sample per op class.
+        ``strategy`` supplies per-layer shardings when the cache was
+        filled by a sharded search; None reads the replicated entries."""
+        m = machine or TPUMachineModel()
+        n = 0
+        for layer in layers:
+            if layer.op_type.is_parallel_op or layer.op_type in _VIEW_OPS:
+                continue
+            sharding = strategy.op_sharding(layer) if strategy else None
+            local_in = profiler._local_input_shapes(layer, sharding, mesh)
+            local_w = profiler._local_weight_shapes(layer, sharding, mesh)
+            key = profiler._key(layer, local_in) + repr(local_w)
+            cls = layer.op_type.name
+            measured = profiler.cache.get(key)
+            if measured is not None and measured > 0:
+                degree = get_op_def(layer.op_type).shard_degree(
+                    layer, sharding, mesh
+                )
+                analytic = op_compute_time(layer, degree, m)
+                if analytic > 0:
+                    self.op_samples.setdefault(cls, []).append(
+                        (analytic, float(measured))
+                    )
+                    n += 1
+            mem = profiler.cache.get("mem:" + key)
+            if mem is not None and mem > 0:
+                opdef = get_op_def(layer.op_type)
+                analytic_bytes = float(opdef.mem_bytes(layer))
+                if analytic_bytes > 0:
+                    self.mem_samples.setdefault(cls, []).append(
+                        (analytic_bytes, float(mem))
+                    )
+                    n += 1
+        return self._count_ingest(n)
+
+    # --- fitting ------------------------------------------------------------
+    def fit(self) -> Dict[str, Any]:
+        """(Re)fit every correction; memoized until new samples arrive."""
+        if self._fits is None:
+            self._fits = {
+                "step": {
+                    k: fit_scale_offset(v)
+                    for k, v in self.step_samples.items()
+                    if fit_scale_offset(v) is not None
+                },
+                "op_class": {
+                    k: fit_scale_offset(v)
+                    for k, v in self.op_samples.items()
+                    if fit_scale_offset(v) is not None
+                },
+                "mem_class": {
+                    k: fit_scale_offset(v)
+                    for k, v in self.mem_samples.items()
+                    if fit_scale_offset(v) is not None
+                },
+            }
+        return self._fits
+
+    def step_correction(self, kind: str) -> Optional[Dict[str, Any]]:
+        return self.fit()["step"].get(kind)
+
+    def op_correction(self, op_class: str) -> Optional[Dict[str, Any]]:
+        return self.fit()["op_class"].get(op_class)
+
+    def correct_step(self, kind: str, predicted_s: float) -> float:
+        """Apply the step-level correction for ``kind`` ("fit"/"serve").
+        Identity when no correction is fitted.  Monotone and clamped
+        positive, so it can re-scale a search's price but never reorder
+        or zero it."""
+        c = self.step_correction(kind)
+        if c is None or predicted_s is None:
+            return predicted_s
+        from flexflow_tpu.obs import get_tracer
+
+        get_tracer().counter("calibration.corrections_applied")
+        return max(1e-12, c["scale"] * float(predicted_s) + c["offset"])
+
+    # --- persistence --------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "schema": CALIBRATION_SCHEMA,
+            "identity": self.identity,
+            "backend": self.backend,
+            "compute_dtype": self.compute_dtype,
+            "samples": {
+                "step": {k: list(map(list, v)) for k, v in self.step_samples.items()},
+                "op_class": {k: list(map(list, v)) for k, v in self.op_samples.items()},
+                "mem_class": {k: list(map(list, v)) for k, v in self.mem_samples.items()},
+            },
+            "corrections": self.fit(),
+        }
+
+    def save(self, path: str) -> None:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=1, sort_keys=True)
+
+    @classmethod
+    def load(
+        cls,
+        path: str,
+        expect_identity: Optional[str] = None,
+        expect_backend: Optional[str] = None,
+        expect_dtype: Optional[str] = None,
+    ) -> "CalibrationStore":
+        """Load a store file, REFUSING a schema-version mismatch or —
+        when the caller states its pricing identity — an identity/
+        backend/dtype mismatch.  A refused store raises
+        :class:`CalibrationMismatch` rather than silently applying
+        corrections fit for different hardware."""
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict) or doc.get("schema") != CALIBRATION_SCHEMA:
+            raise CalibrationMismatch(
+                f"{path}: calibration schema "
+                f"{doc.get('schema') if isinstance(doc, dict) else None!r} "
+                f"!= {CALIBRATION_SCHEMA!r} — refusing stale corrections"
+            )
+        for field, expect in (
+            ("identity", expect_identity),
+            ("backend", expect_backend),
+            ("compute_dtype", expect_dtype),
+        ):
+            have = doc.get(field)
+            if expect is not None and have != expect:
+                raise CalibrationMismatch(
+                    f"{path}: store {field} {have!r} != this run's "
+                    f"{expect!r} — corrections do not transfer across "
+                    f"pricing identities"
+                )
+        store = cls(
+            doc.get("identity", "unknown"),
+            doc.get("backend", "unknown"),
+            doc.get("compute_dtype", "float32"),
+        )
+        samples = doc.get("samples", {})
+        for attr, key in (
+            ("step_samples", "step"),
+            ("op_samples", "op_class"),
+            ("mem_samples", "mem_class"),
+        ):
+            for k, v in (samples.get(key) or {}).items():
+                getattr(store, attr)[k] = [
+                    (float(p), float(o)) for p, o in v
+                ]
+        return store
+
+    def summary(self) -> Dict[str, Any]:
+        """Per-key fit summary for the report tool / search logs."""
+        fits = self.fit()
+        return {
+            "identity": self.identity,
+            "backend": self.backend,
+            "compute_dtype": self.compute_dtype,
+            "step": fits["step"],
+            "op_class": fits["op_class"],
+            "mem_class": fits["mem_class"],
+            "samples": {
+                "step": {k: len(v) for k, v in self.step_samples.items()},
+                "op_class": {k: len(v) for k, v in self.op_samples.items()},
+                "mem_class": {k: len(v) for k, v in self.mem_samples.items()},
+            },
+        }
+
+
+class CalibratedCostModel:
+    """Third cost-model tier (``--cost-model calibrated``): the analytic
+    roofline — or the measured tier, when one is active — with the
+    store's per-op-class corrections applied on top.
+
+    Plugs into the SAME ``node_time_fn`` provider slot as
+    :class:`~flexflow_tpu.search.simulator.MeasuredCostModel`, so the DP,
+    ``estimate_strategy_cost``, and the event simulator all consume it
+    unchanged.  An op class the store has no correction for falls
+    through untouched: to the measured base when present, else to
+    ``node_cost``'s own analytic path (``node_time`` returns None) — so
+    an EMPTY store prices byte-identically to the uncalibrated tier and
+    the search goldens hold by construction.
+    """
+
+    def __init__(
+        self,
+        store: CalibrationStore,
+        mesh,
+        machine: Optional[TPUMachineModel] = None,
+        base=None,
+        forward_only: bool = False,
+    ) -> None:
+        self.store = store
+        self.mesh = mesh
+        self.machine = (machine or TPUMachineModel()).for_mesh(mesh)
+        self.base = base  # MeasuredCostModel or None (analytic roofline)
+        self.forward_only = forward_only
+        self.corrections_applied = 0
+
+    def node_time(
+        self, layer, sharding
+    ) -> Optional[float]:
+        corr = self.store.op_correction(layer.op_type.name)
+        if corr is None or layer.op_type in _VIEW_OPS:
+            # nothing to say: measured base answers, or None lets
+            # node_cost compute its own analytic time (keeps the
+            # fwd_only/view-op handling in ONE place)
+            return self.base.node_time(layer, sharding) if self.base else None
+        degree = get_op_def(layer.op_type).shard_degree(
+            layer, sharding, self.mesh
+        )
+        analytic = op_compute_time(
+            layer, degree, self.machine, fwd_only=self.forward_only
+        )
+        calibrated = max(1e-12, corr["scale"] * analytic + corr["offset"])
+        self.corrections_applied += 1
+        from flexflow_tpu.obs import get_tracer
+
+        get_tracer().counter("calibration.corrections_applied")
+        if self.base is not None:
+            # composable: scale the measured base by the same relative
+            # correction the analytic time received
+            bt = self.base.node_time(layer, sharding)
+            if analytic > 0 and bt is not None and bt > 0:
+                return bt * (calibrated / analytic)
+            return bt
+        return calibrated
+
+    def correct_step(self, kind: str, predicted_s: float) -> float:
+        return self.store.correct_step(kind, predicted_s)

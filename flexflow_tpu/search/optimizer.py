@@ -55,6 +55,7 @@ def unity_search(
     inference: bool = False,
     objective: str = "train",
     serve=None,
+    calibration=None,
 ) -> Strategy:
     """Pick the cheapest (mesh factorization, per-op sharding) pair.
 
@@ -95,6 +96,17 @@ def unity_search(
     :class:`~flexflow_tpu.serve.objective.ServeSpec` (slots, kv_len,
     SLO, flush cadence); None uses its defaults.  The winner carries a
     ``serve_price`` dict (tok_s / p99_ms / feasible / breakdown).
+
+    ``calibration``: a
+    :class:`~flexflow_tpu.search.calibration.CalibrationStore` activates
+    the calibrated cost tier (``--cost-model calibrated``,
+    docs/OBSERVABILITY.md "Calibration loop"): per-op-class corrections
+    wrap the leaf cost provider (on top of the measured tier when
+    ``profiler`` is also given), and the winner's priced cost is
+    step-corrected before landing in ``Strategy.predicted_step_s``.
+    The winner ALWAYS carries ``predicted_step_s`` (the raw DP estimate
+    when no store is given) so every instrumented run pairs prediction
+    with observation in its ffmetrics records.
     """
     from flexflow_tpu.obs import get_tracer
     from flexflow_tpu.search.candidates import SearchOptions, search_options
@@ -113,6 +125,7 @@ def unity_search(
             layers, mesh, graph_inputs, budget, alpha, machine,
             mem_budget_bytes, explore_meshes, beam, profiler, mem_search_iters,
             extra_xfers, struct_xfers, inference, objective, serve,
+            calibration,
         )
 
 
@@ -120,6 +133,7 @@ def _unity_search_impl(
     layers, mesh, graph_inputs, budget, alpha, machine,
     mem_budget_bytes, explore_meshes, beam, profiler, mem_search_iters,
     extra_xfers, struct_xfers, inference, objective="train", serve=None,
+    calibration=None,
 ) -> Strategy:
     assert objective in ("train", "serve"), objective
     if graph_inputs is None:
@@ -138,6 +152,9 @@ def _unity_search_impl(
         serve_obj = ServeObjective(
             machine, serve or ServeSpec(),
             train_tokens=_train_tokens(graph_inputs),
+            # serve-window records calibrate the decode roofline: the
+            # store's "serve" step correction re-scales step_s/tok_s/p99
+            calibration=calibration,
         )
 
     meshes = mesh.enumerate_views() if explore_meshes else [mesh]
@@ -172,12 +189,23 @@ def _unity_search_impl(
     mcms = []  # per-mesh measured-cost models, for the coverage report
     for mv in cands:
         node_time_fn = None
+        mcm = None
         if profiler is not None:
             from flexflow_tpu.search.simulator import MeasuredCostModel
 
             mcm = MeasuredCostModel(profiler, mv, machine, layers=layers)
             mcms.append(mcm)
             node_time_fn = mcm.node_time
+        if calibration is not None:
+            from flexflow_tpu.search.calibration import CalibratedCostModel
+
+            # calibrated tier: per-op-class corrections over the
+            # analytic roofline, or over the measured base when one is
+            # active (the same node_time_fn provider slot either way)
+            node_time_fn = CalibratedCostModel(
+                calibration, mv, machine, base=mcm,
+                forward_only=serve_obj is not None,
+            ).node_time
 
         def run(lam: float, _mv=mv, _ntf=node_time_fn):
             return graph_optimize(
@@ -236,6 +264,20 @@ def _unity_search_impl(
                 st.applied_detail = tuple(res.applied_detail)
             if price is not None:
                 st.serve_price = price
+                # serve prediction: the objective's (calibration-
+                # corrected) one-token decode step time + tokens/s
+                st.predicted_step_s = price.get("step_s")
+                st.predicted_tok_s = price.get("tok_s")
+            else:
+                # training prediction: the DP's step-time estimate
+                # (seconds — optimize_with_memory_budget re-estimates at
+                # λ=0), step-corrected when a calibration store is
+                # active.  Correction is monotone, so applying it only
+                # to the winner cannot change which mesh won.
+                pred = res.cost
+                if calibration is not None:
+                    pred = calibration.correct_step("fit", pred)
+                st.predicted_step_s = pred
             best = st
     assert best is not None, "no feasible mesh factorization"
     if profiler is not None:

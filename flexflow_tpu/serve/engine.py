@@ -134,6 +134,15 @@ class ServeEngine:
         self.sched = ContinuousBatchingScheduler(self.slots, self.kv)
         self.metrics = MetricsStream(metrics_out)
         self.prefetch_depth = max(1, int(prefetch_depth))
+        # search prediction pairing (calibration loop): a strategy from
+        # ``unity_search --objective serve`` carries the ServeObjective's
+        # priced one-token decode step time / tokens/s — thread them into
+        # every window record so ``CalibrationStore.ingest_serve_metrics``
+        # can calibrate the decode roofline from production streams.
+        # Nullable: a demo model without a serve search emits None.
+        sp = getattr(model.strategy, "serve_price", None) or {}
+        self.predicted_step_s = sp.get("step_s")
+        self.predicted_tok_s = sp.get("tok_s")
 
         # --- build the two compiled programs -----------------------------
         spec = self.spec
@@ -504,6 +513,8 @@ class ServeEngine:
                 host_stall_s=stall,
                 tokens=flushed_tokens,
                 samples=len(dec_slots),
+                predicted_step_s=self.predicted_step_s,
+                predicted_tok_s=self.predicted_tok_s,
                 metrics={"serve": {
                     "queue_depth": self.sched.queue_depth,
                     "occupancy": self.sched.occupancy,

@@ -60,10 +60,16 @@ class ServeObjective:
         machine: Optional[TPUMachineModel],
         spec: ServeSpec,
         train_tokens: int,
+        calibration=None,
     ) -> None:
         self.machine = machine
         self.spec = spec
         self.train_tokens = max(1, int(train_tokens))
+        # CalibrationStore fit from ServeEngine window records: its
+        # "serve" step correction re-scales the analytic decode roofline
+        # to observed per-decode-step reality (the PR-6 leftover —
+        # docs/OBSERVABILITY.md "Calibration loop")
+        self.calibration = calibration
 
     def price(self, layers: List[Layer], strategy) -> Dict[str, Any]:
         d = estimate_decode_step_time(
@@ -71,7 +77,14 @@ class ServeObjective:
             slots=self.spec.slots, kv_len=self.spec.kv_len,
             train_tokens=self.train_tokens,
         )
-        step_s = max(d["step_s"], 1e-12)
+        step_s_raw = max(d["step_s"], 1e-12)
+        step_s = step_s_raw
+        calibrated = False
+        if self.calibration is not None:
+            step_s = max(
+                self.calibration.correct_step("serve", step_s_raw), 1e-12
+            )
+            calibrated = step_s != step_s_raw
         tok_s = self.spec.slots / step_s
         p99_ms = step_s * self.spec.sync_every * 1e3
         feasible = p99_ms <= self.spec.slo_p99_ms
@@ -88,7 +101,9 @@ class ServeObjective:
             "slots": self.spec.slots,
             "kv_len": self.spec.kv_len,
             "sync_every": self.spec.sync_every,
-            "step_s": d["step_s"],
+            "step_s": step_s,
+            "step_s_raw": step_s_raw,
+            "calibrated": calibrated,
             "breakdown": {
                 k: d[k] for k in ("mem_s", "flops_s", "coll_s")
             },

@@ -64,7 +64,13 @@ DEFAULT_THRESHOLD = 0.15
 # r08) rides the same rule: a different synthetic workload shifts the
 # serving numbers for benign reasons, so the gate prints the change
 # and still compares.
-COMPARABLE_METADATA = ("metrics_sync_every", "stack_blocks", "serve_traffic")
+# cost_model_tier (which cost-model tier produced the record's
+# prediction — analytic/measured/calibrated, new in r09) also rides this
+# rule: the tier changes prediction accuracy for benign reasons, so the
+# gate prints the change and still compares.
+COMPARABLE_METADATA = (
+    "metrics_sync_every", "stack_blocks", "serve_traffic", "cost_model_tier",
+)
 
 # (label, path into the record, higher_is_better) — the gated metrics.
 # jit_compile_s gates LOWER-is-better: a compile-time regression fails
@@ -73,9 +79,14 @@ COMPARABLE_METADATA = ("metrics_sync_every", "stack_blocks", "serve_traffic")
 # (r08, docs/SERVING.md): serve_tok_s higher-is-better, serve_p99_ms
 # LOWER-is-better — a latency regression fails even when aggregate
 # throughput held.
+# cost_model_mape (r09, docs/OBSERVABILITY.md "Calibration loop") gates
+# LOWER-is-better: predicted-vs-measured step-time error growing past
+# threshold means the cost model drifted from the hardware — the search
+# quality regression the calibration loop exists to prevent.
 GATED = (
     ("throughput", ("value",), True),
     ("compile", ("jit_compile_s",), False),
+    ("cost_model_mape", ("cost_model_mape",), False),
     ("serve_tok_s", ("serve_tok_s",), True),
     ("serve_p99_ms", ("serve_p99_ms",), False),
     ("dlrm", ("secondary", "dlrm", "samples_per_sec"), True),

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Calibration-loop report: correction factors + predicted-vs-observed
+scatter stats from a CalibrationStore and/or ffmetrics streams.
+
+Renders (docs/OBSERVABILITY.md, "Calibration loop"):
+
+  * per-op-class correction factors (scale/offset, fit method, sample
+    counts) and the per-objective step corrections from a store file;
+  * predicted-vs-observed scatter stats for each metrics stream — sample
+    count, MAPE, median/min/max observed/predicted ratio — the quick
+    answer to "how wrong is the cost model on this corpus, and would the
+    fitted store fix it".
+
+Usage:
+  python tools/calibration_report.py --store cal.json
+  python tools/calibration_report.py --metrics run.jsonl [--serve]
+  python tools/calibration_report.py --store cal.json --metrics run.jsonl
+
+Exit codes: 0 = report rendered, 2 = no usable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _fmt_fit(fit: Optional[Dict[str, Any]]) -> str:
+    if not fit:
+        return "(no fit)"
+    return (
+        f"scale={fit['scale']:.4g} offset={fit['offset']:.4g} "
+        f"[{fit['method']}, n={fit['n']}"
+        + (f", used={fit['n_used']}" if fit.get("n_used") != fit.get("n") else "")
+        + "]"
+    )
+
+
+def render_store(store) -> str:
+    """Human table over CalibrationStore.summary()."""
+    s = store.summary()
+    lines = [
+        f"calibration store: identity={s['identity']} "
+        f"backend={s['backend']} dtype={s['compute_dtype']}",
+        "  step corrections (observed ≈ scale·predicted + offset):",
+    ]
+    if not s["step"]:
+        lines.append("    (none fitted)")
+    for kind in sorted(s["step"]):
+        lines.append(f"    {kind:<8} {_fmt_fit(s['step'][kind])}")
+    lines.append("  op-class corrections (measured ≈ scale·analytic + offset):")
+    if not s["op_class"]:
+        lines.append("    (none fitted)")
+    for cls in sorted(s["op_class"]):
+        lines.append(f"    {cls:<22} {_fmt_fit(s['op_class'][cls])}")
+    if s["mem_class"]:
+        lines.append("  memory-class fits (measured temp ≈ scale·analytic bytes):")
+        for cls in sorted(s["mem_class"]):
+            lines.append(f"    {cls:<22} {_fmt_fit(s['mem_class'][cls])}")
+    return "\n".join(lines)
+
+
+def scatter_stats(
+    records: List[Dict[str, Any]], serve: bool = False
+) -> Optional[Dict[str, Any]]:
+    """Predicted-vs-observed scatter over one stream.  ``serve`` scores
+    per-decode-step times from ServeEngine window records instead of
+    training step records."""
+    from flexflow_tpu.search.calibration import observed_step_s
+
+    pairs = []
+    for rec in records:
+        pred = rec.get("predicted_step_s")
+        if pred is None or not isinstance(pred, (int, float)):
+            continue
+        if not math.isfinite(pred) or pred <= 0:
+            continue
+        if serve:
+            sv = (rec.get("metrics") or {}).get("serve") or {}
+            steps = sv.get("decode_steps") or 0
+            wall = rec.get("step_wall_s")
+            if sv.get("prefill_chunks") or steps <= 0 or not wall:
+                continue
+            obs = float(wall) / float(steps)
+        else:
+            obs = observed_step_s(rec)
+            if obs is None:
+                continue
+        pairs.append((float(pred), obs))
+    if not pairs:
+        return None
+    ratios = sorted(o / p for p, o in pairs)
+    mape = sum(abs(o - p) / o for p, o in pairs) / len(pairs)
+    return {
+        "n": len(pairs),
+        "mape": mape,
+        "ratio_median": ratios[len(ratios) // 2],
+        "ratio_min": ratios[0],
+        "ratio_max": ratios[-1],
+    }
+
+
+def render_stream(path: str, records, serve: bool = False) -> str:
+    total = len(records)
+    with_pred = sum(
+        1 for r in records if r.get("predicted_step_s") is not None
+    )
+    lines = [
+        f"metrics stream: {path} ({total} records, "
+        f"{with_pred} carrying predicted_step_s)"
+    ]
+    st = scatter_stats(records, serve=serve)
+    kind = "serve decode-step" if serve else "train step"
+    if st is None:
+        lines.append(f"  {kind}: no scoreable predicted/observed pairs")
+    else:
+        lines.append(
+            f"  {kind}: n={st['n']} MAPE={st['mape']:.2%} "
+            f"obs/pred ratio median={st['ratio_median']:.4g} "
+            f"range=[{st['ratio_min']:.4g}, {st['ratio_max']:.4g}]"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--store", help="CalibrationStore JSON (ffcal/1)")
+    ap.add_argument("--metrics", action="append", default=[],
+                    help="ffmetrics JSONL stream(s); repeatable")
+    ap.add_argument("--serve", action="store_true",
+                    help="score streams as ServeEngine window records")
+    args = ap.parse_args(argv)
+    if not args.store and not args.metrics:
+        print("calibration_report: need --store and/or --metrics",
+              file=sys.stderr)
+        return 2
+
+    # package import deferred past argparse so --help costs nothing
+    from flexflow_tpu.obs.metrics import read_metrics
+    from flexflow_tpu.search.calibration import CalibrationStore
+
+    out = []
+    if args.store:
+        # identity unchecked on purpose: the report describes a store,
+        # it does not apply one (apply-time checks live in FFModel)
+        out.append(render_store(CalibrationStore.load(args.store)))
+    for path in args.metrics:
+        out.append(render_stream(path, read_metrics(path), serve=args.serve))
+    print("\n".join(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -257,6 +257,86 @@ def _compile_stacked_ab(on_tpu: bool) -> dict:
     return out
 
 
+def _pipeline_1f1b_ab(on_tpu: bool) -> dict:
+    """Pipelined-vs-non-pipelined A/B (ISSUE 8, docs/PIPELINE.md): the
+    depth-24 smoke transformer stepped through the same harness twice —
+    ``--pipeline off`` vs a forced S=2 / M=4 1F1B schedule (virtual
+    stages on one device, real stage submeshes when the mesh carries the
+    axis).  Records per-arm AOT step time, the schedule's bubble
+    fraction ``(S-1)/(M+S-1)``, the executor host-sync ledger (the 1F1B
+    step must add ZERO), and the max |loss| divergence over 5 steps at
+    equal global batch — the bench-side shadow of the parity test."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
+    from flexflow_tpu.models.transformer import transformer_encoder
+
+    batch, seq, hidden, layers = (8, 128, 256, 24) if on_tpu else (4, 64, 128, 24)
+
+    def arm(pipeline: str, microbatches: int) -> dict:
+        cfg = FFConfig(
+            batch_size=batch, stack_blocks="auto",
+            pipeline=pipeline, microbatches=microbatches,
+        )
+        m = FFModel(cfg)
+        transformer_encoder(
+            m, batch=batch, seq=seq, hidden=hidden, heads=8,
+            ff_dim=2 * hidden, num_layers=layers, vocab=1000,
+            num_classes=16, use_flash=False, raw_input=True,
+        )
+        m.compile(
+            optimizer=AdamOptimizer(alpha=1e-4),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY, seed=0,
+        )
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(batch, seq, hidden)).astype(np.float32)
+        y = rng.integers(0, 16, size=(batch, 1)).astype(np.int32)
+        ex = m.executor
+        syncs0 = ex.host_syncs
+        ex._step_jit = ex._build_step()
+        inputs, labels = ex.place_batch([x, y])
+        args = (ex.params, ex.state, ex.opt_state, inputs, labels, 0)
+        t0 = _time.perf_counter()
+        compiled = ex._step_jit.lower(*args).compile()
+        compile_s = _time.perf_counter() - t0
+        out = jax.block_until_ready(compiled(*args))
+        losses = [float(out[3])]
+        steps = 5
+        t0 = _time.perf_counter()
+        for i in range(steps):
+            out = compiled(out[0], out[1], out[2], inputs, labels, i + 1)
+            losses.append(float(out[3]))
+        jax.block_until_ready(out)
+        step_ms = (_time.perf_counter() - t0) / steps * 1e3
+        spec = ex.pipeline
+        return {
+            "pipeline": spec.identity() if spec is not None else "off",
+            "bubble_frac": round(spec.bubble_frac, 4) if spec else 0.0,
+            "jit_compile_s": round(compile_s, 3),
+            "step_time_ms": round(step_ms, 2),
+            "extra_host_syncs": ex.host_syncs - syncs0,
+            "losses": [round(v, 6) for v in losses],
+        }
+
+    off = arm("off", 0)
+    pl = arm("2", 4)
+    return {
+        "config": f"b={batch} s={seq} h={hidden} depth={layers}"
+        + ("" if on_tpu else " (cpu smoke)"),
+        "non_pipelined": off,
+        "pipelined": pl,
+        "loss_parity_max_abs": round(
+            max(abs(a - b) for a, b in zip(off["losses"], pl["losses"])), 6
+        ),
+        "step_time_ratio": round(
+            pl["step_time_ms"] / off["step_time_ms"], 3
+        ) if off["step_time_ms"] else None,
+    }
+
+
 def _bench_dlrm(on_tpu: bool) -> dict:
     """Embedding-bound DLRM single-chip step (VERDICT r3 #4 / BASELINE.json
     north star; shapes from reference examples/cpp/DLRM/dlrm.cc:114-241 —
@@ -742,6 +822,13 @@ def run_bench(backend: str) -> None:
         "cost_model_tier": cost_model_tier,
         "cost_model_mape": cost_model_mape,
         "compile_stacked_ab": None,
+        # pipeline parallelism (--pipeline, docs/PIPELINE.md): the
+        # headline's pipeline config is comparable metadata (like
+        # stack_blocks); pipeline_bubble_frac — the 1F1B A/B's measured
+        # warmup/drain bubble — gates LOWER-is-better
+        "pipeline": cfg.pipeline,
+        "pipeline_bubble_frac": None,
+        "pipeline_1f1b_ab": None,
         # shared observability vocabulary (docs/OBSERVABILITY.md): the
         # same field names a --metrics-out training stream carries, so
         # tools/bench_compare.py reads bench artifacts and metrics
@@ -802,6 +889,14 @@ def run_bench(backend: str) -> None:
         record["compile_stacked_ab"] = _compile_stacked_ab(on_tpu)
     except Exception as e:  # noqa: BLE001
         record["compile_stacked_ab"] = {"error": str(e)[:200]}
+    # 1F1B pipeline A/B (ISSUE 8 acceptance): contained like the
+    # stacked A/B — a schedule failure must not sink the headline
+    try:
+        ab = _pipeline_1f1b_ab(on_tpu)
+        record["pipeline_1f1b_ab"] = ab
+        record["pipeline_bubble_frac"] = ab["pipelined"]["bubble_frac"]
+    except Exception as e:  # noqa: BLE001
+        record["pipeline_1f1b_ab"] = {"error": str(e)[:200]}
     record["secondary"] = _bench_secondary(on_tpu)
     sab = record["secondary"].get("serve_continuous_ab") or {}
     record["serve_tok_s"] = sab.get("serve_tok_s")

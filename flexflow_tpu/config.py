@@ -149,6 +149,17 @@ class FFConfig:
     # "on" stacks any detected chain (depth >= 2), "off" is byte-identical
     # to the unrolled path.
     stack_blocks: str = "auto"  # on | off | auto
+    # pipeline parallelism (docs/PIPELINE.md): "off" | "auto" | a stage
+    # count S.  "auto" lets the Unity search price a 1F1B pipelined
+    # variant of every mesh candidate (stage submesh solve + the
+    # (S x M) sweep) and win on cost; a numeric S forces that stage
+    # count — through the search when --budget is set, else attached
+    # directly to the default/imported strategy when a repeated-block
+    # chain divides into S stages.
+    pipeline: str = "off"  # off | auto | <stages>
+    # microbatches per 1F1B step (0 = auto: the search sweeps divisors
+    # of the global batch; non-search strategies default to min(4, B))
+    microbatches: int = 0
     # JAX persistent compilation cache directory (--compile-cache-dir):
     # compiled step programs are written to / served from disk, so
     # repeated bench/search runs skip recompiles entirely; a compile
@@ -244,6 +255,10 @@ class FFConfig:
                 self.remat_policy = take()
             elif a == "--stack-blocks":
                 self.stack_blocks = take()
+            elif a == "--pipeline":
+                self.pipeline = take()
+            elif a == "--microbatches":
+                self.microbatches = int(take())
             elif a == "--compile-cache-dir":
                 self.compile_cache_dir = take()
             elif a == "--enable-parameter-parallel":

@@ -744,6 +744,7 @@ class FFModel:
                     machine.source, jax.default_backend(), cfg.compute_dtype
                 )
 
+        searched = False  # did unity_search pick (and price) this strategy?
         if strategy is None:
             if cfg.import_strategy_file:
                 with open(cfg.import_strategy_file) as f:
@@ -817,9 +818,36 @@ class FFModel:
                     objective=cfg.search_objective,
                     serve=serve_spec,
                     calibration=calibration,
+                    # pipeline axis of the search (docs/PIPELINE.md):
+                    # off|auto|S, with M pinned by --microbatches
+                    pipeline=cfg.pipeline,
+                    microbatches=cfg.microbatches or None,
                 )
+                searched = True
             else:
                 strategy = data_parallel_strategy(self.layers, mesh)
+        # --pipeline without a search (imported / hand-built / default
+        # data-parallel strategies): attach the spec directly when a
+        # repeated-block chain supports it; declined legality prints the
+        # reason and falls back to the non-pipelined step.  A searched
+        # strategy is left alone — when the priced pipeline variant LOST
+        # the search, forcing one on anyway would override the search's
+        # answer with an unpriced guess.
+        if (
+            cfg.pipeline != "off"
+            and strategy.pipeline is None
+            and not searched
+        ):
+            from flexflow_tpu.parallel.pipeline import (
+                attach_pipeline_from_config,
+            )
+
+            reason = attach_pipeline_from_config(
+                strategy, strategy.rewritten_layers or self.layers, cfg,
+                self.graph_inputs,
+            )
+            if reason is not None and jax.process_index() == 0:
+                print(f"[pipeline] declined: {reason}")
         self.strategy = strategy
         # calibration loop: an instrumented run (--metrics-out / --health
         # / --drift) pairs every step record with the strategy's priced
@@ -833,12 +861,37 @@ class FFModel:
             and get_monitor().enabled
         ):
             try:
-                from flexflow_tpu.search.cost import estimate_strategy_cost
-
-                pred = estimate_strategy_cost(
-                    strategy.rewritten_layers or self.layers,
-                    strategy, machine,
+                from flexflow_tpu.search.cost import (
+                    estimate_pipeline_step_time,
+                    estimate_strategy_cost,
                 )
+
+                lyrs = strategy.rewritten_layers or self.layers
+                pred = None
+                if strategy.pipeline is not None:
+                    # imported / hand-attached pipelined strategy: price
+                    # the 1F1B schedule, not the non-pipelined walk —
+                    # the drift watchdog compares against THIS number
+                    from flexflow_tpu.parallel.pipeline import (
+                        select_pipeline_chain,
+                    )
+
+                    chain = select_pipeline_chain(
+                        lyrs, strategy.pipeline.stages
+                    )
+                    if chain is not None:
+                        price = estimate_pipeline_step_time(
+                            lyrs, strategy, machine,
+                            chain=chain,
+                            stages=strategy.pipeline.stages,
+                            microbatches=strategy.pipeline.microbatches,
+                            stage_axis=strategy.pipeline.stage_axis,
+                        )
+                        if price is not None:
+                            pred = price["step_s"]
+                            strategy.pipeline_price = price
+                if pred is None:
+                    pred = estimate_strategy_cost(lyrs, strategy, machine)
                 if calibration is not None:
                     pred = calibration.correct_step("fit", pred)
                 strategy.predicted_step_s = pred

@@ -281,6 +281,12 @@ class HealthMonitor:
             hbm_peak_bytes=hbm_high_water(),
             predicted_step_s=predicted_step_s,
             predicted_tok_s=predicted_tok_s,
+            # pipeline dimension of the running strategy (nullable,
+            # docs/PIPELINE.md) — carried on last_step_stats by the
+            # executor when a 1F1B schedule is active
+            pipeline_stages=stats.get("pipeline_stages"),
+            microbatches=stats.get("microbatches"),
+            bubble_frac=stats.get("bubble_frac"),
             counters=self.counter_deltas(dict(tracer.counters)),
             metrics=metrics,
         )

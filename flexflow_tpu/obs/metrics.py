@@ -67,6 +67,14 @@ RECORD_FIELDS = (
     # new streams and new readers of old streams both interoperate).
     "predicted_step_s",
     "predicted_tok_s",
+    # pipeline dimension of the step (nullable — docs/PIPELINE.md):
+    # stage count / microbatch count / 1F1B warmup-drain bubble fraction
+    # of the strategy the step ran under.  ADDING these keeps the schema
+    # at ffmetrics/1 exactly like the prediction keys above — old
+    # readers ignore them, new readers see None in old streams.
+    "pipeline_stages",
+    "microbatches",
+    "bubble_frac",
 )
 
 
@@ -116,6 +124,9 @@ def step_record(
     hbm_peak_bytes: Optional[float] = None,
     predicted_step_s: Optional[float] = None,
     predicted_tok_s: Optional[float] = None,
+    pipeline_stages: Optional[int] = None,
+    microbatches: Optional[int] = None,
+    bubble_frac: Optional[float] = None,
     counters: Optional[Dict[str, float]] = None,
     metrics: Optional[Dict[str, float]] = None,
 ) -> Dict[str, Any]:
@@ -139,9 +150,14 @@ def step_record(
         ("hbm_peak_bytes", hbm_peak_bytes),
         ("predicted_step_s", predicted_step_s),
         ("predicted_tok_s", predicted_tok_s),
+        ("bubble_frac", bubble_frac),
     ):
         if v is not None:
             rec[k] = float(v)
+    if pipeline_stages is not None:
+        rec["pipeline_stages"] = int(pipeline_stages)
+    if microbatches is not None:
+        rec["microbatches"] = int(microbatches)
     if jit_cache is not None:
         rec["jit_cache"] = str(jit_cache)
     if step_wall_s and step_wall_s > 0:

@@ -1,0 +1,238 @@
+"""Pipeline parallelism: stage specs, legality, and the 1F1B schedule math.
+
+The reference carries a dead ``OP_PIPELINE`` enum (``ffconst.h:159``) and
+SURVEY §7.3 directed the strategy IR to *leave room* for PP without
+building it.  This module builds it, TPU-native:
+
+  * **Stage legality comes from** :mod:`flexflow_tpu.blocks` **chains.**
+    A chain of structurally identical blocks is the one place a PCG can
+    be cut into pipeline stages without bespoke partitioning logic: every
+    cut between blocks crosses exactly ONE tensor (the scan carry), the
+    stages are load-balanced by construction (same block, same cost), and
+    the executor already stores chain params depth-stacked — stage ``s``
+    simply owns depth slice ``[s·D/S, (s+1)·D/S)``.
+  * **The stage axis is a mesh axis.**  Stage submeshes come from the
+    mesh factorization: a mesh ``(data=2, model=8)`` with ``stages=2`` on
+    ``data`` runs each stage SPMD over an 8-chip submesh.  On a
+    multi-slice machine the search prefers a ``dcn_axes`` member as the
+    stage axis — slices become stages, the only traffic crossing DCN is
+    the per-microbatch activation handoff (point-to-point, microbatch-
+    sized), and every collective (TP partials, weight-grad allreduce)
+    stays intra-stage on ICI ("Synthesizing Optimal Parallelism Placement
+    and Reduction Strategies on Hierarchical Systems", PAPERS.md).
+  * **Schedule**: synchronous 1F1B — ``M`` microbatches streamed through
+    ``S`` stages over ``M + S - 1`` ticks, so the warmup/drain bubble is
+    ``(S - 1) / (M + S - 1)`` of the step (the classic PipeDream-flush /
+    GPipe bound).  The executor realizes it as one ``lax.scan`` over
+    ticks with a ``ppermute`` activation handoff between stage meshes
+    (``runtime/executor.py``); autodiff reverses the scan for the
+    backward halves, and gradients accumulate on device across
+    microbatches — no new host syncs.
+
+Pure host-side graph/spec math — no jax imports, usable by the search,
+the strategy layer, and tools alike.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from flexflow_tpu.blocks import BlockChain, detect_block_chains
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineSpec:
+    """One strategy's pipeline dimension: ``stages`` over ``stage_axis``
+    of the mesh, ``microbatches`` per step.  Carried on
+    :class:`~flexflow_tpu.parallel.strategy.Strategy` (serialized in the
+    strategy JSON, round-tripped by ``to_json``/``from_json``)."""
+
+    stages: int
+    microbatches: int
+    stage_axis: str = "data"
+
+    def __post_init__(self) -> None:
+        assert self.stages >= 2, "a pipeline needs at least 2 stages"
+        assert self.microbatches >= 1
+
+    @property
+    def ticks(self) -> int:
+        """Schedule length: ``M`` steady ticks + ``S - 1`` warmup/drain."""
+        return self.microbatches + self.stages - 1
+
+    @property
+    def bubble_frac(self) -> float:
+        """Idle fraction of the 1F1B schedule: ``(S-1) / (M+S-1)``."""
+        return (self.stages - 1) / self.ticks
+
+    def to_dict(self) -> dict:
+        return {
+            "stages": self.stages,
+            "microbatches": self.microbatches,
+            "stage_axis": self.stage_axis,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "PipelineSpec":
+        return PipelineSpec(
+            stages=int(d["stages"]),
+            microbatches=int(d["microbatches"]),
+            stage_axis=str(d.get("stage_axis", "data")),
+        )
+
+    def identity(self) -> str:
+        """Compact ``SxM@axis`` tag (bench records, reports)."""
+        return f"{self.stages}x{self.microbatches}@{self.stage_axis}"
+
+
+def stage_partition(
+    chain: BlockChain, stages: int
+) -> List[Tuple[int, int]]:
+    """Partition a chain's ``depth`` blocks into ``stages`` contiguous
+    groups — the ONLY legal pipeline stages (every cut between blocks of
+    a chain crosses exactly the scan-carry tensor; any other cut would
+    strand intermediates or shared operands across the stage boundary).
+
+    Returns ``[(start_block, end_block), ...)`` (half-open, length
+    ``stages``).  Raises ``ValueError`` when the partition is illegal:
+    the blocks must split evenly so the 1F1B schedule stays
+    load-balanced — an uneven split would make the slowest stage the
+    clock for every tick.
+    """
+    if stages < 2:
+        raise ValueError(f"stages must be >= 2, got {stages}")
+    if chain.depth % stages != 0:
+        raise ValueError(
+            f"chain depth {chain.depth} does not divide into {stages} "
+            f"equal stages — legal stage counts are the divisors of the "
+            f"chain depth"
+        )
+    per = chain.depth // stages
+    return [(s * per, (s + 1) * per) for s in range(stages)]
+
+
+def select_pipeline_chain(
+    layers, stages: int, min_depth: int = 2
+) -> Optional[BlockChain]:
+    """The chain a pipeline of ``stages`` stages should run over: the
+    detected chain covering the most layers whose depth divides evenly
+    into ``stages``.  None when no chain qualifies — the model has no
+    legal pipeline body (stage legality comes from ``blocks.py`` chains,
+    docs/PIPELINE.md)."""
+    best = None
+    for c in detect_block_chains(layers, min_depth=min_depth):
+        if c.depth < stages or c.depth % stages != 0:
+            continue
+        saved = c.depth * c.block_len
+        if best is None or saved > best.depth * best.block_len:
+            best = c
+    return best
+
+
+def microbatch_candidates(
+    global_batch: int, cap: int = 32
+) -> List[int]:
+    """Microbatch counts the (S x M) sweep prices: every divisor of the
+    global batch in ``[2, cap]`` plus the degenerate ``1`` (pipelining
+    with one microbatch is pure bubble — priced so the sweep can PROVE
+    it loses, not assume it)."""
+    out = [m for m in range(1, min(global_batch, cap) + 1)
+           if global_batch % m == 0]
+    return out
+
+
+def validate_pipeline(
+    spec: PipelineSpec,
+    layers,
+    mesh,
+    global_batch: int,
+) -> Optional[str]:
+    """Why this spec cannot run on (layers, mesh, batch) — None when it
+    can.  The one legality rule shared by the search tier, FFModel
+    compile, and the executor, so a spec that prices is a spec that
+    runs."""
+    axis_size = mesh.axis_size(spec.stage_axis)
+    if axis_size not in (1, spec.stages):
+        return (
+            f"stage axis {spec.stage_axis!r} has extent {axis_size}; a "
+            f"{spec.stages}-stage pipeline needs extent {spec.stages} "
+            f"(real stage submeshes) or 1 (virtual stages on one mesh)"
+        )
+    if global_batch % spec.microbatches != 0:
+        return (
+            f"global batch {global_batch} does not divide into "
+            f"{spec.microbatches} microbatches"
+        )
+    chain = select_pipeline_chain(layers, spec.stages)
+    if chain is None:
+        return (
+            f"no repeated-block chain divides into {spec.stages} stages "
+            f"(stage legality comes from blocks.py chains)"
+        )
+    # shared operands that are batch-shaped would have to travel the
+    # pipeline with their microbatch — declined (closure-captured
+    # operands must be batch-invariant, e.g. an attention mask of shape
+    # (1, S, S) or a scalar)
+    guid_t = {}
+    for block in chain.layers:
+        for l in block:
+            for t in l.inputs:
+                guid_t[t.guid] = t
+    for g in chain.shared_guids:
+        t = guid_t.get(g)
+        if t is not None and t.ndim >= 1 and t.shape[0] == global_batch:
+            return (
+                f"chain shared operand {t.name!r} is batch-shaped "
+                f"({t.shape}); per-microbatch shared operands cannot "
+                f"ride the scan closure"
+            )
+    return None
+
+
+def attach_pipeline_from_config(strategy, layers, cfg, graph_inputs):
+    """``--pipeline S``/``auto`` without a search: attach a spec to a
+    hand-built / imported / data-parallel strategy when legal (the
+    search path attaches specs itself, priced).  Mutates ``strategy``
+    in place; returns the reason string when declined, None on success
+    or when the flag is off."""
+    mode = str(getattr(cfg, "pipeline", "off"))
+    if mode == "off" or strategy.pipeline is not None:
+        return None
+    batch = graph_inputs[0].shape[0] if graph_inputs else 0
+    mesh = strategy.mesh
+    # stage axis: a dcn/data axis whose extent can carry the stages,
+    # falling back to virtual stages (extent 1) on a single device
+    if mode == "auto":
+        cands = [
+            a for a, s in zip(mesh.axis_names, mesh.shape) if s > 1
+        ] or [mesh.axis_names[0]]
+        axis = cands[0]
+        stages = max(2, mesh.axis_size(axis))
+    else:
+        stages = int(mode)
+        axis = next(
+            (a for a, s in zip(mesh.axis_names, mesh.shape) if s == stages),
+            mesh.axis_names[0] if mesh.size == 1 else None,
+        )
+        if axis is None:
+            # no axis carries exactly S stages; virtual stages need a
+            # fully size-1 view of SOME axis
+            axis = next(
+                (a for a, s in zip(mesh.axis_names, mesh.shape) if s == 1),
+                None,
+            )
+            if axis is None:
+                return (
+                    f"--pipeline {stages}: no mesh axis of extent "
+                    f"{stages} or 1 on mesh {tuple(mesh.shape)}"
+                )
+    mb = int(getattr(cfg, "microbatches", 0)) or min(4, max(1, batch))
+    while mb > 1 and batch % mb:
+        mb -= 1
+    spec = PipelineSpec(stages=stages, microbatches=mb, stage_axis=axis)
+    reason = validate_pipeline(spec, layers, mesh, batch)
+    if reason is not None:
+        return reason
+    strategy.pipeline = spec
+    return None

@@ -171,6 +171,14 @@ class OpSharding:
         self.__dict__.pop("_key_memo", None)
         self.extras[name] = value
 
+    def sharding_key(self) -> tuple:
+        """Value identity of the SHARDING decision only — ``key()``
+        minus the pipeline ``stage`` tag.  The uniformity checks that
+        gate scan-stacking and collapsed pricing compare THIS: a chain
+        whose depths differ only in stage assignment (the pipeline
+        tier's per-op tags) is still one uniformly-sharded block."""
+        return self.key()[:4]
+
     def copy(self) -> "OpSharding":
         return OpSharding(
             output=list(self.output),
@@ -201,6 +209,16 @@ class Strategy:
         # set by unity_search(objective="serve"): the ServeObjective's
         # pricing of this placement (tok_s / p99_ms / feasible / ...)
         self.serve_price: Optional[Dict] = None
+        # pipeline dimension (docs/PIPELINE.md): stages x microbatches
+        # over a mesh axis, set by the search's pipeline tier (priced —
+        # see search/cost.py estimate_pipeline_step_time) or attached
+        # from --pipeline for hand-built strategies.  The executor runs
+        # the 1F1B schedule when set; None is the non-pipelined step.
+        # Serialized and round-tripped by to_json/from_json.
+        self.pipeline = None  # Optional[parallel.pipeline.PipelineSpec]
+        # the pipeline tier's pricing detail for THIS winner (step_s,
+        # bubble_frac, stage_s, xfer_s, ...) — observability only
+        self.pipeline_price: Optional[Dict] = None
         # the search's priced cost for THIS strategy (seconds per
         # training step / per decode step, calibration-corrected when a
         # CalibrationStore was active) — threaded into every ffmetrics/1
@@ -246,6 +264,11 @@ class Strategy:
         return json.dumps(
             {
                 "mesh": {"shape": list(self.mesh.shape), "axes": list(self.mesh.axis_names)},
+                **(
+                    {"pipeline": self.pipeline.to_dict()}
+                    if self.pipeline is not None
+                    else {}
+                ),
                 "structural_rewrites": [
                     {"rule": r, "layers": list(ls)}
                     for r, ls in self.applied_detail
@@ -270,6 +293,10 @@ class Strategy:
         d = json.loads(text)
         mesh = MachineMesh(tuple(d["mesh"]["shape"]), tuple(d["mesh"]["axes"]))
         st = Strategy(mesh)
+        if d.get("pipeline"):
+            from flexflow_tpu.parallel.pipeline import PipelineSpec
+
+            st.pipeline = PipelineSpec.from_dict(d["pipeline"])
         rw = d.get("structural_rewrites") or []
         if rw and isinstance(rw[0], dict):
             st.applied_detail = tuple(

@@ -134,14 +134,26 @@ class Executor:
             for c in detect_block_chains(layers, min_depth=min_depth):
                 if not self._chain_executable(c):
                     continue
-                self._block_chains.append(c)
-                for j, tl in enumerate(c.template):
-                    if not self._wspecs[int(tl.layer_guid)]:
-                        continue
-                    members = [c.layers[d][j].name for d in range(c.depth)]
-                    self._bucket_members[tl.name] = members
-                    for d, m in enumerate(members):
-                        self._stacked_slices[m] = (tl.name, d)
+                self._register_chain(c)
+        # --- pipeline parallelism (docs/PIPELINE.md): when the strategy
+        # carries a PipelineSpec, ONE chain runs the microbatched 1F1B
+        # schedule — a lax.scan over M + S - 1 ticks whose activation
+        # handoff between stage submeshes is a ppermute over the stage
+        # axis.  The pipelined chain rides the stacked-param machinery
+        # (checkpoints stay per-layer either way), so pipelining forces
+        # stacking for THAT chain even under --stack-blocks off.
+        self.pipeline = None
+        self._pipeline_chain: Optional[BlockChain] = None
+        spec = getattr(strategy, "pipeline", None)
+        if spec is not None:
+            reason = self._setup_pipeline(spec)
+            if reason is not None and jax.process_index() == 0:
+                print(f"[pipeline] declined at executor: {reason}")
+            if self.pipeline is not None and self.pipeline.stage_axis == "data":
+                # the stage axis is consumed by the schedule: batch rows
+                # are not data-sharded over it, so ZeRO-1's "shard
+                # moments over every data replica" premise is gone
+                self.zero1 = False
         # execution plan: plain layers interleaved with BlockChain segments
         if self._block_chains:
             chain_at = {c.start: c for c in self._block_chains}
@@ -217,11 +229,27 @@ class Executor:
         self._input_pspec_cache[t.guid] = ps
         return ps
 
+    def _data_shard_ok(self) -> bool:
+        """May the batch dim default-shard over 'data'?  Not when a
+        pipeline consumes it as the stage axis — microbatches flow
+        THROUGH the stage submeshes, they are not split across them —
+        and not when the per-microbatch row count B/M no longer divides
+        the axis (each microbatch travels the schedule as its own batch
+        dim; a non-dividing shard would reshard every tick)."""
+        dp = self.strategy.mesh.axis_size("data")
+        if self.pipeline is not None:
+            if self.pipeline.stage_axis == "data":
+                return False
+            b = self.graph_inputs[0].shape[0] if self.graph_inputs else 0
+            if (b // self.pipeline.microbatches) % dp != 0:
+                return False
+        return dp > 1
+
     def _input_pspec_uncached(self, t: Tensor) -> PartitionSpec:
         declared = self._declared_input_sharding(t)
         if declared is not None:
             return declared.partition_spec()
-        if self.strategy.mesh.axis_size("data") > 1 and t.shape[0] % self.strategy.mesh.axis_size("data") == 0:
+        if self._data_shard_ok() and t.shape[0] % self.strategy.mesh.axis_size("data") == 0:
             return PartitionSpec("data")
         return PartitionSpec()
 
@@ -271,9 +299,16 @@ class Executor:
         new_state: Dict[str, Dict[str, jax.Array]] = {}
         for seg in self._segments:
             if isinstance(seg, BlockChain):
-                self._trace_block_scan(
-                    seg, values, shardings, params, training, rng, seq_length
-                )
+                if seg is self._pipeline_chain:
+                    self._trace_pipeline_scan(
+                        seg, values, shardings, params, training, rng,
+                        seq_length,
+                    )
+                else:
+                    self._trace_block_scan(
+                        seg, values, shardings, params, training, rng,
+                        seq_length,
+                    )
                 continue
             self._trace_layer(
                 seg, values, shardings, params, state, training, rng,
@@ -404,17 +439,120 @@ class Executor:
                 ):
                     return False
         for j in range(chain.block_len):
+            # sharding_key: per-depth pipeline stage tags are NOT a
+            # sharding difference (the scan body is stage-agnostic)
             keys = {
                 (
                     None
                     if self.strategy.op_sharding(chain.layers[d][j]) is None
-                    else self.strategy.op_sharding(chain.layers[d][j]).key()
+                    else self.strategy.op_sharding(
+                        chain.layers[d][j]
+                    ).sharding_key()
                 )
                 for d in range(chain.depth)
             }
             if len(keys) != 1:
                 return False
         return True
+
+    def _register_chain(self, c: BlockChain) -> None:
+        """Adopt one chain into the scan-stacked execution plan: record
+        it and route its member weights into depth-stacked buckets."""
+        if any(x.start == c.start for x in self._block_chains):
+            return
+        self._block_chains.append(c)
+        for j, tl in enumerate(c.template):
+            if not self._wspecs[int(tl.layer_guid)]:
+                continue
+            members = [c.layers[d][j].name for d in range(c.depth)]
+            self._bucket_members[tl.name] = members
+            for d, m in enumerate(members):
+                self._stacked_slices[m] = (tl.name, d)
+
+    def _setup_pipeline(self, spec) -> Optional[str]:
+        """Adopt the strategy's PipelineSpec: find the chain it runs
+        over, force that chain into the stacked plan, and record the
+        spec.  Returns the decline reason (the run then falls back to
+        the non-pipelined step) or None on success.  The legality rules
+        mirror ``parallel.pipeline.validate_pipeline`` plus the
+        executor-only constraints (stage axis unused by the chain's
+        shardings, executable scan body)."""
+        from flexflow_tpu.parallel.pipeline import select_pipeline_chain
+
+        mm = self.strategy.mesh
+        axis_size = mm.axis_size(spec.stage_axis)
+        if axis_size not in (1, spec.stages):
+            return (
+                f"stage axis {spec.stage_axis!r} extent {axis_size} "
+                f"matches neither {spec.stages} (real submeshes) nor 1 "
+                f"(virtual stages)"
+            )
+        batch = self.graph_inputs[0].shape[0] if self.graph_inputs else 0
+        if batch <= 0 or batch % spec.microbatches:
+            return (
+                f"global batch {batch} not divisible into "
+                f"{spec.microbatches} microbatches"
+            )
+        chain = select_pipeline_chain(self.layers, spec.stages)
+        if chain is None:
+            return (
+                f"no repeated-block chain divides into {spec.stages} "
+                f"stages"
+            )
+        if not self._chain_executable(chain):
+            return "chain not scan-executable (stateful/aux-loss/non-uniform)"
+        # shared operands must be batch-invariant: a (B, ...) operand
+        # would have to travel the schedule with its microbatch
+        guid_t = {
+            t.guid: t
+            for block in chain.layers for l in block for t in l.inputs
+        }
+        for g in chain.shared_guids:
+            t = guid_t.get(g)
+            if t is not None and t.ndim >= 1 and t.shape[0] == batch:
+                return f"chain shared operand {t.name!r} is batch-shaped"
+        # the stage axis is consumed by the schedule: the chain's own
+        # shardings (and its carry activation) must not also use it
+        if axis_size > 1:
+            for block in chain.layers:
+                for l in block:
+                    s = self.strategy.op_sharding(l)
+                    if s is None:
+                        continue
+                    used = set()
+                    for ts in list(s.output) + [
+                        v for v in s.weights.values()
+                    ] + [t for t in s.inputs if t is not None]:
+                        used |= set(ts.used_axes())
+                        used |= set(ts.partial_axes)
+                    if spec.stage_axis in used:
+                        return (
+                            f"layer {l.name!r} shards over the stage "
+                            f"axis {spec.stage_axis!r}"
+                        )
+        # reuse the already-registered chain object when --stack-blocks
+        # detected the same run (segments are keyed by object identity);
+        # a DIFFERENT overlapping chain would double-register layers
+        existing = next(
+            (
+                x for x in self._block_chains
+                if x.start < chain.end and chain.start < x.end
+            ),
+            None,
+        )
+        if existing is not None:
+            if (
+                existing.start != chain.start
+                or existing.block_len != chain.block_len
+                or existing.depth != chain.depth
+            ):
+                return "pipeline chain overlaps a differently-stacked chain"
+            chain = existing
+        else:
+            self._register_chain(chain)
+        self.pipeline = spec
+        self._pipeline_chain = chain
+        return None
 
     def _trace_block_scan(
         self,
@@ -454,6 +592,37 @@ class Executor:
         }
         carry0 = values[chain.carry_in_guid]
         out_sh_box: Dict[int, TensorSharding] = {}
+        body = self._chain_scan_body(
+            chain, values, shardings, training, rng, seq_length, out_sh_box
+        )
+
+        with get_tracer().span(
+            "block_scan", cat="step", level="op", depth=depth, layers=L,
+        ):
+            carry, _ = jax.lax.scan(body, carry0, (crcs, xs_params))
+        values[chain.out_guid] = carry
+        out_t = chain.layers[-1][-1].outputs[0]
+        shardings[chain.out_guid] = out_sh_box.get(
+            chain.template_out_guid, TensorSharding.replicated(out_t.ndim)
+        )
+
+    def _chain_scan_body(
+        self,
+        chain: BlockChain,
+        values: Dict[int, jax.Array],
+        shardings: Dict[int, TensorSharding],
+        training: bool,
+        rng: Optional[jax.Array],
+        seq_length: Optional[int],
+        out_sh_box: Dict[int, TensorSharding],
+    ):
+        """The ONE-block scan body shared by ``_trace_block_scan`` and the
+        pipelined ``_trace_pipeline_scan``: trace the TEMPLATE block over
+        ``(carry, (crc_row, per-depth params))``, with shared operands
+        closure-captured from ``values`` and per-depth dropout keys
+        derived from the member-name crc32 xs (bit-parity with the
+        unrolled per-layer ``fold_in``)."""
+        tmpl = chain.template
 
         def body(carry, x):
             crc_row, p_d = x
@@ -478,15 +647,165 @@ class Executor:
             out_sh_box.update(shs)
             return vals[chain.template_out_guid], None
 
+        return body
+
+    def _trace_pipeline_scan(
+        self,
+        chain: BlockChain,
+        values: Dict[int, jax.Array],
+        shardings: Dict[int, TensorSharding],
+        params: Dict[str, Dict[str, jax.Array]],
+        training: bool,
+        rng: Optional[jax.Array],
+        seq_length: Optional[int],
+    ) -> None:
+        """Trace the pipelined chain as the microbatched 1F1B schedule
+        (docs/PIPELINE.md).  The realization is GSPMD-native: one
+        ``lax.scan`` over the ``M + S - 1`` schedule ticks whose carry is
+        the per-stage activation buffer ``(S, b, ...)`` with dim 0
+        sharded over the stage axis.  Each tick
+
+          1. hands activations off — ``concat(mb_t, buf[:-1])`` shifts
+             every stage's output to its successor, which XLA lowers to
+             a collective-permute across the stage submeshes (the
+             microbatch-sized point-to-point transfer the cost model's
+             ``_stage_handoff_time`` prices); the new microbatch enters
+             at stage 0;
+          2. computes ALL stages at once — a ``vmap`` over the stage dim
+             applies stage ``s``'s ``depth/S`` blocks (an inner scan
+             over the per-stage slice of the depth-stacked params) to
+             its current microbatch; because buffer and params are both
+             stage-sharded on dim 0, every submesh computes only its own
+             stage (SPMD realizes MPMD, the praxis pipelining idiom);
+          3. emits the last stage's output — valid from tick ``S - 1``.
+
+        Microbatch ``m``'s logits surface at tick ``m + S - 1``; the
+        discarded warmup/drain outputs are the ``(S-1)/(M+S-1)`` bubble.
+        Reverse-mode autodiff runs the scan backward, so gradients
+        accumulate on device across microbatches — no host syncs are
+        added anywhere.  Warmup/drain lanes carry zeros whose outputs
+        (and therefore cotangents) are discarded.
+
+        Virtual stages (stage axis extent 1, e.g. single device) run the
+        exact same program without the collective — the schedule is then
+        a pure microbatching transform, which is what the parity tests
+        pin against the non-pipelined step."""
+        spec = self.pipeline
+        S, M = spec.stages, spec.microbatches
+        depth, L = chain.depth, chain.block_len
+        per = depth // S
+        real = self.strategy.mesh.axis_size(spec.stage_axis) == S
+        stage_ps = spec.stage_axis if real else None
+
+        carry0 = values[chain.carry_in_guid]
+        B = carry0.shape[0]
+        b = B // M
+        carry_sh = shardings.get(
+            chain.carry_in_guid, TensorSharding.replicated(carry0.ndim)
+        )
+        buf_spec = PartitionSpec(stage_ps, *carry_sh.spec)
+
+        out_sh_box: Dict[int, TensorSharding] = {}
+        body = self._chain_scan_body(
+            chain, values, shardings, training, rng, seq_length, out_sh_box
+        )
+
+        # per-(depth, position) member-name crc32 rows (the unrolled
+        # path's dropout-key fold targets), regrouped per stage
+        crcs = np.asarray(
+            [
+                [
+                    zlib.crc32(chain.layers[d][j].name.encode()) % (2**31)
+                    for j in range(L)
+                ]
+                for d in range(depth)
+            ],
+            np.uint32,
+        ).reshape(S, per, L)
+        # depth-stacked params regrouped (depth, ...) -> (S, per, ...):
+        # dim 0 was stage-sharded by _stack_param_buckets, so the reshape
+        # is layout-local (each submesh keeps its own depth slice)
+        xs_params = {
+            tl.name: params[tl.name]
+            for tl in chain.template
+            if tl.name in params
+        }
+        stage_params = jax.tree.map(
+            lambda a: a.reshape((S, per) + tuple(a.shape[1:])), xs_params
+        )
+
+        def stage_fn(p_stage, crc_stage, x):
+            y, _ = jax.lax.scan(body, x, (crc_stage, p_stage))
+            return y
+
+        vstages = jax.vmap(stage_fn, in_axes=(0, 0, 0))
+
+        # microbatch stream padded with S-1 drain ticks
+        mbs = carry0.reshape((M, b) + tuple(carry0.shape[1:]))
+        pad = jnp.zeros((S - 1, b) + tuple(carry0.shape[1:]), carry0.dtype)
+        xs_mb = jnp.concatenate([mbs, pad], axis=0)
+        buf0 = self._constrain(
+            jnp.zeros((S, b) + tuple(carry0.shape[1:]), carry0.dtype),
+            buf_spec,
+        )
+
+        if real and self.mesh is not None:
+            # activation handoff between REAL stage submeshes: an
+            # explicit ppermute inside shard_map over the stage axis —
+            # stage s's buffer moves to stage s+1, the fresh microbatch
+            # enters at stage 0.  Explicit because it is the semantic
+            # (ISSUE 8 / ROADMAP #2: "collective permutes between stage
+            # meshes") and because GSPMD's lowering of the equivalent
+            # concat(mb[None], buf[:-1]) shift produces WRONG VALUES on
+            # the CPU backend when the mesh carries further axes
+            # (verified miscompile; the ppermute path is exact).
+            from flexflow_tpu._compat import shard_map
+
+            mesh_ = self.mesh
+            axis_ = spec.stage_axis
+            mb_spec = PartitionSpec(*carry_sh.spec)
+
+            def _shift(buf, mb_t):
+                def local(bl, ml):
+                    moved = jax.lax.ppermute(
+                        bl, axis_, [(i, i + 1) for i in range(S - 1)]
+                    )
+                    idx = jax.lax.axis_index(axis_)
+                    return jnp.where(idx == 0, ml[None], moved)
+
+                return shard_map(
+                    local, mesh=mesh_,
+                    in_specs=(buf_spec, mb_spec), out_specs=buf_spec,
+                    check_rep=False,
+                )(buf, mb_t)
+        else:
+            def _shift(buf, mb_t):
+                return self._constrain(
+                    jnp.concatenate([mb_t[None], buf[:-1]], axis=0),
+                    buf_spec,
+                )
+
+        def tick(buf, mb):
+            # stage s's input <- stage s-1's output; microbatch enters
+            # at stage 0 (the 1F1B handoff)
+            shifted = _shift(buf, mb)
+            out = self._constrain(vstages(stage_params, crcs, shifted), buf_spec)
+            return out, out[-1]
+
         with get_tracer().span(
-            "block_scan", cat="step", level="op", depth=depth, layers=L,
+            "pipeline_scan", cat="step", level="op",
+            stages=S, microbatches=M, depth=depth, layers=L,
         ):
-            carry, _ = jax.lax.scan(body, carry0, (crcs, xs_params))
-        values[chain.out_guid] = carry
+            _, ys = jax.lax.scan(tick, buf0, xs_mb)
+        # microbatch m's output surfaces at tick m + S - 1; reassemble
+        # the global batch in row order
+        out = ys[S - 1:].reshape((B,) + tuple(ys.shape[2:]))
         out_t = chain.layers[-1][-1].outputs[0]
-        shardings[chain.out_guid] = out_sh_box.get(
+        out_sh = out_sh_box.get(
             chain.template_out_guid, TensorSharding.replicated(out_t.ndim)
         )
+        values[chain.out_guid] = self._constrain(out, out_sh.partition_spec())
+        shardings[chain.out_guid] = out_sh
 
     # --- param init --------------------------------------------------------
     def init_params(self, key: Optional[jax.Array] = None) -> None:
@@ -537,6 +856,18 @@ class Executor:
         """Collapse per-member param buckets into (depth, ...) stacked
         arrays keyed by the template layer name (no-op without chains)."""
         for c in self._block_chains:
+            # pipelined chain: the depth dim is ALSO the stage dim —
+            # stage s's params live on stage submesh s, so dim 0 of the
+            # (depth, ...) stack shards over the stage axis (depth is a
+            # multiple of S by stage-partition legality)
+            stage_axis = None
+            if (
+                c is self._pipeline_chain
+                and self.pipeline is not None
+                and self.strategy.mesh.axis_size(self.pipeline.stage_axis)
+                == self.pipeline.stages
+            ):
+                stage_axis = self.pipeline.stage_axis
             for j, tl in enumerate(c.template):
                 ws = self._wspecs[int(tl.layer_guid)]
                 if not ws:
@@ -553,7 +884,8 @@ class Executor:
                         s = jax.device_put(
                             s,
                             NamedSharding(
-                                self.mesh, PartitionSpec(None, *tuple(ps))
+                                self.mesh,
+                                PartitionSpec(stage_axis, *tuple(ps)),
                             ),
                         )
                     stacked[w.name] = s
@@ -1034,6 +1366,20 @@ class Executor:
             "compile_s": compile_s,
             "jit_cache": "miss" if compile_s else "hit",
         }
+        if self.pipeline is not None:
+            # pipeline dimension of this step (ffmetrics/1 nullable
+            # fields + the pipeline.bubble_s counter): bubble seconds =
+            # measured device wall x the schedule's (S-1)/(M+S-1) idle
+            # fraction — the wall-clock the warmup/drain lanes spent on
+            # discarded compute (docs/PIPELINE.md, "Bubble math")
+            bf = self.pipeline.bubble_frac
+            self.last_step_stats.update(
+                pipeline_stages=self.pipeline.stages,
+                microbatches=self.pipeline.microbatches,
+                bubble_frac=bf,
+            )
+            if tracer.enabled:
+                tracer.counter("pipeline.bubble_s", device_s * bf)
         # run-health monitor: feed the flight recorder / detectors.  The
         # float() fetches are the monitor's documented per-step cost (the
         # block_until_ready above already synced, so they are host copies
@@ -1130,7 +1476,7 @@ class Executor:
             return self._fwd_jit(self.params, self.state, inputs, seq_length)
 
     def _label_pspec(self) -> PartitionSpec:
-        if self.strategy.mesh.axis_size("data") > 1:
+        if self._data_shard_ok():
             return PartitionSpec("data")
         return PartitionSpec()
 

@@ -519,6 +519,33 @@ def estimate_strategy_cost(
     (carry-in = the block's own output layout).  Identical totals to the
     unrolled walk, at per-unique-block instead of per-layer host cost
     (``flexflow_tpu.blocks``, docs/PERF.md)."""
+    total, _parts = estimate_strategy_parts(
+        layers, strategy, machine, lambda_mem=lambda_mem,
+        node_time_fn=node_time_fn, cost_cache=cost_cache,
+        collapse_blocks=collapse_blocks, forward_only=forward_only,
+    )
+    return total
+
+
+def estimate_strategy_parts(
+    layers: List[Layer],
+    strategy: Strategy,
+    machine: Optional[TPUMachineModel] = None,
+    lambda_mem: float = 0.0,
+    node_time_fn=None,
+    cost_cache: Optional[Dict] = None,
+    collapse_blocks: bool = True,
+    forward_only: bool = False,
+) -> Tuple[float, Dict[int, Dict]]:
+    """:func:`estimate_strategy_cost` with the collapsed-chain pricing
+    exposed: returns ``(total, parts)`` where ``parts`` maps each
+    collapsed chain's start index to ``{"chain", "first", "steady"}`` —
+    the chain object, the first block's cost at the real boundary
+    sharding, and the steady-state per-block cost.  The pipeline tier
+    (``estimate_pipeline_step_time``) reads these so stage enumeration
+    re-prices NOTHING per (stage count x microbatch count) — the whole
+    (S x M) sweep is arithmetic over one collapsed walk
+    (docs/PIPELINE.md, "Pricing")."""
     from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
     from flexflow_tpu.parallel.spec import TensorSharding
 
@@ -625,6 +652,7 @@ def estimate_strategy_cost(
             if _chain_assignment_uniform(ch, strategy):
                 chain_at[ch.start] = ch
 
+    parts: Dict[int, Dict] = {}
     idx, n = 0, len(layers)
     while idx < n:
         chain = chain_at.get(idx)
@@ -639,6 +667,9 @@ def estimate_strategy_cost(
         # wrongly exempt) and its producers resolve through the strategy
         steady = sum(layer_cost(l) for l in chain.layers[1])
         total += first + (chain.depth - 1) * steady
+        parts[chain.start] = {
+            "chain": chain, "first": first, "steady": steady,
+        }
         if chain.layers[-1][-1].op_type.is_parallel_op:
             # downstream consumers resolve the chain output through
             # pop_out exactly as they would after the unrolled walk;
@@ -651,7 +682,177 @@ def estimate_strategy_cost(
     # collective; surface them as tracer counters once per estimate
     if hasattr(m, "flush_decisions"):
         m.flush_decisions()
-    return total
+    return total, parts
+
+
+def stage_contended_machine(machine, stages: int):
+    """Machine view for pricing a stage SUBMESH whose collectives still
+    cross DCN while ``stages`` stages execute concurrently
+    (docs/PIPELINE.md, "Pricing").
+
+    A pipeline whose stage axis is NOT a ``dcn_axes`` member keeps the
+    slice-crossing factor inside every stage — so each tick, all S
+    stages issue their weight-grad / reshard collectives over the SAME
+    shared per-host uplinks.  The uplink is a physical resource: S
+    concurrent users divide its rate by S (the same ``dcn_contention``
+    semantics PR 3 introduced for concurrent slice-crossing
+    collectives).  A ``dcn_axes`` stage axis needs no clone — collapsing
+    it removed every DCN collective from the submesh, which is exactly
+    why slices-become-stages wins on cost.
+
+    Returns ``machine`` unchanged when there is nothing to contend."""
+    if machine is None or stages <= 1 or not getattr(machine, "dcn_axes", ()):
+        return machine
+    try:
+        from flexflow_tpu.parallel.network import NetworkedMachineModel
+    except ImportError:  # pragma: no cover - network module always ships
+        NetworkedMachineModel = ()
+    if NetworkedMachineModel and isinstance(machine, NetworkedMachineModel):
+        clone = NetworkedMachineModel(
+            slice_topology=machine.slice_topology,
+            num_slices=machine.num_slices,
+            hosts_per_slice=machine.hosts_per_slice,
+            peak_flops=machine.peak_flops,
+            hbm_bw=machine.hbm_bw,
+            dcn_bw_per_uplink=machine.dcn_bw_per_uplink,
+            dcn_uplinks_per_host=machine.dcn_uplinks_per_host,
+            dcn_latency=machine.dcn_latency,
+            dcn_contention=machine.dcn_contention * stages,
+            dcn_axes=machine.dcn_axes,
+            latency=machine.latency,
+        )
+        clone.source = machine.source
+        # share the routing tallies like for_mesh clones do
+        clone.decision_stats = machine.decision_stats
+        clone._flushed = machine._flushed
+        return clone
+    import copy
+
+    clone = copy.copy(machine)
+    clone.dcn_bw = machine.dcn_bw / stages
+    return clone
+
+
+def _stage_handoff_time(
+    machine: TPUMachineModel, nbytes_per_dev: float, axis: str, parallel: int
+) -> float:
+    """One inter-stage activation handoff: a ``ppermute`` moving each
+    device's microbatch shard to its peer in the next stage submesh —
+    point-to-point, NOT a collective, which is the whole reason
+    slices-become-stages wins on a multi-slice machine: the only bytes
+    crossing ``axis`` are microbatch-sized and every chip pair moves in
+    parallel.  ``parallel`` is the per-chip flow count crossing the
+    boundary (the stage submesh size)."""
+    if axis in machine.dcn_axes:
+        lat = machine.dcn_latency
+        agg = getattr(machine, "_slice_dcn_bw", None)
+        if agg is not None:
+            # NetworkedMachineModel: m parallel flows engage up to
+            # hosts_per_slice uplink sets (same routing the hierarchical
+            # collective's DCN phase uses)
+            return lat + nbytes_per_dev * max(1, parallel) / agg(parallel)
+        return lat + nbytes_per_dev / machine.dcn_bw
+    return machine.latency + nbytes_per_dev / machine.ici_bw
+
+
+def estimate_pipeline_step_time(
+    layers: List[Layer],
+    strategy: Strategy,
+    machine: Optional[TPUMachineModel],
+    *,
+    chain,
+    stages: int,
+    microbatches: int,
+    stage_axis: str,
+    sub_total: Optional[float] = None,
+    sub_parts: Optional[Dict[int, Dict]] = None,
+    lambda_mem: float = 0.0,
+    node_time_fn=None,
+    cost_cache: Optional[Dict] = None,
+) -> Optional[Dict[str, float]]:
+    """1F1B pipelined step-time estimate (docs/PIPELINE.md, "Pricing").
+
+    ``strategy`` is the STAGE-SUBMESH assignment (the stage axis has
+    extent 1 in ``strategy.mesh``) — weight-grad allreduces and reshard
+    collectives are therefore priced intra-stage only, which is exactly
+    what pipelining buys: params live on one stage, so no gradient ever
+    crosses the stage axis.  The chain portion of the submesh estimate
+    is replaced by the schedule:
+
+      ``(M + S - 1) x (per-microbatch stage time + handoff)``
+
+    with per-microbatch stage time ``(depth/S) x block_cost / M`` (the
+    roofline is byte/flop-linear, so a 1/M microbatch prices at 1/M —
+    the latency floor is absorbed by the handoff term) and the
+    warmup/drain bubble ``(S-1)/(M+S-1)`` falling out of the tick count.
+    Non-chain prologue/epilogue layers run per-step at full batch,
+    replicated over the stage axis, and keep their submesh price.
+
+    ``sub_total``/``sub_parts`` short-circuit the collapsed walk when
+    the caller already ran :func:`estimate_strategy_parts` — the (S x M)
+    sweep then re-prices nothing.  Returns None when the chain was not
+    collapsed under this strategy (non-uniform assignment — no legal
+    scan, no legal pipeline)."""
+    if sub_total is None or sub_parts is None:
+        sub_total, sub_parts = estimate_strategy_parts(
+            layers, strategy, machine, lambda_mem=lambda_mem,
+            node_time_fn=node_time_fn, cost_cache=cost_cache,
+            collapse_blocks=True,
+        )
+    part = sub_parts.get(chain.start)
+    if part is None:
+        return None
+    depth = part["chain"].depth
+    chain_cost = part["first"] + (depth - 1) * part["steady"]
+    remainder = max(0.0, sub_total - chain_cost)
+    avg_block = chain_cost / depth
+    ticks = microbatches + stages - 1
+    m = machine or TPUMachineModel()
+    # per-microbatch stage time: the roofline is byte/flop-linear so a
+    # 1/M microbatch prices at 1/M — DOWN TO the dispatch floor of one
+    # kernel latency per op per tick.  Without the floor the degenerate
+    # S=depth, M=batch corner (single-row microbatches through
+    # single-block stages) prices as free and wins every sweep.
+    per_stage_ops = (depth // stages) * part["chain"].block_len
+    stage_s = max(
+        (depth // stages) * avg_block / microbatches,
+        per_stage_ops * m.latency,
+    )
+    # handoff bytes: the carry tensor's per-device microbatch shard
+    out_t = part["chain"].layers[0][-1].outputs[0]
+    sh = None
+    os_ = strategy.op_sharding(part["chain"].layers[0][-1])
+    if os_ is not None and os_.output:
+        sh = os_.output[0]
+    shard_deg = max(1, sh.total_degree(strategy.mesh)) if sh is not None else 1
+    nbytes = (
+        float(math.prod(out_t.shape)) * _dtype_nbytes(out_t.dtype)
+        / microbatches / shard_deg
+    )
+    xfer_s = _stage_handoff_time(m, nbytes, stage_axis, strategy.mesh.size)
+    # the handoff is point-to-point and OVERLAPS the next tick's stage
+    # compute (the PipeDream/GPipe steady-state assumption — while stage
+    # s computes microbatch i, microbatch i+1's activation is already in
+    # flight), so a tick costs max(compute, transfer), not the sum; one
+    # unoverlapped handoff remains at the schedule head.  This is what
+    # makes slices-become-stages rational on a multi-slice machine: a
+    # DCN handoff hidden under a fat intra-slice stage is free, while a
+    # DCN COLLECTIVE inside a stage is paid every block.
+    tick_s = max(stage_s, xfer_s)
+    pipe_s = ticks * tick_s + xfer_s
+    step_s = remainder + pipe_s
+    return {
+        "step_s": step_s,
+        "bubble_frac": (stages - 1) / ticks,
+        "bubble_s": (stages - 1) * tick_s,
+        "stage_s": stage_s,
+        "xfer_s": xfer_s,
+        "pipe_s": pipe_s,
+        "remainder_s": remainder,
+        "chain_s_unpipelined": chain_cost,
+        "stages": float(stages),
+        "microbatches": float(microbatches),
+    }
 
 
 def estimate_decode_step_time(
@@ -750,12 +951,14 @@ def estimate_decode_step_time(
 
 def _chain_assignment_uniform(chain, strategy: Strategy) -> bool:
     """Every repeat of the chain carries the same per-position OpSharding
-    (the precondition for price-once-multiply)."""
+    (the precondition for price-once-multiply).  Compared by
+    ``sharding_key()``: per-depth pipeline stage tags price identically
+    (stage membership changes WHERE a block runs, not what it costs)."""
     for j in range(chain.block_len):
         keys = set()
         for d in range(chain.depth):
             s = strategy.op_sharding(chain.layers[d][j])
-            keys.add(None if s is None else s.key())
+            keys.add(None if s is None else s.sharding_key())
         if len(keys) != 1:
             return False
     return True

@@ -386,3 +386,89 @@ class SearchHelper:
         st = Strategy(self.mesh)
         st.ops = dict(assign)
         return st
+
+
+def sweep_pipeline_axis(
+    layers: List[Layer],
+    sub_strategy: Strategy,
+    machine: Optional[TPUMachineModel],
+    stage_axis: str,
+    stages: int,
+    global_batch: int,
+    microbatches: Optional[int] = None,
+    lambda_mem: float = 0.0,
+    node_time_fn=None,
+    cost_cache: Optional[Dict] = None,
+):
+    """The (stage count x microbatch count) axis of the search
+    (docs/PIPELINE.md): price every microbatch candidate for a
+    ``stages``-stage pipeline over ``stage_axis``, given the DP's
+    stage-SUBMESH winner ``sub_strategy``.
+
+    One :func:`~flexflow_tpu.search.cost.estimate_strategy_parts` walk
+    (collapsed-chain pricing — per unique block, never unrolled) feeds
+    the whole sweep; each (S, M) point after that is arithmetic, which
+    is what keeps the pipeline axis inside the 2x wall-clock bound of
+    the block-collapsed search (ISSUE 8 acceptance).  Returns
+    ``(PipelineSpec, price dict, chain)`` for the cheapest microbatch
+    count, or None when no chain divides into ``stages`` stages / the
+    chain did not collapse under this assignment.
+    """
+    from flexflow_tpu.parallel.pipeline import (
+        PipelineSpec,
+        microbatch_candidates,
+        select_pipeline_chain,
+    )
+    from flexflow_tpu.search.cost import (
+        estimate_pipeline_step_time,
+        estimate_strategy_parts,
+        stage_contended_machine,
+    )
+
+    # min_depth=4 matches the estimator's collapse threshold: a chain the
+    # collapsed walk did not price has no parts to reuse
+    chain = select_pipeline_chain(layers, stages, min_depth=4)
+    if chain is None:
+        return None
+    # a NON-dcn stage axis leaves the slice-crossing factor inside every
+    # stage: all S stages then contend for the same DCN uplinks each
+    # tick, so the submesh prices under S-way DCN contention.  A
+    # dcn_axes stage axis collapsed the DCN factor away — no contention,
+    # which is the cost-level statement of "slices become stages".
+    pricing_machine = machine
+    if machine is not None and stage_axis not in getattr(
+        machine, "dcn_axes", ()
+    ):
+        pricing_machine = stage_contended_machine(machine, stages)
+    sub_total, sub_parts = estimate_strategy_parts(
+        layers, sub_strategy, pricing_machine, lambda_mem=lambda_mem,
+        node_time_fn=node_time_fn, cost_cache=cost_cache,
+    )
+    cands = (
+        [microbatches]
+        if microbatches
+        else microbatch_candidates(global_batch)
+    )
+    best = None
+    for mb in cands:
+        if mb < 1 or global_batch % mb:
+            continue
+        price = estimate_pipeline_step_time(
+            layers, sub_strategy, pricing_machine,
+            chain=chain, stages=stages, microbatches=mb,
+            stage_axis=stage_axis,
+            sub_total=sub_total, sub_parts=sub_parts,
+            lambda_mem=lambda_mem, node_time_fn=node_time_fn,
+            cost_cache=cost_cache,
+        )
+        if price is None:
+            return None
+        if best is None or price["step_s"] < best[1]["step_s"]:
+            best = (
+                PipelineSpec(
+                    stages=stages, microbatches=mb, stage_axis=stage_axis
+                ),
+                price,
+                chain,
+            )
+    return best

@@ -90,6 +90,29 @@ def strategy_memory_per_device(
     return total
 
 
+def chain_weight_bytes(
+    chain, strategy: Strategy, optimizer_state_factor: float = 3.0
+) -> float:
+    """Per-device bytes of a repeated-block chain's weights (+grad/
+    moment slots) under ``strategy`` — the share a pipeline stage drops:
+    stage ``s`` of an S-stage schedule holds only depth/S of these, so a
+    pipelined variant's footprint is the full estimate minus
+    ``(1 - 1/S)`` of this term (docs/PIPELINE.md, "Memory")."""
+    mesh = strategy.mesh
+    total = 0.0
+    for block in chain.layers:
+        for l in block:
+            opdef = get_op_def(l.op_type)
+            s = strategy.op_sharding(l)
+            for w in opdef.weights(l):
+                wb = math.prod(w.shape) * _dtype_bytes(w.dtype)
+                ws = s.weights.get(w.name) if s else None
+                deg = ws.total_degree(mesh) if ws else 1
+                factor = optimizer_state_factor if w.trainable else 1.0
+                total += wb * factor / deg
+    return total
+
+
 def optimize_with_memory_budget(
     optimize_fn,
     layers: List[Layer],

@@ -26,6 +26,127 @@ from flexflow_tpu.search.substitution import graph_optimize
 from flexflow_tpu.tensor import Layer
 
 
+def _pipeline_variants(
+    mv, layers, graph_inputs, machine, budget, alpha, beam,
+    extra_xfers, struct_xfers, inference, forced_stages,
+    microbatches, global_batch, submesh_memo, make_ntf,
+    mem_budget_bytes=None,
+):
+    """Best 1F1B pipelined candidate for mesh ``mv`` (docs/PIPELINE.md):
+    for each axis of extent ``S >= 2`` (all of them when ``forced_stages``
+    is None, else exactly that extent), solve the stage SUBMESH — the
+    mesh with that axis collapsed to 1, so weight-grad sync and reshard
+    collectives price intra-stage only — once per distinct submesh shape
+    (``submesh_memo``), then run the (S x M) sweep over the solve's
+    collapsed-chain parts.  Multi-slice machines need no special-casing
+    to prefer ``dcn_axes``: collapsing the DCN-crossing axis removes
+    every DCN collective from the submesh price, so slices-become-stages
+    wins on cost alone.  Returns ``(step_s, Strategy)`` or None."""
+    from flexflow_tpu.obs import get_tracer
+    from flexflow_tpu.parallel.pipeline import (
+        stage_partition,
+        validate_pipeline,
+    )
+    from flexflow_tpu.search.dp import sweep_pipeline_axis
+
+    best = None
+    for axis, ssize in zip(mv.axis_names, mv.shape):
+        if ssize < 2:
+            continue
+        if forced_stages is not None and ssize != forced_stages:
+            continue
+        sub_shape = tuple(
+            1 if n == axis else s
+            for n, s in zip(mv.axis_names, mv.shape)
+        )
+        entry = submesh_memo.get(sub_shape)
+        if entry is None:
+            submesh = MachineMesh(sub_shape, mv.axis_names)
+            if machine is not None and not machine.legal_mesh(submesh):
+                submesh_memo[sub_shape] = False
+                continue
+            try:
+                with get_tracer().span(
+                    "search_stage_submesh", cat="search",
+                    mesh=str(sub_shape),
+                ):
+                    sub_res = graph_optimize(
+                        layers, graph_inputs, submesh, machine,
+                        budget=budget, alpha=alpha, beam=beam,
+                        lambda_mem=0.0, node_time_fn=make_ntf(submesh),
+                        extra_xfers=extra_xfers,
+                        struct_xfers=struct_xfers,
+                        inference=inference, return_joint=True,
+                    )
+            except ShardingError:
+                submesh_memo[sub_shape] = False
+                continue
+            entry = (submesh, sub_res)
+            submesh_memo[sub_shape] = entry
+        if entry is False:
+            continue
+        submesh, sub_res = entry
+        sub_layers = sub_res.layers if sub_res.layers is not layers else layers
+        sub_st = Strategy(submesh)
+        sub_st.ops = sub_res.assign
+        swept = sweep_pipeline_axis(
+            sub_layers, sub_st, machine, axis, ssize, global_batch,
+            microbatches=microbatches,
+        )
+        if swept is None:
+            continue
+        spec, pprice, chain = swept
+        if validate_pipeline(spec, sub_layers, mv, global_batch) is not None:
+            continue
+        # memory legality (the λ-search analog for the pipeline tier):
+        # a stage holds 1/S of the chain's weights but EVERYTHING else
+        # at the submesh's sharding — a replicate-the-model-per-stage
+        # variant that prices fast on the roofline still has to FIT.
+        # Without this check the degenerate S=depth, replicated-submesh
+        # corner wins every search the moment memory is unconstrained.
+        if mem_budget_bytes is not None:
+            from flexflow_tpu.search.memory import (
+                chain_weight_bytes,
+                strategy_memory_per_device,
+            )
+
+            pipe_mem = strategy_memory_per_device(
+                sub_layers, sub_st
+            ) - chain_weight_bytes(chain, sub_st) * (1.0 - 1.0 / spec.stages)
+            if pipe_mem > mem_budget_bytes:
+                get_tracer().counter("search.oom_rejections")
+                continue
+        pcost = pprice["step_s"]
+        if best is not None and pcost >= best[0]:
+            continue
+        st = Strategy(mv)
+        ops = dict(sub_res.assign)
+        # per-op stage tags on the chain members (the long-reserved
+        # OpSharding.stage field, serialized since PR 0): stage s owns
+        # depth slice [s*D/S, (s+1)*D/S) of the chain
+        for s_idx, (b0, b1) in enumerate(
+            stage_partition(chain, spec.stages)
+        ):
+            for d in range(b0, b1):
+                for l in chain.layers[d]:
+                    g = int(l.layer_guid)
+                    if g in ops:
+                        a = ops[g].copy()
+                        a.stage = s_idx
+                        ops[g] = a
+        st.ops = ops
+        if sub_res.layers is not layers:
+            st.rewritten_layers = sub_res.layers
+            st.output_remap = sub_res.remap
+            st.applied_rewrites = tuple(sub_res.applied)
+            st.applied_detail = tuple(sub_res.applied_detail)
+        st.pipeline = spec
+        st.pipeline_price = pprice
+        st.predicted_step_s = pcost
+        best = (pcost, st)
+    return best
+
+
 def _train_tokens(graph_inputs) -> int:
     """Tokens one training step of this graph moves (batch x seq of the
     first sequence-shaped input, else batch) — the scale factor the
@@ -56,6 +177,8 @@ def unity_search(
     objective: str = "train",
     serve=None,
     calibration=None,
+    pipeline: str = "off",
+    microbatches: Optional[int] = None,
 ) -> Strategy:
     """Pick the cheapest (mesh factorization, per-op sharding) pair.
 
@@ -107,6 +230,22 @@ def unity_search(
     The winner ALWAYS carries ``predicted_step_s`` (the raw DP estimate
     when no store is given) so every instrumented run pairs prediction
     with observation in its ffmetrics records.
+
+    ``pipeline``: the pipeline-parallel axis of the search
+    (docs/PIPELINE.md).  ``"off"`` (default) leaves every winner
+    byte-identical to the pre-pipeline search.  ``"auto"`` additionally
+    prices, for every mesh candidate and every mesh axis of extent
+    ``S >= 2`` whose repeated-block chain divides into ``S`` stages, a
+    1F1B pipelined variant: the stage submesh (that axis collapsed to 1)
+    is solved once by the same DP — memoized across meshes — and the
+    (stage count x microbatch count) sweep re-prices it arithmetically
+    (:func:`~flexflow_tpu.search.dp.sweep_pipeline_axis`).  A numeric
+    string forces that stage count.  On a multi-slice machine the
+    ``dcn_axes`` member wins naturally: stages-over-DCN replaces the
+    per-block DCN weight-grad allreduce with one microbatch-sized
+    point-to-point handoff.  ``microbatches`` pins M (None sweeps the
+    divisors of the global batch).  Winners carry
+    ``Strategy.pipeline``/``pipeline_price`` and per-op ``stage`` tags.
     """
     from flexflow_tpu.obs import get_tracer
     from flexflow_tpu.search.candidates import SearchOptions, search_options
@@ -125,7 +264,7 @@ def unity_search(
             layers, mesh, graph_inputs, budget, alpha, machine,
             mem_budget_bytes, explore_meshes, beam, profiler, mem_search_iters,
             extra_xfers, struct_xfers, inference, objective, serve,
-            calibration,
+            calibration, pipeline, microbatches,
         )
 
 
@@ -133,9 +272,16 @@ def _unity_search_impl(
     layers, mesh, graph_inputs, budget, alpha, machine,
     mem_budget_bytes, explore_meshes, beam, profiler, mem_search_iters,
     extra_xfers, struct_xfers, inference, objective="train", serve=None,
-    calibration=None,
+    calibration=None, pipeline="off", microbatches=None,
 ) -> Strategy:
     assert objective in ("train", "serve"), objective
+    pipeline = str(pipeline)
+    forced_stages = None
+    if pipeline not in ("off", "auto"):
+        forced_stages = int(pipeline)
+        assert forced_stages >= 2, (
+            f"--pipeline takes off|auto|S with S >= 2, got {pipeline!r}"
+        )
     if graph_inputs is None:
         seen = set()
         graph_inputs = []
@@ -187,25 +333,40 @@ def _unity_search_impl(
     best: Optional[Strategy] = None
     best_cost = float("inf")
     mcms = []  # per-mesh measured-cost models, for the coverage report
-    for mv in cands:
-        node_time_fn = None
-        mcm = None
+
+    def make_ntf(mesh_):
+        """Leaf-time provider for one mesh (measured and/or calibrated
+        tier in the shared node_time_fn slot) — also used per stage
+        SUBMESH by the pipeline tier, so pipelined variants price on the
+        same tier as everything else."""
+        ntf, mcm_ = None, None
         if profiler is not None:
             from flexflow_tpu.search.simulator import MeasuredCostModel
 
-            mcm = MeasuredCostModel(profiler, mv, machine, layers=layers)
-            mcms.append(mcm)
-            node_time_fn = mcm.node_time
+            mcm_ = MeasuredCostModel(profiler, mesh_, machine, layers=layers)
+            mcms.append(mcm_)
+            ntf = mcm_.node_time
         if calibration is not None:
             from flexflow_tpu.search.calibration import CalibratedCostModel
 
             # calibrated tier: per-op-class corrections over the
             # analytic roofline, or over the measured base when one is
             # active (the same node_time_fn provider slot either way)
-            node_time_fn = CalibratedCostModel(
-                calibration, mv, machine, base=mcm,
+            ntf = CalibratedCostModel(
+                calibration, mesh_, machine, base=mcm_,
                 forward_only=serve_obj is not None,
             ).node_time
+        return ntf
+
+    # stage-submesh DP winners, memoized by submesh shape: several full
+    # meshes collapse to the same submesh (docs/PIPELINE.md, "Search")
+    submesh_memo: dict = {}
+    forced_best = None  # best S-stage variant under --pipeline S
+    global_batch = (
+        int(graph_inputs[0].shape[0]) if graph_inputs else 0
+    )
+    for mv in cands:
+        node_time_fn = make_ntf(mv)
 
         def run(lam: float, _mv=mv, _ntf=node_time_fn):
             return graph_optimize(
@@ -240,6 +401,36 @@ def _unity_search_impl(
             # parallel-op attrs (fixed degree/axis) — skip, like the
             # reference skips invalid MachineViews
             continue
+        # --- pipeline tier (docs/PIPELINE.md): price 1F1B variants of
+        # this mesh.  Every axis of extent S >= 2 can carry the stages;
+        # its submesh winner comes from ONE memoized DP solve and the
+        # (S x M) sweep is arithmetic over that solve's collapsed-chain
+        # parts.  A pipelined variant competes as one more candidate.
+        if pipeline != "off" and serve_obj is None and global_batch > 0:
+            pl_best = _pipeline_variants(
+                mv, layers, graph_inputs, machine, budget, alpha, beam,
+                extra_xfers, struct_xfers, inference, forced_stages,
+                microbatches, global_batch, submesh_memo, make_ntf,
+                mem_budget_bytes=mem_budget_bytes,
+            )
+            if pl_best is not None:
+                pcost, pst = pl_best
+                if calibration is not None:
+                    pst.predicted_step_s = calibration.correct_step(
+                        "fit", pst.predicted_step_s
+                    )
+                if forced_stages is not None:
+                    # --pipeline S FORCES a pipelined winner: S-stage
+                    # variants compete among themselves only (the
+                    # non-pipelined field would otherwise win whenever
+                    # the machine model makes the bubble expensive and
+                    # silently ignore the flag); "auto" lets them
+                    # compete with everything on cost.
+                    if forced_best is None or pcost < forced_best[0]:
+                        forced_best = (pcost, pst)
+                elif pcost < best_cost:
+                    best_cost = pcost
+                    best = pst
         cost = res.cost
         price = None
         if serve_obj is not None:
@@ -279,6 +470,8 @@ def _unity_search_impl(
                     pred = calibration.correct_step("fit", pred)
                 st.predicted_step_s = pred
             best = st
+    if forced_best is not None:
+        best = forced_best[1]
     assert best is not None, "no feasible mesh factorization"
     if profiler is not None:
         profiler.save()  # persist the cost cache across sessions

@@ -112,12 +112,18 @@ def test_expert_parallel_matches_dense():
     (alpha high enough that neither path drops tokens)."""
     dense = build(fused=True)
     _compile(dense)
+    w0 = dense.get_weights()  # step-0 weights, before any training
     ref = _losses(dense)
 
     ep_model = build(fused=True)
     mesh = MachineMesh((2, 4), ("data", "expert"))
     strat = expert_parallel_strategy(ep_model.layers, mesh)
     _compile(ep_model, mesh=mesh, strategy=strat)
+    # threefry is not partitionable, so the expert-axis-sharded INIT
+    # draws different values than the single-device reference (the
+    # documented dryrun-parity caveat) — sync step-0 weights so the
+    # comparison tests the EP MATH, not the sharded init stream
+    ep_model.set_weights(w0)
     # expert weights must be physically sharded over the expert axis
     ex_layer = next(l for l in ep_model.layers if l.op_type.value == "experts")
     w1 = ep_model.executor.params[ex_layer.name]["w1"]

@@ -97,6 +97,18 @@ def test_two_process_dp_matches_single_process():
             pytest.fail("multihost worker timed out")
         outs.append((p.returncode, out, err))
     for rc, out, err in outs:
+        if rc != 0 and "Multiprocess computations aren't implemented" in (
+            out + err
+        ):
+            # environment-bound (tier-1 triage, ISSUE 8): this jaxlib's
+            # CPU backend refuses cross-process computations outright, so
+            # 2-process SPMD cannot run here at all — same limitation the
+            # hybrid-DCN dryrun degrades on (see CHANGES PR 2/3).  On a
+            # backend with multiprocess support the test runs as written.
+            pytest.skip(
+                "jaxlib CPU backend does not implement multiprocess "
+                "computations in this environment"
+            )
         assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err}"
     line = next(
         (ln for ln in outs[0][1].splitlines() if ln.startswith("LOSSES ")), None

@@ -7,7 +7,17 @@ notes its transfer estimates are never unit-tested at all).
 
 import math
 
-from hypothesis import given, settings, strategies as st_
+import pytest
+
+# environment-bound: the container image does not ship hypothesis and
+# the repo policy forbids installing packages — skip the module cleanly
+# instead of erroring collection (tier-1 triage, ISSUE 8)
+pytest.importorskip(
+    "hypothesis",
+    reason="hypothesis not installed in this environment "
+    "(property tests need it; pip install is unavailable here)",
+)
+from hypothesis import given, settings, strategies as st_  # noqa: E402
 
 from flexflow_tpu.parallel.machine import MachineMesh, PhysicalTopology
 from flexflow_tpu.parallel.spec import TensorSharding

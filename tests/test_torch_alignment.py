@@ -72,7 +72,13 @@ def test_mlp_loss_curve_matches_torch():
         _sgd_step([k1, b1, k2, b2], tl)
 
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=1e-5)
-    assert theirs[-1] < theirs[0], "torch oracle did not learn"
+    # the alignment content is the allclose above; comparing first-vs-
+    # last loss with a FRESH random batch (and random labels) each step
+    # is noise that flips sign across rng/BLAS environments (tier-1
+    # triage, ISSUE 8).  "The oracle is alive" = finite, non-frozen.
+    assert np.all(np.isfinite(theirs)) and np.ptp(theirs) > 1e-6, (
+        "torch oracle returned a frozen/non-finite loss curve"
+    )
 
 
 def test_cnn_loss_curve_matches_torch():
@@ -131,7 +137,13 @@ def test_cnn_loss_curve_matches_torch():
         _sgd_step(params, tl, lr)
 
     np.testing.assert_allclose(ours, theirs, rtol=5e-4, atol=5e-5)
-    assert theirs[-1] < theirs[0], "torch oracle did not learn"
+    # the alignment content is the allclose above; comparing first-vs-
+    # last loss with a FRESH random batch (and random labels) each step
+    # is noise that flips sign across rng/BLAS environments (tier-1
+    # triage, ISSUE 8).  "The oracle is alive" = finite, non-frozen.
+    assert np.all(np.isfinite(theirs)) and np.ptp(theirs) > 1e-6, (
+        "torch oracle returned a frozen/non-finite loss curve"
+    )
 
 
 def test_transformer_loss_curve_matches_torch():
@@ -189,4 +201,10 @@ def test_transformer_loss_curve_matches_torch():
         _sgd_step(params, tl)
 
     np.testing.assert_allclose(ours, theirs, rtol=5e-4, atol=5e-5)
-    assert theirs[-1] < theirs[0], "torch oracle did not learn"
+    # the alignment content is the allclose above; comparing first-vs-
+    # last loss with a FRESH random batch (and random labels) each step
+    # is noise that flips sign across rng/BLAS environments (tier-1
+    # triage, ISSUE 8).  "The oracle is alive" = finite, non-frozen.
+    assert np.all(np.isfinite(theirs)) and np.ptp(theirs) > 1e-6, (
+        "torch oracle returned a frozen/non-finite loss curve"
+    )

@@ -68,8 +68,13 @@ DEFAULT_THRESHOLD = 0.15
 # prediction — analytic/measured/calibrated, new in r09) also rides this
 # rule: the tier changes prediction accuracy for benign reasons, so the
 # gate prints the change and still compares.
+# pipeline (the headline run's --pipeline config, new in r09) rides
+# the same rule: a pipelined and a non-pipelined run of the same model
+# are still the same experiment — the schedule shifts step time for
+# architectural reasons the gate should surface, not refuse over.
 COMPARABLE_METADATA = (
     "metrics_sync_every", "stack_blocks", "serve_traffic", "cost_model_tier",
+    "pipeline",
 )
 
 # (label, path into the record, higher_is_better) — the gated metrics.
@@ -87,6 +92,10 @@ GATED = (
     ("throughput", ("value",), True),
     ("compile", ("jit_compile_s",), False),
     ("cost_model_mape", ("cost_model_mape",), False),
+    # pipeline_bubble_frac (r09, docs/PIPELINE.md) gates LOWER-is-better:
+    # the 1F1B A/B's measured warmup/drain bubble growing means the
+    # schedule degraded (fewer microbatches fitting, a stage imbalance)
+    ("pipeline_bubble_frac", ("pipeline_bubble_frac",), False),
     ("serve_tok_s", ("serve_tok_s",), True),
     ("serve_p99_ms", ("serve_p99_ms",), False),
     ("dlrm", ("secondary", "dlrm", "samples_per_sec"), True),

@@ -81,6 +81,13 @@ def main(argv: List[str] | None = None) -> int:
                     help="comma-separated axis names (default: data,model)")
     ap.add_argument("--sizes", default="4KB,64KB,1MB,64MB,1GB",
                     help="comma-separated tensor sizes for the time matrix")
+    ap.add_argument("--stages", type=int, default=0,
+                    help="pipeline view (docs/PIPELINE.md): show which "
+                    "axis/slices an S-stage 1F1B pipeline lands on and "
+                    "the priced inter-stage activation handoff (ICI vs "
+                    "DCN) per tensor size")
+    ap.add_argument("--microbatches", type=int, default=4,
+                    help="microbatch count for the --stages bubble line")
     args = ap.parse_args(argv)
 
     machine = load_machine_model(args.config)
@@ -169,7 +176,57 @@ def main(argv: List[str] | None = None) -> int:
         ds = bound.decision_stats
         print(f"\nrouting decisions this report: ring={ds['ring']} "
               f"hierarchical={ds['hierarchical']}")
+
+    if args.stages >= 2:
+        _stage_view(machine, bound, mesh, args.stages, args.microbatches,
+                    sizes, networked)
     return 0
+
+
+def _stage_view(machine, bound, mesh: MachineMesh, S: int, M: int,
+                sizes, networked: bool) -> None:
+    """The ``--stages S`` pipeline view (docs/PIPELINE.md): which mesh
+    axis carries the stages (a ``dcn_axes`` member of extent S wins —
+    slices become stages and every collective stays intra-stage on ICI),
+    what each stage's submesh looks like, and the priced per-microbatch
+    activation handoff between consecutive stages — the ONE transfer
+    that crosses the stage boundary under 1F1B."""
+    from flexflow_tpu.search.cost import _stage_handoff_time
+
+    cands = [n for n, s in zip(mesh.axis_names, mesh.shape) if s == S]
+    if not cands:
+        print(f"\npipeline view: no mesh axis of extent {S} on "
+              f"{dict(zip(mesh.axis_names, mesh.shape))} — an S-stage "
+              f"pipeline needs one (or a size-1 axis for virtual stages)")
+        return
+    # prefer the DCN-crossing axis: stages-over-DCN replaces every
+    # inter-slice collective with the point-to-point handoff
+    axis = next((a for a in cands if a in machine.dcn_axes), cands[0])
+    over_dcn = axis in machine.dcn_axes
+    sub = {n: (1 if n == axis else s)
+           for n, s in zip(mesh.axis_names, mesh.shape)}
+    sub_sz = 1
+    for v in sub.values():
+        sub_sz *= v
+    bubble = (S - 1) / (M + S - 1)
+    print(f"\npipeline view (--stages {S}, M={M}, docs/PIPELINE.md):")
+    print(f"  stage axis: {axis!r}"
+          + (" (crosses DCN — slices become stages; TP partials and "
+             "weight-grad sync stay intra-slice on ICI)" if over_dcn
+             else " (intra-slice ICI axis)"))
+    for s_idx in range(S):
+        where = (f"slice {s_idx}" if over_dcn and networked
+                 else f"{axis}={s_idx}")
+        print(f"  stage {s_idx}: {where}, submesh {sub} "
+              f"({sub_sz} device(s))")
+    print(f"  1F1B bubble (S-1)/(M+S-1) = {bubble:.3f}")
+    print(f"  inter-stage activation handoff ({'DCN' if over_dcn else 'ICI'}"
+          f" point-to-point, per microbatch):")
+    print(f"  {'size':<8}{'xfer ms':>12}{'eff GB/s':>12}")
+    for nbytes in sizes:
+        t = _stage_handoff_time(machine, nbytes, axis, sub_sz)
+        eff = nbytes / t / 1e9 if t > 0 else float("inf")
+        print(f"  {_fmt_size(nbytes):<8}{t * 1e3:>12.3f}{eff:>12.2f}")
 
 
 if __name__ == "__main__":

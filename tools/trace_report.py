@@ -118,6 +118,50 @@ def render(doc: Dict, by: str = "both", top: int = 40) -> str:
             + _table(["chain", "spans", "total_ms", "% wall"], rows)
         )
 
+    # pipeline parallelism (--pipeline, docs/PIPELINE.md): one
+    # pipeline_scan span per 1F1B chain per trace, carrying the stage /
+    # microbatch counts.  Roll them up per (S x M x depth) shape, with
+    # the schedule's warmup-drain bubble share of each line's wall —
+    # the per-stage work runs inside one jitted scan, so this rollup is
+    # the trace's per-stage accounting.
+    ps = [
+        e for e in events
+        if e.get("ph") == "X" and e.get("name") == "pipeline_scan"
+    ]
+    if ps:
+        agg2: Dict[str, List[float]] = {}
+        for e in ps:
+            a = e.get("args") or {}
+            s_ = a.get("stages", "?")
+            m_ = a.get("microbatches", "?")
+            key = (f"S={s_} x M={m_} "
+                   f"(depth={a.get('depth', '?')} x "
+                   f"{a.get('layers', '?')} layers)")
+            row = agg2.setdefault(key, [0, 0.0, 0.0])
+            row[0] += 1
+            dur = float(e.get("dur", 0.0))
+            row[1] += dur
+            try:
+                bf = (int(s_) - 1) / (int(m_) + int(s_) - 1)
+            except (TypeError, ValueError):
+                bf = 0.0
+            row[2] += dur * bf
+        rows = [
+            [k, int(n), f"{tot / 1e3:.2f}", f"{bub / 1e3:.2f}",
+             f"{100.0 * tot / wall_us:.1f}%" if wall_us > 0 else "-"]
+            for k, (n, tot, bub) in sorted(
+                agg2.items(), key=lambda kv: -kv[1][1]
+            )
+        ]
+        out.append(
+            "pipeline_scan rollup (1F1B schedule per chain; bubble_ms = "
+            "wall x (S-1)/(M+S-1)):\n"
+            + _table(
+                ["schedule", "spans", "total_ms", "bubble_ms", "% wall"],
+                rows,
+            )
+        )
+
     counters = summary.get("counters")
     if counters is None:  # fall back to final 'C' events
         counters = {}

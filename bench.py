@@ -685,7 +685,13 @@ def run_bench(backend: str) -> None:
     # compile/search/init costs come from the shared tracing vocabulary
     # (docs/OBSERVABILITY.md) instead of ad-hoc perf_counter bracketing
     tracer = configure(level="step")
-    cfg = FFConfig(batch_size=batch, compute_dtype=dtype)
+    # warn (not strict): the ffcheck pass runs post-compile on the
+    # instrumented step — outside the timed windows — and the violation
+    # count lands in the record for tools/bench_compare.py's zero-gate;
+    # a dirty program must not sink the measured headline
+    cfg = FFConfig(
+        batch_size=batch, compute_dtype=dtype, verify_compiled="warn"
+    )
     model = FFModel(cfg)
     transformer_encoder(
         model,
@@ -849,6 +855,13 @@ def run_bench(backend: str) -> None:
         "serve_tok_s": None,
         "serve_p99_ms": None,
         "serve_traffic": None,
+        # --verify-compiled ffcheck pass (docs/ANALYSIS.md): violation
+        # count from the post-compile static analysis of the headline
+        # step, gated AT ZERO by tools/bench_compare.py; null when the
+        # pass didn't run (verify_compiled=off)
+        "analysis_violations": getattr(
+            model.executor, "analysis_violations", None
+        ),
     }
     # the headline goes out BEFORE the extras: a hang in the attention
     # sweep or a secondary compile (the tunnel's documented failure mode
@@ -875,6 +888,7 @@ def run_bench(backend: str) -> None:
             jit_cache="miss",
             samples=batch,
             tokens=batch * seq,
+            analysis_violations=record["analysis_violations"],
             metrics={"metric": record["metric"], "mfu": record["mfu"]},
         ))
         stream.close()

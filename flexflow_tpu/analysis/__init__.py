@@ -1,0 +1,72 @@
+"""Compiled-program static analyzer (``ffcheck``) — docs/ANALYSIS.md.
+
+Walks the ClosedJaxpr / compiled HLO of each execution path (fit step,
+eval forward, serve prefill/decode, the pipeline scan inside the step)
+and runs a registry of invariant checks:
+
+* ``collective``  — lowered collectives reconcile with the strategy's
+  implied set (search/cost.py ``implied_collectives``)
+* ``transfer``    — no device->host round trips or un-prefetched H2D
+  copies inside jitted bodies
+* ``donation``    — buffers eligible for donation are donated (no
+  double-HBM)
+* ``dtype``       — no fp32 dot/conv leaks inside bf16/fp16 regions
+* ``replication`` — weights the strategy shards are not lowered
+  fully replicated
+
+Entry points: ``tools/ffcheck.py`` (CLI), the ``--verify-compiled``
+FFConfig knob (post-compile hook in Executor / ServeEngine), and direct
+use from tests via :func:`analyze_program`.
+"""
+
+from flexflow_tpu.analysis.capture import (
+    analyze_executor,
+    analyze_serve_engine,
+    artifact_from_executor_step,
+    capture_jit,
+)
+from flexflow_tpu.analysis.checks import (
+    check_donation,
+    check_dtype,
+    check_replication,
+    check_transfers,
+)
+from flexflow_tpu.analysis.collectives import (
+    CollectiveOp,
+    CollectiveSummary,
+    check_collectives,
+    extract_collectives,
+)
+from flexflow_tpu.analysis.core import (
+    CHECKS,
+    AnalysisError,
+    AnalysisReport,
+    ProgramArtifact,
+    Violation,
+    analyze_artifacts,
+    analyze_program,
+    register_check,
+)
+
+__all__ = [
+    "AnalysisError",
+    "AnalysisReport",
+    "CHECKS",
+    "CollectiveOp",
+    "CollectiveSummary",
+    "ProgramArtifact",
+    "Violation",
+    "analyze_artifacts",
+    "analyze_executor",
+    "analyze_program",
+    "analyze_serve_engine",
+    "artifact_from_executor_step",
+    "capture_jit",
+    "check_collectives",
+    "check_donation",
+    "check_dtype",
+    "check_replication",
+    "check_transfers",
+    "extract_collectives",
+    "register_check",
+]

@@ -1,0 +1,271 @@
+"""Artifact capture: turn live runtime objects (Executor, ServeEngine,
+or any jitted callable) into :class:`ProgramArtifact`\\ s the checks
+understand.
+
+Capture is built on ``jitted.trace(*args)`` — abstract evaluation only,
+no execution, no donation, no compile — plus the AOT executable the
+caller already owns (the executor's ``_step_compiled``, or a fresh
+``.lower().compile()`` when none exists).  So ``--verify-compiled``
+costs one trace walk on top of the compile the program needed anyway.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+from flexflow_tpu.analysis.core import (
+    AnalysisReport,
+    ProgramArtifact,
+    analyze_program,
+    flatten_info,
+)
+
+
+def _labeled_inputs(args_info: Any, arg_names: Sequence[str]):
+    """Flatten ``trace(...).args_info`` into labeled rows, naming each
+    leaf by its top-level argument (``params[dense1][kernel]``)."""
+    top = args_info
+    # jax reports ``(positional_args_tuple, kwargs_dict)`` (older
+    # versions wrapped the positional tuple alone one level deep)
+    if (
+        isinstance(top, tuple) and len(top) == 2
+        and isinstance(top[0], tuple) and isinstance(top[1], dict)
+    ):
+        top = top[0] + tuple(top[1].values())
+    elif isinstance(top, tuple) and len(top) == 1 and isinstance(top[0], tuple):
+        top = top[0]
+    if isinstance(top, (tuple, list)) and len(top) == len(arg_names):
+        rows = []
+        for name, sub in zip(arg_names, top):
+            rows.extend(flatten_info(sub, name))
+        return rows
+    return flatten_info(args_info, "arg")
+
+
+def capture_jit(
+    name: str,
+    role: str,
+    jitted: Any,
+    args: Tuple,
+    *,
+    compiled: Any = None,
+    arg_names: Sequence[str] = (),
+    mesh: Any = None,
+    strategy: Any = None,
+    layers: Any = None,
+    compute_dtype: str = "float32",
+    implied: Any = None,
+    expects_donation: bool = True,
+    param_shardings: Any = None,
+) -> ProgramArtifact:
+    """Build an artifact from one jitted callable + example args.
+    ``compiled`` reuses an existing AOT executable; otherwise the
+    capture lowers and compiles one itself."""
+    tr = jitted.trace(*args)
+    if compiled is None:
+        compiled = tr.lower().compile()
+    hlo = ""
+    try:
+        hlo = compiled.as_text()
+    except Exception:
+        pass
+    inputs = _labeled_inputs(
+        tr.args_info,
+        arg_names or tuple(f"arg{i}" for i in range(len(args))),
+    )
+    outputs = [
+        (shape, dtype)
+        for _, shape, dtype, _ in flatten_info(tr.out_info, "out")
+    ]
+    return ProgramArtifact(
+        name=name,
+        role=role,
+        hlo=hlo,
+        jaxpr=tr.jaxpr,
+        mesh=mesh,
+        strategy=strategy,
+        layers=layers,
+        compute_dtype=compute_dtype,
+        inputs=inputs,
+        outputs=outputs,
+        implied=implied,
+        expects_donation=expects_donation,
+        param_shardings=param_shardings,
+    )
+
+
+def _executor_implied(ex, forward_only: bool):
+    from flexflow_tpu.search.cost import implied_collectives
+
+    layers = (
+        ex.strategy.rewritten_layers
+        if getattr(ex.strategy, "rewritten_layers", None)
+        else ex.layers
+    )
+    implied = implied_collectives(
+        layers,
+        ex.strategy,
+        forward_only=forward_only,
+        extra_axes=("data",) if ex.zero1 else (),
+    )
+    if ex.pipeline is None:
+        # the executor declined the strategy's pipeline (or none was
+        # set): the handoff ppermute is not in this program
+        implied = [e for e in implied if not e.reason.startswith("pipeline")]
+    return implied
+
+
+def _param_shardings(compiled) -> Optional[dict]:
+    """The params subtree of the executable's input shardings —
+    ``layer -> wname -> Sharding`` for the replication audit."""
+    try:
+        args_shardings, _ = compiled.input_shardings
+        tree = args_shardings[0]
+        return tree if isinstance(tree, dict) else None
+    except Exception:
+        return None
+
+
+def artifact_from_executor_step(
+    ex, args: Tuple, compiled: Any = None
+) -> ProgramArtifact:
+    """The fit-step artifact: trace ``ex._step_jit`` at the step's real
+    args, pair with the AOT executable."""
+    return capture_jit(
+        "fit",
+        "fit",
+        ex._step_jit,
+        args,
+        compiled=compiled,
+        arg_names=("params", "state", "opt_state", "inputs", "labels", "step"),
+        mesh=ex.mesh,
+        strategy=ex.strategy,
+        layers=ex.layers,
+        compute_dtype=str(ex.compute_dtype),
+        implied=_executor_implied(ex, forward_only=False),
+        param_shardings=_param_shardings(compiled) if compiled is not None else None,
+    )
+
+
+def _synth_batch(ex):
+    """A shape/dtype-correct dummy batch for capture-only compiles."""
+    import numpy as np
+
+    from flexflow_tpu.fftype import DataType
+
+    rng = np.random.default_rng(0)
+    xs = []
+    for t in ex.graph_inputs:
+        if t.dtype in (DataType.INT32, DataType.INT64):
+            xs.append(np.zeros(t.shape, np.int32))
+        elif t.dtype == DataType.BOOLEAN:
+            xs.append(np.zeros(t.shape, bool))
+        else:
+            xs.append(rng.normal(size=t.shape).astype(np.float32))
+    if "CROSSENTROPY" in ex.loss_type.name:
+        y = np.zeros((ex.graph_inputs[0].shape[0], 1), np.int32)
+    else:
+        y = np.zeros(ex.logits.shape, np.float32)
+    return xs, y
+
+
+def analyze_executor(
+    ex,
+    programs: Sequence[str] = ("fit",),
+    checks: Optional[Sequence[str]] = None,
+) -> AnalysisReport:
+    """Analyze an executor's compiled program(s), synthesizing a dummy
+    batch when none has run yet.  ``programs``: subset of
+    ``("fit", "eval")``."""
+    report = AnalysisReport()
+    xs_np, y_np = _synth_batch(ex)
+    inputs = [
+        ex._place(x, ex._input_pspec(t), t.shape[0])
+        for x, t in zip(xs_np, ex.graph_inputs)
+    ]
+    labels = ex._place(y_np, ex._label_pspec(), ex.graph_inputs[0].shape[0])
+    if "fit" in programs:
+        if ex._step_jit is None:
+            ex._step_jit = ex._build_step()
+            ex._step_compiled = None
+        args = (ex.params, ex.state, ex.opt_state, inputs, labels, 0)
+        compiled = ex._step_compiled
+        if compiled is None or compiled is ex._step_jit:
+            try:
+                compiled = ex._step_jit.lower(*args).compile()
+                ex._step_compiled = compiled
+            except Exception:
+                compiled = None
+        art = artifact_from_executor_step(ex, args, compiled)
+        report.add_program(art.name)
+        report.extend(analyze_program(art, checks))
+    if "eval" in programs:
+        if ex._fwd_jit is None:
+            ex._fwd_jit = ex._build_fwd()
+        args = (ex.params, ex.state, inputs, None)
+        art = capture_jit(
+            "eval",
+            "eval",
+            ex._fwd_jit,
+            args,
+            arg_names=("params", "state", "inputs", "seq_length"),
+            mesh=ex.mesh,
+            strategy=ex.strategy,
+            layers=ex.layers,
+            compute_dtype=str(ex.compute_dtype),
+            implied=_executor_implied(ex, forward_only=True),
+            expects_donation=False,
+        )
+        report.add_program(art.name)
+        report.extend(analyze_program(art, checks))
+    return report
+
+
+def analyze_serve_engine(
+    engine, checks: Optional[Sequence[str]] = None
+) -> AnalysisReport:
+    """Analyze a ServeEngine's decode + prefill programs.  No strategy
+    reconciliation (the decode programs are hand-written, not
+    search-placed) — the transfer/donation/dtype audits carry the
+    zero-sync-serve and paged-KV-donation guarantees."""
+    import jax.numpy as jnp
+
+    ex = engine.model.executor
+    kv = engine.kv
+    B, MB = engine.slots, kv.max_blocks_per_seq
+    z = jnp.zeros((B,), jnp.int32)
+    bt0 = jnp.zeros((B, MB), jnp.int32)
+    dt = str(ex.compute_dtype)
+    report = AnalysisReport()
+    for name, jitted, args, names in (
+        (
+            "serve.decode",
+            engine._decode,
+            (ex.params, kv.cache_k, kv.cache_v, z, z, bt0),
+            ("params", "cache_k", "cache_v", "tok", "pos", "block_tables"),
+        ),
+        (
+            "serve.prefill",
+            engine._prefill,
+            (
+                ex.params, kv.cache_k, kv.cache_v,
+                jnp.zeros((engine.prefill_chunk,), jnp.int32),
+                jnp.asarray(0, jnp.int32), jnp.asarray(1, jnp.int32),
+                bt0[0],
+            ),
+            ("params", "cache_k", "cache_v", "toks", "start", "n_valid",
+             "block_tables"),
+        ),
+    ):
+        art = capture_jit(
+            name,
+            name.split(".", 1)[1],
+            jitted,
+            args,
+            arg_names=names,
+            mesh=ex.mesh,
+            compute_dtype=dt,
+        )
+        report.add_program(art.name)
+        report.extend(analyze_program(art, checks))
+    return report

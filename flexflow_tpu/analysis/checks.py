@@ -1,0 +1,269 @@
+"""The non-collective checks: transfer & sync, donation, dtype
+promotion, replication (docs/ANALYSIS.md "Check catalog").
+
+Each check is total over :class:`ProgramArtifact` — missing inputs mean
+skip, never raise — and reports op/file-level diagnostics via the jaxpr
+equation's user source frame where one exists.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List
+
+from flexflow_tpu.analysis.core import (
+    DONATION_BYTES_FLOOR,
+    DTYPE_LEAK_MIN_ELEMS,
+    H2D_CONST_BYTES_FLOOR,
+    ProgramArtifact,
+    Violation,
+    eqn_where,
+    register_check,
+    walk_jaxpr_eqns,
+)
+
+# jaxpr primitives that force a device->host round trip when they appear
+# INSIDE a jitted body (the async-fit / zero-sync-serve killers).
+# debug_callback is warn-level: ordered prints stall dispatch but do not
+# change results.
+HOST_SYNC_PRIMS = {
+    "pure_callback": "error",
+    "io_callback": "error",
+    "callback": "error",
+    "infeed": "error",
+    "outfeed": "error",
+    "debug_callback": "warn",
+}
+# the HLO-text fallback when no jaxpr was captured
+_HOST_CALLBACK_TARGETS = (
+    'custom_call_target="xla_python_cpu_callback"',
+    'custom_call_target="xla_ffi_python_cpu_callback"',
+)
+
+
+def _dtype_bytes(dtype_str: str) -> int:
+    import numpy as np
+
+    try:
+        return int(np.dtype(dtype_str).itemsize)
+    except TypeError:
+        return 4
+
+
+@register_check("transfer")
+def check_transfers(artifact: ProgramArtifact) -> List[Violation]:
+    """Statically find device-to-host transfers (host callbacks, infeed/
+    outfeed) and un-prefetched H2D copies (large host constants closed
+    over by the jitted body) — the static form of the ``host_syncs``
+    ledger guarantee."""
+    out: List[Violation] = []
+    if artifact.jaxpr is not None:
+        for eqn in walk_jaxpr_eqns(artifact.jaxpr):
+            sev = HOST_SYNC_PRIMS.get(eqn.primitive.name)
+            if sev is not None:
+                out.append(Violation(
+                    check="transfer",
+                    severity=sev,
+                    program=artifact.name,
+                    message=(
+                        f"host round-trip inside jitted body: "
+                        f"{eqn.primitive.name}"
+                    ),
+                    where=(eqn_where(eqn) or eqn.primitive.name),
+                ))
+        # closed-over host arrays become per-dispatch H2D copies; device
+        # arrays (jax.Array) are already resident
+        import numpy as np
+
+        consts = getattr(artifact.jaxpr, "consts", ())
+        for c in consts:
+            if type(c).__module__.startswith("numpy") and isinstance(
+                c, np.ndarray
+            ) and c.nbytes >= H2D_CONST_BYTES_FLOOR:
+                out.append(Violation(
+                    check="transfer",
+                    severity="warn",
+                    program=artifact.name,
+                    message=(
+                        f"un-prefetched H2D copy: jitted body closes over "
+                        f"a host array of {c.nbytes} bytes "
+                        f"(shape {tuple(c.shape)}) — stage it with "
+                        f"device_put/place_batch instead"
+                    ),
+                ))
+    elif artifact.hlo:
+        for tgt in _HOST_CALLBACK_TARGETS:
+            n = artifact.hlo.count(tgt)
+            if n:
+                out.append(Violation(
+                    check="transfer",
+                    severity="error",
+                    program=artifact.name,
+                    message=(
+                        f"{n} host-callback custom-call(s) inside the "
+                        f"compiled program ({tgt})"
+                    ),
+                ))
+    return out
+
+
+@register_check("donation")
+def check_donation(artifact: ProgramArtifact) -> List[Violation]:
+    """Detect buffers eligible for donation but not donated.
+
+    A non-donated input whose (shape, dtype) matches an output left over
+    after the donated inputs consumed theirs holds BOTH copies live
+    across the step — the double-HBM hazard ``search/memory.py`` budgets
+    assume away.  Small buffers (< 1 MiB) are exempt: token ids and
+    scalar counters legitimately alias nothing.
+    """
+    if not artifact.expects_donation or not artifact.inputs:
+        return []
+    out: List[Violation] = []
+    # multiset of output avals, consumed donated-first
+    remaining: Dict[tuple, int] = {}
+    for shape, dtype in artifact.outputs:
+        k = (tuple(shape), dtype)
+        remaining[k] = remaining.get(k, 0) + 1
+    donated_any = False
+    for label, shape, dtype, donated in artifact.inputs:
+        if donated:
+            donated_any = True
+            k = (tuple(shape), dtype)
+            if remaining.get(k, 0) > 0:
+                remaining[k] -= 1
+    for label, shape, dtype, donated in artifact.inputs:
+        if donated or not shape:
+            continue
+        nbytes = math.prod(shape) * _dtype_bytes(dtype)
+        if nbytes < DONATION_BYTES_FLOOR:
+            continue
+        k = (tuple(shape), dtype)
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            out.append(Violation(
+                check="donation",
+                severity="error",
+                program=artifact.name,
+                message=(
+                    f"input {label} ({dtype}{list(shape)}, {nbytes} bytes) "
+                    f"matches an undonated output — donate it or both "
+                    f"copies stay live across the step (double-HBM)"
+                ),
+                where=label,
+                details={"bytes": nbytes, "shape": list(shape),
+                         "dtype": dtype},
+            ))
+    # donation declared but dropped at lowering: XLA records honored
+    # donations in the module header's input_output_alias
+    if donated_any and artifact.hlo and "input_output_alias" not in artifact.hlo:
+        out.append(Violation(
+            check="donation",
+            severity="error",
+            program=artifact.name,
+            message=(
+                "donate_argnums declared but the compiled module carries "
+                "no input_output_alias — donation was dropped at lowering"
+            ),
+        ))
+    return out
+
+
+@register_check("dtype")
+def check_dtype(artifact: ProgramArtifact) -> List[Violation]:
+    """fp32 leaks inside reduced-precision compute regions: a
+    dot/conv contracting fp32 operands of non-trivial size inside a
+    program whose compute dtype is bf16/fp16 runs at a fraction of the
+    MXU rate and doubles the activation bytes.  Deliberate fp32 islands
+    (loss scalars, norm denominators, optimizer math on master weights)
+    fall under the ``DTYPE_LEAK_MIN_ELEMS`` floor or are not dots."""
+    if artifact.compute_dtype not in ("bfloat16", "float16"):
+        return []
+    if artifact.jaxpr is None:
+        return []
+    out: List[Violation] = []
+    for eqn in walk_jaxpr_eqns(artifact.jaxpr):
+        if eqn.primitive.name not in ("dot_general", "conv_general_dilated"):
+            continue
+        opnds = [
+            v.aval for v in eqn.invars if hasattr(getattr(v, "aval", None), "dtype")
+        ]
+        if not opnds:
+            continue
+        fp32 = [a for a in opnds if str(a.dtype) == "float32"]
+        big = [a for a in fp32 if a.size >= DTYPE_LEAK_MIN_ELEMS]
+        if fp32 and big:
+            shapes = [tuple(a.shape) for a in opnds]
+            out.append(Violation(
+                check="dtype",
+                severity="error",
+                program=artifact.name,
+                message=(
+                    f"fp32 {eqn.primitive.name} inside a "
+                    f"{artifact.compute_dtype} compute region "
+                    f"(operands {shapes}) — silent upcast"
+                ),
+                where=(eqn_where(eqn) or eqn.primitive.name),
+                details={"operand_shapes": [list(s) for s in shapes]},
+            ))
+    return out
+
+
+@register_check("replication")
+def check_replication(artifact: ProgramArtifact) -> List[Violation]:
+    """Operands lowered fully replicated when the strategy says sharded:
+    the weight occupies ``degree``x the HBM the placement priced, and its
+    collectives vanish — usually a dropped sharding constraint or an
+    executor/strategy keying mismatch."""
+    if (
+        artifact.param_shardings is None
+        or artifact.strategy is None
+        or artifact.layers is None
+    ):
+        return []
+    from flexflow_tpu.ops.base import get_op_def
+
+    strategy = artifact.strategy
+    mesh = strategy.mesh
+    out: List[Violation] = []
+    for layer in artifact.layers:
+        bucket = artifact.param_shardings.get(layer.name)
+        if not isinstance(bucket, dict):
+            continue  # stacked members key under their template's name
+        for w in get_op_def(layer.op_type).weights(layer):
+            actual = bucket.get(w.name)
+            if actual is None:
+                continue
+            pspec = strategy.weight_pspec(layer, w.name, len(w.shape))
+            degree = 1
+            for entry in pspec:
+                axes = entry if isinstance(entry, tuple) else (entry,)
+                for a in axes:
+                    if a is not None:
+                        degree *= mesh.axis_size(a)
+            if degree <= 1:
+                continue
+            replicated = getattr(actual, "is_fully_replicated", False)
+            if replicated:
+                out.append(Violation(
+                    check="replication",
+                    severity="error",
+                    program=artifact.name,
+                    message=(
+                        f"weight {layer.name}.{w.name} lowered fully "
+                        f"replicated but the strategy shards it "
+                        f"{degree}-way ({_fmt_pspec(pspec)}) — "
+                        f"{degree}x the priced HBM"
+                    ),
+                    where=f"params[{layer.name}][{w.name}]",
+                    details={"intended": _fmt_pspec(pspec),
+                             "degree": degree},
+                ))
+    return out
+
+
+def _fmt_pspec(pspec: Any) -> str:
+    return "P(" + ", ".join(
+        "+".join(e) if isinstance(e, tuple) else (str(e) if e else "None")
+        for e in pspec
+    ) + ")"

@@ -166,6 +166,13 @@ class FFConfig:
     # served from disk emits the jit_cache.persistent_hit tracer counter
     # (docs/OBSERVABILITY.md).  None = in-memory jit cache only.
     compile_cache_dir: Optional[str] = None
+    # post-compile static analysis (docs/ANALYSIS.md): run the ffcheck
+    # registry over every compiled program.  "warn" records violations
+    # (ffmetrics `analysis_violations` + the analysis.violations tracer
+    # counter), "strict" raises AnalysisError — compile-time enforcement
+    # of the collective / transfer / donation / dtype / replication
+    # invariants the placement priced.
+    verify_compiled: str = "off"  # off | warn | strict
     rng_seed: int = 0
     memory_search_budget: int = -1  # lambda search iterations (graph.cc:2075)
     device_memory_gb: float = -1.0  # per-device HBM budget for λ mem search
@@ -261,6 +268,8 @@ class FFConfig:
                 self.microbatches = int(take())
             elif a == "--compile-cache-dir":
                 self.compile_cache_dir = take()
+            elif a == "--verify-compiled":
+                self.verify_compiled = take()
             elif a == "--enable-parameter-parallel":
                 self.enable_parameter_parallel = True
             elif a == "--disable-parameter-parallel":

@@ -20,7 +20,7 @@ from __future__ import annotations
 import collections
 import queue
 import threading
-from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Iterator, Optional, Sequence
 
 import jax
 import numpy as np

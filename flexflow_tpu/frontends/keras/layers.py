@@ -13,7 +13,7 @@ describes one sample; the batch dim is prepended at compile time.
 from __future__ import annotations
 
 import itertools
-from typing import Callable, List, Optional, Sequence, Tuple, Union
+from typing import List, Optional, Sequence, Tuple, Union
 
 from flexflow_tpu.fftype import ActiMode, DataType, PoolType
 

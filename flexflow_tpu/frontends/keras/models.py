@@ -9,7 +9,7 @@ canonical loop with callbacks (reference ``BaseModel.fit``,
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -17,7 +17,7 @@ from flexflow_tpu.config import FFConfig
 from flexflow_tpu.dataloader import BatchIterator, SingleDataLoader
 from flexflow_tpu.fftype import LossType, MetricsType
 from flexflow_tpu.frontends.keras.layers import KTensor, Layer, Node
-from flexflow_tpu.frontends.keras.optimizers import SGD, Adam, KOptimizer
+from flexflow_tpu.frontends.keras.optimizers import SGD, Adam
 from flexflow_tpu.metrics import PerfMetrics
 from flexflow_tpu.model import FFModel
 

@@ -13,11 +13,11 @@ in the torch frontend), enabling forward-parity tests.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Sequence, Union
+from typing import Dict, List
 
 import numpy as np
 
-from flexflow_tpu.fftype import ActiMode, AggrMode, DataType, PoolType
+from flexflow_tpu.fftype import DataType, PoolType
 from flexflow_tpu.model import FFModel
 from flexflow_tpu.tensor import Tensor
 

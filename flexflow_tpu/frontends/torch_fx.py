@@ -18,11 +18,9 @@ from __future__ import annotations
 
 import json
 import math
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, List, Optional, Sequence, Union
 
-import numpy as np
-
-from flexflow_tpu.fftype import ActiMode, DataType, PoolType
+from flexflow_tpu.fftype import DataType, PoolType
 from flexflow_tpu.model import FFModel
 from flexflow_tpu.tensor import Tensor
 

@@ -11,7 +11,7 @@ round-trip, unlike the reference's CPU-side task dispatch).
 from __future__ import annotations
 
 import math
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -22,7 +22,6 @@ What compile() does here vs the reference:
 from __future__ import annotations
 
 import dataclasses
-import math
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -53,15 +52,10 @@ from flexflow_tpu.obs import (
     get_tracer,
 )
 from flexflow_tpu.ops.base import get_op_def
-from flexflow_tpu.optimizer import AdamOptimizer, Optimizer, SGDOptimizer
+from flexflow_tpu.optimizer import Optimizer, SGDOptimizer
 from flexflow_tpu.parallel.machine import MachineMesh, default_mesh
-from flexflow_tpu.parallel.strategy import (
-    Strategy,
-    data_parallel_strategy,
-    tensor_parallel_strategy,
-)
+from flexflow_tpu.parallel.strategy import Strategy, data_parallel_strategy
 from flexflow_tpu.runtime.executor import Executor
-from flexflow_tpu.runtime.recompile import RecompileState
 from flexflow_tpu.tensor import Layer, Tensor
 
 # auto metric-flush cadence for the async fit loop (K in
@@ -935,6 +929,7 @@ class FFModel:
             zero1=cfg.enable_zero1,
             profiling=cfg.profiling,
             stack_blocks=cfg.stack_blocks,
+            verify_compiled=cfg.verify_compiled,
         )
         with get_tracer().span("init_params", cat="compile"):
             self.executor.init_params()

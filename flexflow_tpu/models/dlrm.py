@@ -16,7 +16,7 @@ table weight's ``tp_dim=0`` over the ``model`` axis
 from __future__ import annotations
 
 import math
-from typing import List, Optional, Sequence
+from typing import List, Sequence
 
 from flexflow_tpu.fftype import ActiMode, AggrMode, DataType
 from flexflow_tpu.initializer import NormInitializer, UniformInitializer

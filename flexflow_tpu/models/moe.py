@@ -11,7 +11,7 @@ Reference app ``examples/cpp/mixture_of_experts/moe.cc``:
 
 from __future__ import annotations
 
-from flexflow_tpu.fftype import ActiMode, DataType
+from flexflow_tpu.fftype import ActiMode
 from flexflow_tpu.model import FFModel
 from flexflow_tpu.tensor import Tensor
 

@@ -287,6 +287,7 @@ class HealthMonitor:
             pipeline_stages=stats.get("pipeline_stages"),
             microbatches=stats.get("microbatches"),
             bubble_frac=stats.get("bubble_frac"),
+            analysis_violations=stats.get("analysis_violations"),
             counters=self.counter_deltas(dict(tracer.counters)),
             metrics=metrics,
         )

@@ -75,6 +75,12 @@ RECORD_FIELDS = (
     "pipeline_stages",
     "microbatches",
     "bubble_frac",
+    # compiled-program static analysis (nullable — docs/ANALYSIS.md):
+    # violation count from the --verify-compiled ffcheck pass over the
+    # program this step ran.  None = analysis never ran; 0 = ran clean.
+    # ADDING this keeps the schema at ffmetrics/1 (same interop rule as
+    # the prediction/pipeline keys above).
+    "analysis_violations",
 )
 
 
@@ -127,6 +133,7 @@ def step_record(
     pipeline_stages: Optional[int] = None,
     microbatches: Optional[int] = None,
     bubble_frac: Optional[float] = None,
+    analysis_violations: Optional[int] = None,
     counters: Optional[Dict[str, float]] = None,
     metrics: Optional[Dict[str, float]] = None,
 ) -> Dict[str, Any]:
@@ -158,6 +165,8 @@ def step_record(
         rec["pipeline_stages"] = int(pipeline_stages)
     if microbatches is not None:
         rec["microbatches"] = int(microbatches)
+    if analysis_violations is not None:
+        rec["analysis_violations"] = int(analysis_violations)
     if jit_cache is not None:
         rec["jit_cache"] = str(jit_cache)
     if step_wall_s and step_wall_s > 0:

@@ -56,6 +56,9 @@ CORE_COUNTERS = (
     "network.hierarchical_collectives",
     "serve.windows",
     "serve.decode_steps",
+    # --verify-compiled ffcheck pass (docs/ANALYSIS.md): violation count
+    # from the last analyzed program (0 after a clean verify)
+    "analysis.violations",
 )
 
 

@@ -23,7 +23,7 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
-from flexflow_tpu.fftype import DataType, OperatorType
+from flexflow_tpu.fftype import OperatorType
 from flexflow_tpu.initializer import default_kernel_initializer
 from flexflow_tpu.ops.base import OpContext, OpDef, ShapeDtype, WeightSpec, register_op
 from flexflow_tpu.tensor import Layer

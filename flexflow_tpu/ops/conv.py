@@ -17,13 +17,13 @@ reference's flatten order by transposing before reshape.
 from __future__ import annotations
 
 import math
-from typing import Dict, List
+from typing import List
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from flexflow_tpu.fftype import ActiMode, DataType, OperatorType, PoolType
+from flexflow_tpu.fftype import ActiMode, OperatorType, PoolType
 from flexflow_tpu.initializer import default_bias_initializer, default_kernel_initializer
 from flexflow_tpu.ops.base import OpContext, OpDef, ShapeDtype, WeightSpec, register_op
 from flexflow_tpu.ops.dense import apply_activation

@@ -22,12 +22,12 @@ Both are expressed in strategy specs; the lowering is identical.
 from __future__ import annotations
 
 import math
-from typing import Dict, List
+from typing import List
 
 import jax
 import jax.numpy as jnp
 
-from flexflow_tpu.fftype import ActiMode, DataType, OperatorType
+from flexflow_tpu.fftype import ActiMode, OperatorType
 from flexflow_tpu.initializer import default_bias_initializer, default_kernel_initializer
 from flexflow_tpu.ops.base import OpContext, OpDef, ShapeDtype, WeightSpec, register_op
 from flexflow_tpu.tensor import Layer

@@ -15,12 +15,12 @@ kernels (``element_binary_kernels.cu`` broadcast paths).
 from __future__ import annotations
 
 import math
-from typing import Dict, List
+from typing import List
 
 import jax
 import jax.numpy as jnp
 
-from flexflow_tpu.fftype import DataType, OperatorType
+from flexflow_tpu.fftype import OperatorType
 from flexflow_tpu.ops.base import OpContext, OpDef, ShapeDtype, register_op
 from flexflow_tpu.tensor import Layer
 

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from flexflow_tpu import _compat
-from flexflow_tpu.fftype import DataType, OperatorType
+from flexflow_tpu.fftype import OperatorType
 from flexflow_tpu.ops.base import OpContext, OpDef, ShapeDtype, register_op
 from flexflow_tpu.tensor import Layer
 

@@ -16,7 +16,7 @@ replica dims: an axis absent from the spec is a replication axis, and a
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple, Union
+from typing import Tuple, Union
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 

@@ -21,7 +21,7 @@ from typing import Dict, List, Optional, Tuple
 from jax.sharding import PartitionSpec
 
 from flexflow_tpu.fftype import OperatorType
-from flexflow_tpu.ops.base import WeightSpec, get_op_def
+from flexflow_tpu.ops.base import get_op_def
 from flexflow_tpu.parallel.machine import MachineMesh
 from flexflow_tpu.parallel.spec import TensorSharding
 from flexflow_tpu.tensor import Layer
@@ -228,6 +228,12 @@ class Strategy:
         # FFModel.compile estimates one.
         self.predicted_step_s: Optional[float] = None
         self.predicted_tok_s: Optional[float] = None
+        # the collective multiset this placement implies (search/cost.py
+        # implied_collectives), attached by unity_search to its winner —
+        # the reconciliation source for the analyzer's collective audit
+        # (docs/ANALYSIS.md).  Derived, not serialized: rebuilt from the
+        # assignments whenever needed.
+        self.implied_collectives: Optional[List] = None
 
     def op_sharding(self, layer: Layer) -> Optional[OpSharding]:
         return self.ops.get(int(layer.layer_guid))

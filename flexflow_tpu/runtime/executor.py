@@ -29,11 +29,10 @@ cost model already assumes (``search/cost.py``).
 
 from __future__ import annotations
 
-import functools
 import os
 import time
 import zlib
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -72,6 +71,7 @@ class Executor:
         zero1: bool = False,
         profiling: bool = False,
         stack_blocks: str = "off",
+        verify_compiled: str = "off",
     ) -> None:
         self.layers = layers
         self.graph_inputs = graph_inputs
@@ -196,6 +196,17 @@ class Executor:
         self.host_syncs = 0
         self.host_stall_s = 0.0
         self._step_compiled = None  # AOT executable (traced path only)
+        # --verify-compiled (docs/ANALYSIS.md): run the ffcheck registry
+        # over the step program once per compile.  "warn" records the
+        # count (analysis.violations counter + last_analysis report),
+        # "strict" raises AnalysisError before the first step executes.
+        assert verify_compiled in ("off", "warn", "strict"), (
+            f"unknown --verify-compiled value {verify_compiled!r}"
+        )
+        self.verify_compiled = verify_compiled
+        self.last_analysis = None  # AnalysisReport from the last verify
+        self.analysis_violations: Optional[int] = None  # None = never ran
+        self._verified_step = False
         self._fwd_seqs_seen: set = set()  # fwd jit-cache hit/miss tracking
         # run-health monitor vocabulary: samples (and tokens when the
         # first input carries a sequence dim) consumed per step — the
@@ -1239,6 +1250,51 @@ class Executor:
         )
         return inputs, labels
 
+    def _maybe_verify_compiled(self, args) -> None:
+        """--verify-compiled hook: run the ffcheck registry over the
+        compiled step program ONCE per compile (docs/ANALYSIS.md).  Warn
+        mode records the violation count (``analysis.violations`` tracer
+        counter, ``last_analysis`` report, the ``analysis_violations``
+        ffmetrics field); strict mode raises AnalysisError before the
+        first step executes on device."""
+        if self.verify_compiled == "off" or self._verified_step:
+            return
+        self._verified_step = True
+        from flexflow_tpu.analysis import (
+            AnalysisError,
+            AnalysisReport,
+            analyze_program,
+            artifact_from_executor_step,
+        )
+
+        if self._step_compiled is None:
+            # fast path never AOT-compiles on its own: do it here and
+            # keep the executable (the step reuses it — no double
+            # compile, and the analysis sees exactly what will run)
+            try:
+                self._step_compiled = self._step_jit.lower(*args).compile()
+            except Exception:
+                self._step_compiled = self._step_jit
+        compiled = (
+            None if self._step_compiled is self._step_jit
+            else self._step_compiled
+        )
+        art = artifact_from_executor_step(self, args, compiled)
+        report = AnalysisReport()
+        report.add_program(art.name)
+        report.extend(analyze_program(art))
+        self.last_analysis = report
+        self.analysis_violations = len(report.violations)
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.counter(
+                "analysis.violations", float(self.analysis_violations)
+            )
+        if not report.ok:
+            if self.verify_compiled == "strict":
+                raise AnalysisError(report)
+            print(report.format_human())
+
     def train_step(self, inputs: Sequence[Any], labels: Any) -> Tuple[float, Dict[str, float]]:
         tracer = get_tracer()
         if not (tracer.enabled or self.profiling or get_monitor().enabled):
@@ -1249,6 +1305,7 @@ class Executor:
             if self._step_jit is None:
                 self._step_jit = self._build_step()
                 self._step_compiled = None
+                self._verified_step = False
             inputs = [
                 self._place(x, self._input_pspec(t), t.shape[0])
                 for x, t in zip(inputs, self.graph_inputs)
@@ -1259,6 +1316,9 @@ class Executor:
                 self.params, self.state, self.opt_state, inputs, labels,
                 self._step_count,
             )
+            if self.verify_compiled != "off":
+                self._maybe_verify_compiled(args)
+                fn = self._step_compiled or fn
             try:
                 out = fn(*args)
             except Exception:
@@ -1291,6 +1351,7 @@ class Executor:
                 with tracer.span("build_step", cat="compile"):
                     self._step_jit = self._build_step()
                 self._step_compiled = None
+                self._verified_step = False
             with tracer.span("h2d_place", cat="step", level="op"):
                 inputs = [
                     self._place(x, self._input_pspec(t), t.shape[0])
@@ -1327,6 +1388,9 @@ class Executor:
                 self._record_memory_snapshot(tracer)
             else:
                 tracer.counter("jit.cache_hit")
+            if self.verify_compiled != "off":
+                with tracer.span("verify_compiled", cat="compile"):
+                    self._maybe_verify_compiled(args)
             t0 = time.perf_counter()
             try:
                 out = self._step_compiled(*args)
@@ -1366,6 +1430,10 @@ class Executor:
             "compile_s": compile_s,
             "jit_cache": "miss" if compile_s else "hit",
         }
+        if self.analysis_violations is not None:
+            self.last_step_stats["analysis_violations"] = (
+                self.analysis_violations
+            )
         if self.pipeline is not None:
             # pipeline dimension of this step (ffmetrics/1 nullable
             # fields + the pipeline.bubble_s counter): bubble seconds =

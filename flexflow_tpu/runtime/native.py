@@ -13,7 +13,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 

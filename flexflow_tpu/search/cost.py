@@ -685,6 +685,238 @@ def estimate_strategy_parts(
     return total, parts
 
 
+class ImpliedCollective:
+    """One collective the cost model expects GSPMD to lower for a strategy
+    (``flexflow_tpu.analysis`` reconciles these against the compiled HLO —
+    docs/ANALYSIS.md "Collective audit").
+
+    ``kind`` is the HLO instruction family (``all-reduce`` / ``all-gather``
+    / ``all-to-all`` / ``reduce-scatter`` / ``collective-permute``);
+    ``axes`` the mesh axes the collective runs over; ``required`` marks
+    entries whose ABSENCE from the lowering is itself a violation (grad
+    sync, the pipeline handoff) — optional entries only widen what the
+    lowering is allowed to contain."""
+
+    __slots__ = ("kind", "axes", "reason", "required")
+
+    def __init__(self, kind: str, axes, reason: str, required: bool = False):
+        self.kind = kind
+        self.axes = frozenset(axes)
+        self.reason = reason
+        self.required = required
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        req = " required" if self.required else ""
+        return (f"ImpliedCollective({self.kind} over "
+                f"{sorted(self.axes)}{req}: {self.reason})")
+
+
+def _transition_implied(src, dst, mesh, with_backward: bool, reason: str):
+    """The collectives one ``src -> dst`` layout change lowers to — the
+    same taxonomy :func:`reshard_cost` prices (all-reduce for partial
+    resolution, all-to-all for moved axes, all-gather for removed axes,
+    local slice for added axes), emitted as entries instead of seconds."""
+    out = []
+    pending = [a for a in src.partial_axes if a not in dst.partial_axes]
+    for a in pending:
+        if mesh.axis_size(a) > 1:
+            out.append(ImpliedCollective("all-reduce", {a}, reason + ":psum"))
+    src_map = {a: d for d in range(len(src.spec)) for a in src.axes_of(d)}
+    dst_map = {a: d for d in range(len(dst.spec)) for a in dst.axes_of(d)}
+    moved = [a for a in src_map if a in dst_map and src_map[a] != dst_map[a]]
+    removed = [a for a in src_map if a not in dst_map]
+    for a in moved:
+        if mesh.axis_size(a) > 1:
+            out.append(ImpliedCollective("all-to-all", {a}, reason + ":move"))
+    gaxes = {a for a in removed if mesh.axis_size(a) > 1}
+    if gaxes:
+        out.append(ImpliedCollective("all-gather", gaxes, reason + ":gather"))
+        if with_backward:
+            # transpose of an all-gather: reduce-scatter (or the
+            # partitioner's equivalent all-reduce + slice)
+            out.append(ImpliedCollective(
+                "reduce-scatter", gaxes, reason + ":gather-bwd"))
+            out.append(ImpliedCollective(
+                "all-reduce", gaxes, reason + ":gather-bwd"))
+    added = {a for a in dst_map if a not in src_map if mesh.axis_size(a) > 1}
+    if added and with_backward:
+        # forward is a local slice; the cotangent is gathered back
+        out.append(ImpliedCollective(
+            "all-gather", added, reason + ":slice-bwd"))
+    return out
+
+
+def implied_collectives(
+    layers: List[Layer],
+    strategy: Strategy,
+    forward_only: bool = False,
+    extra_axes: Tuple[str, ...] = (),
+) -> List["ImpliedCollective"]:
+    """The multiset of collectives ``strategy`` implies for the compiled
+    program — the reconciliation source for the analyzer's collective
+    audit (a placement that PRICES collective X but LOWERS collective Y
+    is flagged at compile time instead of in a bench regression).
+
+    Mirrors :func:`estimate_strategy_parts`'s walk exactly — parallel-op
+    reshards, implicit edge reshards, weight-grad sync, backward dgrad
+    sync — but collects (kind, axes) entries instead of seconds, so the
+    pricing model and the verification model can never drift apart.
+    Chains are walked unrolled (no costs are computed, so collapse buys
+    nothing; the entry SET is identical either way).
+
+    ``extra_axes`` admits optional all-gather/reduce-scatter over axes
+    the runtime adds outside the strategy walk (the executor's ZeRO-1
+    moment sharding gathers the param delta over its shard axes)."""
+    from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
+    from flexflow_tpu.parallel.spec import TensorSharding
+
+    mesh = strategy.mesh
+    out: List[ImpliedCollective] = []
+    pop_out: Dict[int, "TensorSharding"] = {}
+
+    def producer_sharding(t):
+        if t.guid in pop_out:
+            return pop_out[t.guid]
+        if t.owner_layer is None:
+            return None
+        prod = strategy.op_sharding(t.owner_layer)
+        if prod is None or t.owner_idx >= len(prod.output):
+            return None
+        return prod.output[t.owner_idx]
+
+    for layer in layers:
+        if layer.op_type.is_parallel_op:
+            t = layer.inputs[0]
+            src = producer_sharding(t) or TensorSharding.replicated(t.ndim)
+            dst = resolve_parallel_sharding(layer, src, mesh)
+            out.extend(_transition_implied(
+                src, dst, mesh,
+                with_backward=t.owner_layer is not None and not forward_only,
+                reason=layer.name,
+            ))
+            pop_out[layer.outputs[0].guid] = dst
+            continue
+        os_ = strategy.op_sharding(layer)
+        if os_ is None:
+            os_ = default_op_sharding(layer)
+        opdef = get_op_def(layer.op_type)
+        out0 = os_.output[0] if os_.output else None
+        # --- implicit edge reshards (same skip rule as the estimator) ---
+        for i, t in enumerate(layer.inputs):
+            src = producer_sharding(t)
+            if src is None:
+                continue
+            explicit = i < len(os_.inputs) and os_.inputs[i] is not None
+            dst = os_.inputs[i] if explicit else TensorSharding.replicated(t.ndim)
+            if not explicit and not src.partial_axes and not any(
+                "model" in src.axes_of(d) for d in range(len(src.spec))
+            ):
+                continue
+            out.extend(_transition_implied(
+                src, dst, mesh,
+                with_backward=t.owner_layer is not None and not forward_only,
+                reason=layer.name,
+            ))
+        # --- node collectives (same terms node_cost prices) ---
+        data_axes = set()
+        if out0 is not None:
+            for d in range(len(out0.spec)):
+                data_axes.update(out0.axes_of(d))
+            data_axes -= set(out0.partial_axes)
+            # forward partial sums a consumer resolves implicitly
+            for a in out0.partial_axes:
+                if mesh.axis_size(a) > 1:
+                    out.append(ImpliedCollective(
+                        "all-reduce", {a}, layer.name + ":partial"))
+        out_axes_all = set()
+        if out0 is not None:
+            for d in range(len(out0.spec)):
+                out_axes_all.update(out0.axes_of(d))
+            out_axes_all |= set(out0.partial_axes)
+        waxes_all = set()
+        for w in opdef.weights(layer):
+            if not w.trainable:
+                continue
+            ws = os_.weights.get(w.name)
+            waxes = set(ws.used_axes()) if ws is not None else set()
+            waxes_all |= waxes
+            # forward contraction over a weight-sharded axis the output
+            # does not carry (vocab-sharded embedding lookup, matmul
+            # contracting dim): each shard holds a partial sum the
+            # lowering resolves with a forward all-reduce
+            wpsum = {
+                a for a in waxes - out_axes_all if mesh.axis_size(a) > 1
+            }
+            if wpsum:
+                out.append(ImpliedCollective(
+                    "all-reduce", wpsum, f"{layer.name}.{w.name}:wpsum"))
+            sync_axes = {
+                a for a in data_axes - waxes if mesh.axis_size(a) > 1
+            }
+            if sync_axes and not forward_only:
+                # the one collective every training step MUST contain:
+                # weight grads partial over the data axes are resolved by
+                # an all-reduce (or a ZeRO reduce-scatter)
+                out.append(ImpliedCollective(
+                    "all-reduce", sync_axes,
+                    f"{layer.name}.{w.name}:grad-sync", required=True,
+                ))
+        if waxes_all and not forward_only:
+            in_axes = set()
+            for ts in os_.inputs:
+                if ts is not None:
+                    for d in range(len(ts.spec)):
+                        in_axes |= set(ts.axes_of(d))
+            for a in sorted(waxes_all - in_axes):
+                if mesh.axis_size(a) > 1:
+                    out.append(ImpliedCollective(
+                        "all-reduce", {a}, layer.name + ":dgrad-sync"))
+        if forward_only and data_axes:
+            # inference programs still reduce metrics/logits summaries
+            # across the data shards (loss mean, argmax agreement)
+            axes = {a for a in data_axes if mesh.axis_size(a) > 1}
+            if axes:
+                out.append(ImpliedCollective(
+                    "all-reduce", axes, layer.name + ":eval-reduce"))
+    # loss/metrics means cross every data-sharding axis of the step
+    all_data_axes = set()
+    for e in out:
+        if e.required:
+            all_data_axes |= e.axes
+    if all_data_axes:
+        out.append(ImpliedCollective(
+            "all-reduce", all_data_axes, "loss-mean"))
+    # runtime-added sharding axes (executor ZeRO-1): param delta
+    # all-gather + grad reduce-scatter over the shard axes
+    ex_axes = {a for a in extra_axes if mesh.axis_size(a) > 1}
+    if ex_axes:
+        out.append(ImpliedCollective("all-gather", ex_axes, "zero1:unshard"))
+        out.append(ImpliedCollective(
+            "reduce-scatter", ex_axes, "zero1:scatter"))
+        out.append(ImpliedCollective("all-reduce", ex_axes, "zero1"))
+    # pipeline handoff: the 1F1B stage boundary is an explicit ppermute
+    # (docs/PIPELINE.md — GSPMD's concat-shift alternative miscompiles,
+    # so the analyzer REQUIRES the permute form)
+    spec = strategy.pipeline
+    if spec is not None and mesh.axis_size(spec.stage_axis) == spec.stages:
+        out.append(ImpliedCollective(
+            "collective-permute", {spec.stage_axis}, "pipeline:handoff",
+            required=not forward_only,
+        ))
+        # the schedule's other traffic: output reassembly (last stage's
+        # rows -> global batch) over the stage axis, and the shard_map
+        # transpose's psums — differentiating the stage body inserts an
+        # all-reduce over every axis a captured operand is replicated
+        # along (check_rep is off inside shard_map).  Priced as xfer_s /
+        # epsilon by estimate_pipeline_step_time, tolerated here by kind.
+        out.append(ImpliedCollective(
+            "all-gather", {spec.stage_axis}, "pipeline:reassemble"))
+        for ax in mesh.axis_names:
+            out.append(ImpliedCollective(
+                "all-reduce", {ax}, "pipeline:grad"))
+    return out
+
+
 def stage_contended_machine(machine, stages: int):
     """Machine view for pricing a stage SUBMESH whose collectives still
     cross DCN while ``stages`` stages execute concurrently

@@ -12,7 +12,7 @@ the testability gap SURVEY §4.7 notes in the reference.
 
 from __future__ import annotations
 
-from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Set
 
 
 class BasicGraph:

@@ -11,12 +11,12 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 from flexflow_tpu.ops.base import get_op_def
 from flexflow_tpu.ops.base import _dtype_bytes
 from flexflow_tpu.parallel.machine import MachineMesh
-from flexflow_tpu.parallel.strategy import OpSharding, Strategy
+from flexflow_tpu.parallel.strategy import Strategy
 from flexflow_tpu.tensor import Layer
 
 

@@ -473,6 +473,19 @@ def _unity_search_impl(
     if forced_best is not None:
         best = forced_best[1]
     assert best is not None, "no feasible mesh factorization"
+    # attach the winner's implied collective multiset (docs/ANALYSIS.md):
+    # the golden tests and --verify-compiled reconcile the lowered
+    # program against exactly what this placement priced
+    try:
+        from flexflow_tpu.search.cost import implied_collectives
+
+        best.implied_collectives = implied_collectives(
+            best.rewritten_layers or layers,
+            best,
+            forward_only=(objective == "serve"),
+        )
+    except Exception:  # noqa: BLE001 — analysis must never block a search
+        best.implied_collectives = None
     if profiler is not None:
         profiler.save()  # persist the cost cache across sessions
     if mcms:

@@ -291,6 +291,32 @@ class ServeEngine:
         # layout, recompiling both donated programs once per layout
         self.kv.cache_k, self.kv.cache_v = ck, cv
 
+        # --verify-compiled (docs/ANALYSIS.md): the executor's post-
+        # compile ffcheck pass, applied to the serve programs — the
+        # transfer/donation/dtype audits carry the zero-sync-serve and
+        # paged-KV-donation guarantees at the program level
+        self.last_analysis = None
+        self.analysis_violations: Optional[int] = None
+        vc = getattr(model.config, "verify_compiled", "off")
+        if vc != "off":
+            from flexflow_tpu.analysis import (
+                AnalysisError,
+                analyze_serve_engine,
+            )
+
+            report = analyze_serve_engine(self)
+            self.last_analysis = report
+            self.analysis_violations = len(report.violations)
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.counter(
+                    "analysis.violations", float(self.analysis_violations)
+                )
+            if not report.ok:
+                if vc == "strict":
+                    raise AnalysisError(report)
+                print(report.format_human())
+
         # --- loop state ---------------------------------------------------
         self.windows = 0
         self.decode_steps = 0

@@ -1,0 +1,473 @@
+"""ffcheck analyzer tests (docs/ANALYSIS.md).
+
+Three layers of coverage for the compiled-program static analyzer:
+
+* seeded-violation mini-programs — one deliberately broken program per
+  registered check, each caught by EXACTLY its intended check (a check
+  that fires on its neighbor's seed is mis-scoped);
+* clean passes — the reference configs (MLP, DLRM, gpt_decode serve,
+  the searched 2-stage pipeline) analyze clean, pinning the donation /
+  sync / dtype / collective hygiene of the shipped paths;
+* wiring — the ``--verify-compiled`` knob (strict raises before the
+  first step runs, warn records ``analysis_violations`` + the
+  ``analysis.violations`` tracer counter), the unity_search winner
+  carrying its priced implied-collective set, and the ffmetrics /
+  bench_compare interop for the new nullable field.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import flexflow_tpu  # noqa: F401  (pins the CPU platform via conftest)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    """Import a tools/ script as a module (tools/ is not a package)."""
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------------------------- helpers
+def _mlp24(verify="off", dp_only=False, batch=16):
+    """Small MLP on a dp2 x tp4 mesh; TP by default (so the lowering
+    carries model-axis collectives the implied set must price)."""
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, MachineMesh
+    from flexflow_tpu.fftype import LossType
+    from flexflow_tpu.optimizer import AdamOptimizer
+    from flexflow_tpu.parallel.strategy import (
+        data_parallel_strategy,
+        tensor_parallel_strategy,
+    )
+
+    m = FFModel(FFConfig(batch_size=batch, verify_compiled=verify))
+    x = m.create_tensor((batch, 32))
+    t = m.dense(x, 256, ActiMode.RELU)
+    t = m.dense(t, 10)
+    m.softmax(t)
+    mesh = MachineMesh((2, 4), ("data", "model"))
+    fn = data_parallel_strategy if dp_only else tensor_parallel_strategy
+    m.compile(optimizer=AdamOptimizer(alpha=1e-3),
+              loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              metrics=[], strategy=fn(m.layers, mesh))
+    return m
+
+
+def _mlp_batch(batch=16):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 32)).astype(np.float32)
+    y = rng.integers(0, 10, size=(batch, 1)).astype(np.int32)
+    return x, y
+
+
+def _fit_artifact(ex):
+    """The executor's fit-step artifact with a real AOT executable —
+    the same capture the --verify-compiled hook performs."""
+    from flexflow_tpu.analysis import artifact_from_executor_step
+    from flexflow_tpu.analysis.capture import _synth_batch
+
+    xs_np, y_np = _synth_batch(ex)
+    inputs = [
+        ex._place(x, ex._input_pspec(t), t.shape[0])
+        for x, t in zip(xs_np, ex.graph_inputs)
+    ]
+    labels = ex._place(y_np, ex._label_pspec(), ex.graph_inputs[0].shape[0])
+    if ex._step_jit is None:
+        ex._step_jit = ex._build_step()
+    args = (ex.params, ex.state, ex.opt_state, inputs, labels, 0)
+    compiled = ex._step_jit.lower(*args).compile()
+    ex._step_compiled = compiled
+    return artifact_from_executor_step(ex, args, compiled)
+
+
+# -------------------------------------------- registry + config plumbing
+def test_registry_carries_the_five_checks_and_rejects_unknown():
+    from flexflow_tpu.analysis import CHECKS, ProgramArtifact, analyze_program
+
+    art = ProgramArtifact(name="empty", role="fit")
+    assert analyze_program(art) == []  # checks are total: missing inputs skip
+    assert {"collective", "transfer", "donation", "dtype",
+            "replication"} <= set(CHECKS)
+    with pytest.raises(KeyError):
+        analyze_program(art, checks=["no_such_check"])
+
+
+def test_verify_compiled_cli_knob_parses():
+    from flexflow_tpu import FFConfig
+
+    cfg = FFConfig()
+    assert cfg.verify_compiled == "off"
+    rest = cfg.parse_args(["--verify-compiled", "strict", "extra"])
+    assert cfg.verify_compiled == "strict"
+    assert "extra" in rest
+
+
+# ------------------------------------- seeded violations (one per check)
+def test_seeded_host_callback_caught_by_transfer_check():
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.analysis import analyze_program, capture_jit
+
+    def host_double(x):
+        return np.asarray(x) * 2.0  # host round-trip inside the step
+
+    def f(x):
+        y = jax.pure_callback(
+            host_double, jax.ShapeDtypeStruct(x.shape, x.dtype), x
+        )
+        return y + 1.0
+
+    art = capture_jit(
+        "seed.transfer", "fit", jax.jit(f),
+        (jnp.ones((8, 8), jnp.float32),), expects_donation=False,
+    )
+    vs = analyze_program(art)
+    assert vs, "the host callback must be caught"
+    assert all(v.check == "transfer" for v in vs), vs
+    assert any("pure_callback" in v.message for v in vs)
+
+
+def test_seeded_dropped_donation_caught_by_donation_check():
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.analysis import analyze_program, capture_jit
+
+    def sgd(w, g):
+        return w - 0.1 * g
+
+    w = jnp.ones((512, 1024), jnp.float32)  # 2 MiB — above the floor
+    g = jnp.zeros((512, 1024), jnp.float32)
+    art = capture_jit("seed.donation", "fit", jax.jit(sgd), (w, g),
+                      arg_names=("w", "g"))
+    vs = analyze_program(art)
+    assert vs, "the eligible-but-not-donated buffer must be caught"
+    assert all(v.check == "donation" for v in vs), vs
+    assert any("w" in v.where for v in vs)
+
+    # donating the weight fixes it — the fixed program analyzes clean
+    art2 = capture_jit(
+        "seed.donation.fixed", "fit",
+        jax.jit(sgd, donate_argnums=(0,)), (w, g), arg_names=("w", "g"),
+    )
+    assert analyze_program(art2) == []
+
+
+def test_seeded_fp32_dot_caught_by_dtype_check():
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.analysis import analyze_program, capture_jit
+
+    def f(a, b):
+        h = jnp.dot(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16))
+        leak = jnp.dot(a, b)  # fp32 contraction inside the bf16 region
+        return h.astype(jnp.float32) + leak
+
+    a = jnp.ones((128, 64), jnp.float32)  # 8192 elems — above the floor
+    b = jnp.ones((64, 64), jnp.float32)
+    art = capture_jit("seed.dtype", "fit", jax.jit(f), (a, b),
+                      compute_dtype="bfloat16", expects_donation=False)
+    vs = analyze_program(art)
+    assert vs, "the fp32 dot in the bf16 region must be caught"
+    assert all(v.check == "dtype" for v in vs), vs
+    assert any("dot_general" in v.message for v in vs)
+
+
+def test_seeded_replicated_weight_caught_by_replication_check():
+    from flexflow_tpu.analysis import analyze_program
+    from flexflow_tpu.parallel.strategy import tensor_parallel_strategy
+
+    # compiled data-parallel: every weight genuinely lowers fully
+    # replicated; reconciling against a TP strategy that shards them is
+    # exactly the dropped-sharding-constraint failure the check hunts
+    model = _mlp24(dp_only=True)
+    ex = model.executor
+    art = _fit_artifact(ex)
+    assert analyze_program(art) == []  # consistent: DP vs DP is clean
+    art.strategy = tensor_parallel_strategy(ex.layers, ex.strategy.mesh)
+    vs = analyze_program(art)
+    assert vs, "the replicated-but-priced-sharded weight must be caught"
+    assert all(v.check == "replication" for v in vs), vs
+    assert any("kernel" in v.where for v in vs)
+
+
+def test_seeded_mispriced_strategy_caught_by_collective_check():
+    from flexflow_tpu.analysis import analyze_program
+    from flexflow_tpu.parallel.strategy import data_parallel_strategy
+    from flexflow_tpu.search.cost import implied_collectives
+
+    # compiled tensor-parallel (model-axis psums in the lowering), but
+    # priced as pure data-parallel: the cost model never accounted for
+    # the TP collectives — the placement-vs-pricing divergence the
+    # audit exists for
+    model = _mlp24()
+    ex = model.executor
+    art = _fit_artifact(ex)
+    assert analyze_program(art) == []  # consistent pricing is clean
+    dp = data_parallel_strategy(ex.layers, ex.strategy.mesh)
+    art.implied = implied_collectives(ex.layers, dp)
+    art.strategy = dp  # keep strategy/implied consistent with each other
+    vs = analyze_program(art, checks=["collective"])
+    assert vs, "the mispriced strategy must be caught"
+    assert all(v.check == "collective" for v in vs), vs
+    assert any("lowered-not-priced" in v.message for v in vs)
+
+
+# ------------------------------------------------ --verify-compiled hook
+def test_strict_reconciles_the_8dev_golden_and_fails_when_mispriced(
+    monkeypatch,
+):
+    import __graft_entry__ as ge
+
+    from flexflow_tpu.analysis import AnalysisError
+    from flexflow_tpu.analysis import capture as cap
+    from flexflow_tpu.parallel.strategy import data_parallel_strategy
+    from flexflow_tpu.search.cost import implied_collectives
+
+    model = ge._build(
+        batch=4, seq=32, hidden=128, heads=8, ff_dim=256,
+        num_layers=2, num_classes=8, mesh_shape=(2, 2, 2),
+    )
+    ex = model.executor
+    ex.verify_compiled = "strict"
+    x = np.random.default_rng(0).normal(size=(4, 32, 128)).astype(np.float32)
+    y = np.zeros((4, 1), np.int32)
+    # strict verify runs before the first step executes — a clean
+    # reconcile on the dp x tp x sp golden lets training proceed
+    loss, _ = ex.train_step([x], y)
+    assert np.isfinite(float(loss))
+    assert ex.analysis_violations == 0
+    assert ex.last_analysis is not None and ex.last_analysis.ok
+
+    # deliberately misprice: reconcile the same compiled program against
+    # a pure data-parallel implied set (prices nothing over model/seq)
+    dp = data_parallel_strategy(ex.layers, ex.strategy.mesh)
+    monkeypatch.setattr(
+        cap, "_executor_implied",
+        lambda e, forward_only: implied_collectives(
+            e.layers, dp, forward_only=forward_only
+        ),
+    )
+    ex._verified_step = False  # force re-verification of the same program
+    with pytest.raises(AnalysisError) as ei:
+        ex.train_step([x], y)
+    assert ei.value.report.counts().get("collective", 0) > 0
+    assert ex.analysis_violations > 0
+
+
+def test_warn_mode_records_count_emits_counter_and_runs_once(monkeypatch):
+    from flexflow_tpu.obs import Tracer, configure, set_tracer
+
+    model = _mlp24(verify="warn")
+    ex = model.executor
+    tracer = configure(level="step")
+    try:
+        x, y = _mlp_batch()
+        ex.train_step([x], y)
+        assert ex.analysis_violations == 0
+        assert ex.last_analysis is not None and ex.last_analysis.ok
+        assert ex.last_step_stats["analysis_violations"] == 0
+        assert tracer.summary()["counters"]["analysis.violations"] == 0.0
+        first = ex.last_analysis
+        ex.train_step([x], y)
+        assert ex.last_analysis is first  # one verify per compile
+    finally:
+        set_tracer(Tracer())
+
+
+def test_warn_mode_reports_but_never_raises(monkeypatch, capsys):
+    from flexflow_tpu.analysis import capture as cap
+
+    model = _mlp24(verify="warn")
+    ex = model.executor
+    # sabotage: an empty priced set makes every lowered collective a
+    # violation — warn must report and keep training
+    monkeypatch.setattr(cap, "_executor_implied", lambda e, fwd_only=None,
+                        **kw: [])
+    x, y = _mlp_batch()
+    loss, _ = ex.train_step([x], y)
+    assert np.isfinite(float(loss))
+    assert ex.analysis_violations > 0
+    assert not ex.last_analysis.ok
+    assert "violation" in capsys.readouterr().out
+
+
+# ------------------------------------------------ clean reference configs
+def test_ffcheck_mlp_config_clean():
+    ffcheck = _load_tool("ffcheck")
+    rep = ffcheck.analyze_config("mlp")
+    assert rep.ok, rep.format_human()
+    assert set(rep.programs) == {"mlp.fit", "mlp.eval"}
+
+
+def test_ffcheck_dlrm_config_clean():
+    ffcheck = _load_tool("ffcheck")
+    rep = ffcheck.analyze_config("dlrm")
+    assert rep.ok, rep.format_human()
+    assert set(rep.programs) == {"dlrm.fit", "dlrm.eval"}
+
+
+def test_ffcheck_pipelined_config_clean():
+    from flexflow_tpu.analysis import analyze_executor
+
+    ffcheck = _load_tool("ffcheck")
+    model = ffcheck._build_pipelined()
+    # the searched pipelined winner prices its stage handoff as a
+    # REQUIRED collective-permute (docs/PIPELINE.md: the ppermute-vs-
+    # concat-shift choice is analyzer-pinned via this entry)
+    ic = model.executor.strategy.implied_collectives
+    assert ic, "pipelined winner must carry its implied set"
+    assert any(
+        e.required and e.reason == "pipeline:handoff"
+        and e.kind == "collective-permute"
+        for e in ic
+    )
+    rep = analyze_executor(model.executor, programs=("fit",))
+    assert rep.ok, rep.format_human()
+
+
+@pytest.fixture(scope="module")
+def gpt_engine():
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.transformer import gpt_decoder
+    from flexflow_tpu.serve import ServeEngine
+
+    slots = 4
+    gm = FFModel(FFConfig(batch_size=slots))
+    gpt_decoder(gm, slots, 48, use_flash=False, hidden=32, heads=4,
+                ff_dim=64, num_layers=2, vocab=31)
+    gm.compile(seed=0)
+    return ServeEngine(gm, slots=slots, block_size=8, sync_every=4)
+
+
+def test_serve_programs_clean(gpt_engine):
+    from flexflow_tpu.analysis import analyze_serve_engine
+
+    rep = analyze_serve_engine(gpt_engine)
+    assert rep.ok, rep.format_human()
+    assert set(rep.programs) == {"serve.decode", "serve.prefill"}
+
+
+# ----------------------------------------------- donation cleanliness pins
+def test_fit_step_donates_params_state_and_opt_state():
+    from flexflow_tpu.analysis import analyze_program
+
+    art = _fit_artifact(_mlp24().executor)
+    donated = {label for label, _, _, d in art.inputs if d}
+    assert any(l.startswith("params") for l in donated), donated
+    assert any(l.startswith("opt_state") for l in donated), donated
+    assert analyze_program(art, checks=["donation"]) == []
+    # honored at lowering, not just declared at trace time
+    assert "input_output_alias" in art.hlo
+
+
+def test_serve_decode_donates_the_paged_kv_pools(gpt_engine):
+    import jax.numpy as jnp
+
+    from flexflow_tpu.analysis import analyze_program, capture_jit
+
+    eng = gpt_engine
+    ex = eng.model.executor
+    kv = eng.kv
+    B, MB = eng.slots, kv.max_blocks_per_seq
+    z = jnp.zeros((B,), jnp.int32)
+    bt = jnp.zeros((B, MB), jnp.int32)
+    art = capture_jit(
+        "serve.decode", "decode", eng._decode,
+        (ex.params, kv.cache_k, kv.cache_v, z, z, bt),
+        arg_names=("params", "cache_k", "cache_v", "tok", "pos",
+                   "block_tables"),
+    )
+    donated = {label for label, _, _, d in art.inputs if d}
+    assert any(l.startswith("cache_k") for l in donated), donated
+    assert any(l.startswith("cache_v") for l in donated), donated
+    assert analyze_program(art, checks=["donation"]) == []
+
+
+# --------------------------------------------------- search integration
+def test_unity_search_winner_carries_its_implied_collective_set():
+    from flexflow_tpu import FFConfig, FFModel, MachineMesh
+    from flexflow_tpu.models.transformer import transformer_encoder
+    from flexflow_tpu.parallel.network import load_machine_model
+    from flexflow_tpu.search import unity_search
+    from flexflow_tpu.search.cost import ImpliedCollective
+
+    B, S, H = 64, 32, 128
+    m = FFModel(FFConfig(batch_size=B))
+    transformer_encoder(
+        m, batch=B, seq=S, hidden=H, heads=8, ff_dim=4 * H,
+        num_layers=2, vocab=50, num_classes=8, use_flash=False,
+        raw_input=True,
+    )
+    machine = load_machine_model(os.path.join(
+        REPO, "examples", "machine_configs", "v5p_2slice.json"))
+    st = unity_search(
+        m.layers, MachineMesh((2, 4), ("data", "model")),
+        graph_inputs=m.graph_inputs, budget=6, machine=machine,
+        explore_meshes=False,
+    )
+    ic = st.implied_collectives
+    assert ic, "the search winner must carry its priced implied set"
+    assert all(isinstance(e, ImpliedCollective) for e in ic)
+    # a data-sharded winner must price its grad sync as REQUIRED — the
+    # entry --verify-compiled strict reconciles against the lowering
+    assert any(e.required and "grad-sync" in e.reason for e in ic)
+
+
+# --------------------------------------- ffmetrics / bench_compare interop
+def test_step_record_analysis_violations_interop(tmp_path):
+    from flexflow_tpu.obs.metrics import RECORD_FIELDS, step_record
+
+    assert "analysis_violations" in RECORD_FIELDS
+    new = step_record(step=0, t=0.0, analysis_violations=2)
+    assert new["analysis_violations"] == 2
+    default = step_record(step=1, t=1.0)
+    assert default["analysis_violations"] is None
+    assert default["schema"] == "ffmetrics/1"  # adding fields keeps /1
+
+    # a record from an old producer (no field at all) still parses and
+    # gates through the stream reader
+    bc = _load_tool("bench_compare")
+    old = step_record(step=0, t=0.0, step_wall_s=0.1, samples=8)
+    del old["analysis_violations"]
+    stream = tmp_path / "m.jsonl"
+    stream.write_text(json.dumps(old) + "\n")
+    loaded = bc.load_record(str(stream))
+    assert loaded is not None
+    assert loaded["value"] == old["samples_per_s"]
+
+
+def test_bench_compare_gates_analysis_violations_at_zero(tmp_path, capsys):
+    bc = _load_tool("bench_compare")
+
+    def write(name, rec):
+        p = tmp_path / name
+        p.write_text(json.dumps(rec))
+        return str(p)
+
+    base = write("base.json",  # legacy baseline: predates the field
+                 {"metric": "m", "value": 100.0, "backend": "cpu"})
+    dirty = write("dirty.json", {"metric": "m", "value": 100.0,
+                                 "backend": "cpu", "analysis_violations": 2})
+    clean = write("clean.json", {"metric": "m", "value": 100.0,
+                                 "backend": "cpu", "analysis_violations": 0})
+    off = write("off.json", {"metric": "m", "value": 100.0,
+                             "backend": "cpu", "analysis_violations": None})
+    # any non-zero count fails, even against a baseline without the field
+    assert bc.main([dirty, "--baseline", base]) == 1
+    assert "analysis_violations" in capsys.readouterr().out
+    assert bc.main([clean, "--baseline", base]) == 0
+    # null (verify off) and legacy records are not gated
+    assert bc.main([off, "--baseline", base]) == 0
+    assert bc.main([base, "--baseline", clean]) == 0
+    capsys.readouterr()

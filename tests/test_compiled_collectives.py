@@ -33,12 +33,23 @@ def _build_transformer_step():
 
 
 def test_transformer_dp_tp_sp_step_compiles_without_full_remat(capfd):
+    from flexflow_tpu.analysis import extract_collectives
+
     ex, step, xs, ys = _build_transformer_step()
     capfd.readouterr()
     compiled = step.lower(ex.params, ex.state, ex.opt_state, xs, ys, 0).compile()
     err = capfd.readouterr().err
     assert "Involuntary full rematerialization" not in err, err
     txt = compiled.as_text()
+    # The budgets count via the analyzer's shared HLO walker
+    # (flexflow_tpu.analysis.extract_collectives) — the same extraction
+    # ffcheck's collective audit reconciles, so the budget tests and the
+    # analyzer can never disagree about what counts as a collective.
+    # The walker must be byte-identical to the raw text scan it replaced
+    # (`-start` async forms count as the op): pinned here.
+    summary = extract_collectives(txt)
+    assert summary["all-gather"] == txt.count(" all-gather(")
+    assert summary["all-reduce"] == txt.count(" all-reduce(")
     # collective budget for 2 encoder blocks under dp=2 x tp=2 x sp=2:
     # measured at pin time 5 all-gathers + 16 all-reduces (TP boundary
     # psums fwd+bwd, SP gathers, grad sync); headroom for XLA drift, but
@@ -46,9 +57,9 @@ def test_transformer_dp_tp_sp_step_compiles_without_full_remat(capfd):
     # Re-measured 17 all-gathers under this jaxlib's SPMD partitioner
     # (tier-1 triage, ISSUE 8) — the budget tracks partitioner drift
     # while the ~40 weights keep the fallback bound an order above it.
-    n_ag = txt.count(" all-gather(")
+    n_ag = summary["all-gather"]
     assert n_ag <= 20, f"all-gather count regressed: {n_ag}"
-    n_ar = txt.count(" all-reduce(")
+    n_ar = summary["all-reduce"]
     # 16 at pin time; re-measured 82 under this jaxlib (the partitioner
     # now emits per-weight grad reductions instead of fusing them) —
     # verified identical at the pre-PR commit, so the budget tracks the

@@ -103,6 +103,18 @@ GATED = (
     ("gpt_decode_cached", ("secondary", "gpt_decode", "cached_tok_per_s"), True),
 )
 
+# (label, path) — metrics gated AT ZERO: any non-zero current value is a
+# failure, regardless of the baseline (the ratio machinery in GATED
+# would skip a 0-or-missing baseline, silently passing a 0 -> N
+# regression).  analysis_violations (r10, docs/ANALYSIS.md) is the
+# --verify-compiled ffcheck violation count for the headline step: the
+# compiled program drifting from its priced strategy is a correctness
+# regression at ANY threshold.  A null/missing current value (record
+# predates the field, or verify_compiled=off) is not gated.
+ZERO_GATED = (
+    ("analysis_violations", ("analysis_violations",)),
+)
+
 
 def _dig(d: Any, path: Tuple[str, ...]) -> Optional[float]:
     for k in path:
@@ -181,6 +193,23 @@ def compare(
                 if higher
                 else ratio > (1.0 + threshold)
             ),
+        })
+    for label, path in ZERO_GATED:
+        cur = _dig(current, path)
+        if cur is None:
+            continue
+        base = _dig(baseline, path) or 0.0
+        rows.append({
+            "metric": label,
+            "baseline": base,
+            "current": cur,
+            "ratio": (
+                cur / base if base > 0
+                else (1.0 if cur == 0 else float("inf"))
+            ),
+            # zero-gate: threshold-free — any non-zero count fails even
+            # when the baseline predates the field (base treated as 0)
+            "regressed": cur > 0,
         })
     return rows
 

@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""ffcheck: static analysis of compiled flexflow_tpu programs.
+
+Usage:
+    python tools/ffcheck.py [--configs mlp,dlrm,...] [--json] [--out F]
+                            [--checks collective,transfer,...] [--strict]
+
+Builds each named reference config on the host platform (8 virtual CPU
+devices, the same harness the tests use), compiles its programs, and
+runs the analyzer registry (flexflow_tpu.analysis, docs/ANALYSIS.md)
+over what the compiler actually produced:
+
+  * ``mlp``          — bf16 MLP, 1x8 data-parallel mesh (fit + eval)
+  * ``dlrm``         — vocab-sharded embeddings, dp2 x tp4 (fit + eval)
+  * ``gpt_decode``   — ServeEngine paged decode + chunked prefill
+  * ``stacked_bert`` — scan-stacked encoder, dp2 x tp4 (fit)
+  * ``pipelined``    — searched 2-stage 1F1B pipeline on the 2-slice
+                       machine model, real stage submeshes (fit)
+
+Exit status: 0 when every analyzed program is clean, 1 when any check
+reports a violation (``--strict`` additionally raises on the spot so
+the failing config's traceback is preserved).
+
+The report is the ``ffcheck/1`` JSON schema (``--json``) or the human
+listing; both come from the same AnalysisReport.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    (os.environ.get("XLA_FLAGS", "") +
+     " --xla_force_host_platform_device_count=8").strip(),
+)
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIGS = ("mlp", "dlrm", "gpt_decode", "stacked_bert", "pipelined")
+
+
+def _build_mlp():
+    """bf16 MLP on a 1x8 data-parallel mesh: the dtype + donation audits
+    on the smallest interesting program."""
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, MachineMesh
+    from flexflow_tpu.fftype import LossType
+    from flexflow_tpu.optimizer import AdamOptimizer
+    from flexflow_tpu.parallel.strategy import data_parallel_strategy
+
+    batch = 16
+    m = FFModel(FFConfig(batch_size=batch, compute_dtype="bfloat16"))
+    x = m.create_tensor((batch, 32))
+    t = m.dense(x, 64, ActiMode.RELU)
+    t = m.dense(t, 64, ActiMode.RELU)
+    t = m.dense(t, 10)
+    m.softmax(t)
+    mesh = MachineMesh((8,), ("data",))
+    st = data_parallel_strategy(m.layers, mesh)
+    m.compile(optimizer=AdamOptimizer(alpha=1e-3),
+              loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              metrics=[], strategy=st)
+    return m
+
+
+def _build_dlrm():
+    """DLRM with vocab-sharded embedding tables over the model axis —
+    the forward-psum (wpsum) and replication audits."""
+    from flexflow_tpu import FFConfig, FFModel, MachineMesh
+    from flexflow_tpu.fftype import LossType
+    from flexflow_tpu.models import dlrm, dlrm_strategy
+    from flexflow_tpu.optimizer import AdamOptimizer
+
+    batch = 8
+    m = FFModel(FFConfig(batch_size=batch))
+    dlrm(m, batch, embedding_sizes=(1024, 1024, 512),
+         sparse_feature_size=16, bag_size=2, mlp_bot=(4, 16, 16),
+         mlp_top=(64, 16, 2))
+    mesh = MachineMesh((2, 4), ("data", "model"))
+    st = dlrm_strategy(m.layers, mesh)
+    m.compile(optimizer=AdamOptimizer(alpha=1e-3),
+              loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+              metrics=[], mesh=mesh, strategy=st)
+    return m
+
+
+def _build_stacked_bert():
+    """Scan-stacked encoder on dp2 x tp4: the analyzer must see through
+    the lax.scan body (collectives inside the scan count once per HLO
+    instruction, the jaxpr walk recurses into the body)."""
+    from flexflow_tpu import FFConfig, FFModel, MachineMesh
+    from flexflow_tpu.fftype import LossType
+    from flexflow_tpu.models.transformer import transformer_encoder
+    from flexflow_tpu.optimizer import AdamOptimizer
+    from flexflow_tpu.parallel.strategy import data_parallel_strategy
+
+    B, S, H = 8, 16, 64
+    m = FFModel(FFConfig(batch_size=B, stack_blocks="on"))
+    transformer_encoder(
+        m, batch=B, seq=S, hidden=H, heads=4, ff_dim=4 * H,
+        num_layers=4, vocab=50, num_classes=8, use_flash=False,
+        raw_input=True,
+    )
+    mesh = MachineMesh((2, 4), ("data", "model"))
+    st = data_parallel_strategy(m.layers, mesh)
+    m.compile(optimizer=AdamOptimizer(alpha=1e-3),
+              loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              metrics=[], strategy=st)
+    return m
+
+
+def _build_pipelined():
+    """Searched 2-stage 1F1B pipeline on the shipped v5p 2-slice machine
+    model — REAL stage submeshes (the stage axis has extent 2), so the
+    handoff collective-permute is lowered and the required
+    ``pipeline:handoff`` implied entry must reconcile."""
+    from flexflow_tpu import FFConfig, FFModel, MachineMesh
+    from flexflow_tpu.fftype import LossType
+    from flexflow_tpu.models.transformer import transformer_encoder
+    from flexflow_tpu.optimizer import AdamOptimizer
+    from flexflow_tpu.parallel.network import load_machine_model
+    from flexflow_tpu.search import unity_search
+
+    B, S, H = 8, 16, 64
+    m = FFModel(FFConfig(batch_size=B))
+    transformer_encoder(
+        m, batch=B, seq=S, hidden=H, heads=4, ff_dim=4 * H,
+        num_layers=4, vocab=50, num_classes=8, use_flash=False,
+        raw_input=True,
+    )
+    machine = load_machine_model(
+        os.path.join(REPO, "examples", "machine_configs", "v5p_2slice.json")
+    )
+    mesh = MachineMesh((2, 4), ("data", "model"))
+    st = unity_search(
+        m.layers, mesh, graph_inputs=m.graph_inputs, budget=6,
+        machine=machine, pipeline="2", explore_meshes=False,
+    )
+    m.compile(optimizer=AdamOptimizer(alpha=1e-3),
+              loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              metrics=[], strategy=st)
+    assert m.executor.pipeline is not None, "pipeline declined at executor"
+    return m
+
+
+def analyze_config(name: str, checks=None):
+    """Build config ``name``, return its AnalysisReport (programs
+    renamed ``<config>.<program>`` so the merged report reads)."""
+    from flexflow_tpu.analysis import analyze_executor, analyze_serve_engine
+
+    if name == "gpt_decode":
+        from flexflow_tpu import FFConfig, FFModel
+        from flexflow_tpu.models.transformer import gpt_decoder
+        from flexflow_tpu.serve import ServeEngine
+
+        slots, seq, vocab = 4, 48, 31
+        gm = FFModel(FFConfig(batch_size=slots))
+        gpt_decoder(gm, slots, seq, use_flash=False, hidden=32, heads=4,
+                    ff_dim=64, num_layers=2, vocab=vocab)
+        gm.compile(seed=0)
+        eng = ServeEngine(gm, slots=slots, block_size=8, sync_every=4)
+        report = analyze_serve_engine(eng, checks=checks)
+    else:
+        builder = {
+            "mlp": _build_mlp,
+            "dlrm": _build_dlrm,
+            "stacked_bert": _build_stacked_bert,
+            "pipelined": _build_pipelined,
+        }[name]
+        model = builder()
+        programs = ("fit",) if name == "pipelined" else ("fit", "eval")
+        report = analyze_executor(model.executor, programs=programs,
+                                  checks=checks)
+    report.programs = [f"{name}.{p}" for p in report.programs]
+    for v in report.violations:
+        v.program = f"{name}.{v.program}"
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--configs", default=",".join(CONFIGS),
+                    help="comma list from: " + ", ".join(CONFIGS))
+    ap.add_argument("--checks", default=None,
+                    help="comma list of checks (default: all registered)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the ffcheck/1 JSON report")
+    ap.add_argument("--out", default=None,
+                    help="write the report to this file instead of stdout")
+    ap.add_argument("--strict", action="store_true",
+                    help="raise AnalysisError on the first dirty config")
+    args = ap.parse_args(argv)
+
+    from flexflow_tpu.analysis import AnalysisError, AnalysisReport
+
+    names = [c.strip() for c in args.configs.split(",") if c.strip()]
+    for n in names:
+        if n not in CONFIGS:
+            ap.error(f"unknown config {n!r}; choose from {CONFIGS}")
+    checks = (
+        [c.strip() for c in args.checks.split(",") if c.strip()]
+        if args.checks else None
+    )
+
+    merged = AnalysisReport()
+    for n in names:
+        print(f"[ffcheck] analyzing {n} ...", file=sys.stderr)
+        rep = analyze_config(n, checks=checks)
+        for p in rep.programs:
+            merged.add_program(p)
+        merged.extend(rep.violations)
+        if args.strict and not rep.ok:
+            raise AnalysisError(rep)
+
+    text = merged.to_json() if args.json else merged.format_human()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"[ffcheck] report written to {args.out}", file=sys.stderr)
+    else:
+        print(text)
+    return 0 if merged.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,24 @@
+#!/bin/sh
+# tier-0 lint gate (docs/ANALYSIS.md "Static gates").
+#
+# Runs ruff with the pyproject [tool.ruff] config when ruff is on PATH;
+# otherwise falls back to a stdlib AST pass (tools/lint_fallback.py)
+# covering the correctness core of the same rule set — undefined names
+# never make it to tier-1 either way, and the gate works in hermetic
+# containers that cannot pip install.
+#
+# Usage: tools/lint.sh [paths...]   (default: flexflow_tpu tools tests bench.py)
+
+set -e
+cd "$(dirname "$0")/.."
+PATHS="${*:-flexflow_tpu tools tests bench.py}"
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "[lint] ruff check $PATHS"
+    # shellcheck disable=SC2086
+    exec ruff check $PATHS
+fi
+
+echo "[lint] ruff not installed — stdlib fallback (tools/lint_fallback.py)"
+# shellcheck disable=SC2086
+exec python tools/lint_fallback.py $PATHS

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Stdlib fallback for tools/lint.sh when ruff is not installed.
+
+Covers the correctness core of the pyproject ruff gate with nothing but
+``ast``:
+
+  * E9  — syntax errors (the file does not parse)
+  * F401 — module-level imports never used in the file (skipped for
+    ``__init__.py`` re-export surfaces and ``tests/``, mirroring the
+    pyproject per-file-ignores; ``# noqa`` on the import line opts out)
+
+Anything beyond that (undefined names across scopes, f-string checks)
+waits for real ruff — the fallback must never false-positive, because a
+lint gate that cries wolf gets deleted.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Iterator, List, Tuple
+
+SKIP_DIRS = {".git", "__pycache__", ".claude", "related"}
+
+
+def iter_py_files(paths: List[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def used_names(tree: ast.AST) -> set:
+    """Every identifier the module body references (Name loads,
+    attribute roots, decorators, string annotations are approximated by
+    Name nodes only — conservative: more "used" than real)."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # a.b.c marks "a" used via its Name child; nothing extra
+            pass
+    return out
+
+
+def check_file(path: str) -> List[Tuple[int, str, str]]:
+    with open(path, "rb") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(e.lineno or 0, "E999", f"syntax error: {e.msg}")]
+
+    issues: List[Tuple[int, str, str]] = []
+    base = os.path.basename(path)
+    in_tests = f"{os.sep}tests{os.sep}" in path or path.startswith("tests")
+    if base == "__init__.py" or in_tests:
+        return issues
+
+    lines = src.decode("utf-8", "replace").splitlines()
+    used = used_names(tree)
+    exported = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    for elt in getattr(node.value, "elts", []):
+                        if isinstance(elt, ast.Constant):
+                            exported.add(str(elt.value))
+    for node in tree.body:
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "noqa" in line:
+            continue
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            bound = (alias.asname or alias.name).split(".")[0]
+            if bound not in used and bound not in exported:
+                issues.append((
+                    node.lineno, "F401",
+                    f"'{alias.name}' imported but unused",
+                ))
+    return issues
+
+
+def main(argv: List[str]) -> int:
+    paths = argv or ["flexflow_tpu", "tools", "tests", "bench.py"]
+    n = 0
+    for path in iter_py_files(paths):
+        for lineno, code, msg in check_file(path):
+            print(f"{path}:{lineno}: {code} {msg}")
+            n += 1
+    if n:
+        print(f"[lint] {n} issue(s)")
+        return 1
+    print("[lint] clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -224,11 +224,23 @@ def analyze_executor(
 def analyze_serve_engine(
     engine, checks: Optional[Sequence[str]] = None
 ) -> AnalysisReport:
-    """Analyze a ServeEngine's decode + prefill programs.  No strategy
+    """Analyze a ServeEngine's decode + prefill (and, when speculative
+    decoding is on, draft + verify) programs.  No strategy
     reconciliation (the decode programs are hand-written, not
     search-placed) — the transfer/donation/dtype audits carry the
-    zero-sync-serve and paged-KV-donation guarantees."""
+    zero-sync-serve and paged-KV-donation guarantees.
+
+    Additionally audits copy-on-write safety (``serve_cow``): every
+    serve program DONATES the whole paged K/V pool and scatters into
+    blocks its tables name, so a block mapped by a slot's writable
+    region while still shared (refcount > 1) or prefix-indexed would be
+    silently corrupted for every other table that maps it.  The
+    allocator's :meth:`PagedKVCache.shared_write_hazards` must therefore
+    be empty whenever programs can run — donation of shared blocks is
+    never declared."""
     import jax.numpy as jnp
+
+    from flexflow_tpu.analysis.core import Violation
 
     ex = engine.model.executor
     kv = engine.kv
@@ -237,7 +249,7 @@ def analyze_serve_engine(
     bt0 = jnp.zeros((B, MB), jnp.int32)
     dt = str(ex.compute_dtype)
     report = AnalysisReport()
-    for name, jitted, args, names in (
+    programs = [
         (
             "serve.decode",
             engine._decode,
@@ -256,7 +268,25 @@ def analyze_serve_engine(
             ("params", "cache_k", "cache_v", "toks", "start", "n_valid",
              "block_tables"),
         ),
-    ):
+    ]
+    if getattr(engine, "_draft", None) is not None:
+        programs.append((
+            "serve.draft",
+            engine._draft,
+            (ex.params, kv.cache_k, kv.cache_v, z, z, bt0),
+            ("params", "cache_k", "cache_v", "tok", "pos", "block_tables"),
+        ))
+        programs.append((
+            "serve.verify",
+            engine._verify,
+            (
+                ex.params, kv.cache_k, kv.cache_v,
+                jnp.zeros((B, engine.spec_k + 1), jnp.int32), z, bt0,
+            ),
+            ("params", "cache_k", "cache_v", "toks", "pos0",
+             "block_tables"),
+        ))
+    for name, jitted, args, names in programs:
         art = capture_jit(
             name,
             name.split(".", 1)[1],
@@ -268,4 +298,33 @@ def analyze_serve_engine(
         )
         report.add_program(art.name)
         report.extend(analyze_program(art, checks))
+    # serve_cow: CoW safety as an ffcheck invariant — a live allocator
+    # state where a shared/indexed block sits in a slot's writable
+    # region means a donated scatter would corrupt other tables
+    if checks is None or "serve_cow" in checks:
+        report.add_program("serve.kvcache")
+        try:
+            hazards = kv.shared_write_hazards()
+        except Exception:
+            hazards = []  # checks are total: never raise
+        report.extend([
+            Violation(
+                check="serve_cow",
+                severity="error",
+                program="serve.kvcache",
+                message=(
+                    f"slot {slot} may write logical block {idx} -> "
+                    f"physical {blk} which is shared "
+                    f"(refcount {kv.refcount(blk)}) or prefix-indexed; "
+                    "donated scatters would corrupt every other table "
+                    "mapping it (copy-on-write discipline breached)"
+                ),
+                where=f"slot{slot}/block{idx}",
+                details={
+                    "slot": slot, "logical_idx": idx, "block": blk,
+                    "refcount": kv.refcount(blk),
+                },
+            )
+            for slot, idx, blk in hazards
+        ])
     return report

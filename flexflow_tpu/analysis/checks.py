@@ -209,6 +209,19 @@ def check_dtype(artifact: ProgramArtifact) -> List[Violation]:
     return out
 
 
+@register_check("serve_cow")
+def check_serve_cow(artifact: ProgramArtifact) -> List[Violation]:
+    """Copy-on-write safety for prefix-shared paged KV caches.  The
+    hazard lives in the ALLOCATOR (a shared refcount>1 or prefix-indexed
+    block mapped by a slot's writable region), not in any one compiled
+    program, so at the artifact level this check is a registered no-op —
+    the live scan runs in
+    :func:`flexflow_tpu.analysis.capture.analyze_serve_engine`, which
+    walks ``PagedKVCache.shared_write_hazards()`` and emits
+    ``serve_cow`` violations against the ``serve.kvcache`` program."""
+    return []
+
+
 @register_check("replication")
 def check_replication(artifact: ProgramArtifact) -> List[Violation]:
     """Operands lowered fully replicated when the strategy says sharded:

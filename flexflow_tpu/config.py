@@ -187,6 +187,10 @@ class FFConfig:
     serve_prefill_chunk: int = 32  # prompt positions per prefill call
     serve_sync_every: int = 4  # decode steps per flush window
     serve_slo_ms: float = 50.0  # p99 per-token latency SLO (objective)
+    serve_prefix_sharing: bool = True  # CoW prefix-block sharing
+    serve_spec_k: int = 0  # speculative draft depth (0 = off)
+    serve_spec_draft_layers: int = 0  # draft slice depth (0 = half)
+    serve_spec_accept: float = 0.7  # priced per-draft acceptance prob.
 
     def __post_init__(self) -> None:
         self._devices = None
@@ -358,6 +362,16 @@ class FFConfig:
                 self.serve_sync_every = int(take())
             elif a == "--serve-slo-ms":
                 self.serve_slo_ms = float(take())
+            elif a == "--serve-prefix-sharing":
+                self.serve_prefix_sharing = take().lower() in (
+                    "1", "true", "on", "yes",
+                )
+            elif a == "--serve-spec-k":
+                self.serve_spec_k = int(take())
+            elif a == "--serve-spec-draft-layers":
+                self.serve_spec_draft_layers = int(take())
+            elif a == "--serve-spec-accept":
+                self.serve_spec_accept = float(take())
             else:
                 rest.append(a)
             i += 1

@@ -782,6 +782,18 @@ class FFModel:
                         ),
                         slo_p99_ms=cfg.serve_slo_ms,
                         sync_every=cfg.serve_sync_every,
+                        spec_k=cfg.serve_spec_k,
+                        spec_accept=cfg.serve_spec_accept,
+                        spec_draft_frac=(
+                            cfg.serve_spec_draft_layers
+                            / max(1, sum(
+                                1 for ly in self.layers
+                                if ly.op_type.name
+                                == "MULTIHEAD_ATTENTION"
+                            ))
+                            if cfg.serve_spec_draft_layers > 0
+                            else 0.5
+                        ),
                     )
                 strategy = unity_search(
                     self.layers,

@@ -1181,6 +1181,58 @@ def estimate_decode_step_time(
     }
 
 
+def estimate_speculative_decode(
+    step_s: float,
+    *,
+    k: int,
+    accept_rate: float,
+    draft_frac: float,
+    verify_overhead: float = 1.0,
+) -> Dict[str, float]:
+    """Accept-rate-weighted macro-step pricing for speculative decoding
+    (docs/SERVING.md, "Speculative accept math").
+
+    One macro step = ``k`` draft steps on the shallow slice (each
+    ``draft_frac`` of a full decode step — the layer-count fraction, a
+    good proxy in the weight-streaming regime where step time is linear
+    in layers streamed) + ONE full-depth verify over the k+1 rows.  The
+    verify batches k+1 positions through the same weight stream a
+    single decode step pays, so its cost is ~one step
+    (``verify_overhead`` scales it for the extra attention/FLOPs).
+
+    With per-draft acceptance probability ``a`` (i.i.d. approximation),
+    the macro emits the verify row's own token plus a geometric prefix
+    of accepted drafts::
+
+        E[tokens] = 1 + a + a^2 + ... + a^k = (1 - a^{k+1}) / (1 - a)
+
+    so the effective per-token step time is ``macro_s / E[tokens]`` and
+    the speedup over plain decode is ``step_s / effective``.  At a=1
+    the bound is the ideal (k+1) / (k·draft_frac + 1); at a=0 spec is a
+    pure loss (macro_s > step_s for one token) — the objective prices
+    both arms and only picks spec when it wins.
+    """
+    k = max(0, int(k))
+    a = min(1.0, max(0.0, float(accept_rate)))
+    df = min(1.0, max(0.0, float(draft_frac)))
+    step_s = max(float(step_s), 1e-12)
+    if a >= 1.0:
+        expected = float(k + 1)
+    else:
+        expected = (1.0 - a ** (k + 1)) / (1.0 - a)
+    macro_s = k * df * step_s + verify_overhead * step_s
+    effective = macro_s / max(expected, 1e-12)
+    return {
+        "k": float(k),
+        "accept_rate": a,
+        "draft_frac": df,
+        "expected_tokens": expected,
+        "macro_s": macro_s,
+        "effective_step_s": effective,
+        "speedup": step_s / effective,
+    }
+
+
 def _chain_assignment_uniform(chain, strategy: Strategy) -> bool:
     """Every repeat of the chain carries the same per-position OpSharding
     (the precondition for price-once-multiply).  Compared by

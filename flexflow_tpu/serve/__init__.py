@@ -34,7 +34,11 @@ from flexflow_tpu.serve.scheduler import (
     Request,
     RequestState,
 )
-from flexflow_tpu.serve.traffic import TrafficSpec, synthetic_requests
+from flexflow_tpu.serve.traffic import (
+    TrafficSpec,
+    multi_tenant_requests,
+    synthetic_requests,
+)
 
 __all__ = [
     "PagedKVCache",
@@ -48,4 +52,5 @@ __all__ = [
     "ServeObjective",
     "TrafficSpec",
     "synthetic_requests",
+    "multi_tenant_requests",
 ]

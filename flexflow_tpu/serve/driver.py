@@ -12,6 +12,14 @@ Defaults are CPU-smoke sized; pass model flags for anything real.
 
     python -m flexflow_tpu --serve --requests 32 --rate 50 \\
         --serve-slots 4 --serve-sync-every 4 --metrics-out serve.jsonl
+
+Multi-tenant shapes: ``--tenants N --shared-prefix P
+--interactive-frac F`` generate per-tenant system prompts (prefix
+sharing traffic) and SLO tiers; ``--serve-prefix-sharing off``,
+``--serve-spec-k K`` and ``--serve-spec-draft-layers D`` control the
+allocator and speculative decoding.  The JSON summary then carries
+``prefix_hit_rate``, ``preemptions``, per-tier TTFT percentiles, and
+the speculative accept rate.
 """
 
 from __future__ import annotations
@@ -38,7 +46,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     opts = dict(
         requests=16, rate=0.0, prompt_len=(4, 12), gen_len=(4, 24),
         hidden=64, heads=4, ff_dim=128, num_layers=2, vocab=256, seq=64,
-        traffic_seed=0,
+        traffic_seed=0, tenants=1, shared_prefix=0, interactive_frac=0.0,
     )
     i = 0
     while i < len(rest):
@@ -71,6 +79,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             opts["seq"] = int(take())
         elif a == "--traffic-seed":
             opts["traffic_seed"] = int(take())
+        elif a == "--tenants":
+            opts["tenants"] = int(take())
+        elif a == "--shared-prefix":
+            opts["shared_prefix"] = int(take())
+        elif a == "--interactive-frac":
+            opts["interactive_frac"] = float(take())
         elif a in ("-h", "--help"):
             print(__doc__, file=sys.stderr)
             return 0
@@ -102,11 +116,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         prefill_chunk=cfg.serve_prefill_chunk,
         sync_every=cfg.serve_sync_every,
         metrics_out=cfg.metrics_out,
+        prefix_sharing=cfg.serve_prefix_sharing,
+        spec_k=cfg.serve_spec_k,
+        spec_draft_layers=cfg.serve_spec_draft_layers,
     )
     spec = TrafficSpec(
         n_requests=opts["requests"], seed=opts["traffic_seed"],
         rate_rps=opts["rate"], prompt_len=opts["prompt_len"],
         max_new=opts["gen_len"], vocab=opts["vocab"],
+        tenants=opts["tenants"], shared_prefix=opts["shared_prefix"],
+        interactive_frac=opts["interactive_frac"],
     )
     # clamp generated budgets to the compiled position range
     reqs = synthetic_requests(spec)

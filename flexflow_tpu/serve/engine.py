@@ -77,6 +77,21 @@ class ServeReport:
     prefill_chunks: int
     host_syncs: int
     per_request: List[Dict[str, Any]]
+    # --- multi-tenant scale-out (PR 11) ---
+    prefix_hit_rate: Optional[float] = None  # shareable lookups that hit
+    preemptions: int = 0  # batch-tier spill events
+    per_tier: Dict[str, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict
+    )  # tier -> finished / ttft_p50_ms / ttft_p99_ms / tpot_p99_ms
+    per_tenant: Dict[str, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict
+    )
+    spec_k: int = 0  # speculative draft depth (0 = off)
+    spec_draft_layers: int = 0
+    spec_accept_rate: Optional[float] = None  # accepted / drafted
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+    peak_active: int = 0  # max simultaneously-admitted requests
 
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -108,6 +123,9 @@ class ServeEngine:
         eos_id: Optional[int] = None,
         metrics_out: Optional[str] = None,
         prefetch_depth: int = 2,
+        prefix_sharing: bool = True,
+        spec_k: int = 0,
+        spec_draft_layers: int = 0,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -125,11 +143,26 @@ class ServeEngine:
             self.sync_every = 1
         self._rng = np.random.default_rng(seed)
         self.eos_id = eos_id
+        # speculative decoding (docs/SERVING.md): draft with the first
+        # ``spec_draft_layers`` of the chain, verify ``spec_k`` drafts
+        # in one batched step.  Greedy-only: sampling re-introduces a
+        # per-step host draw, which defeats both spec and the zero-sync
+        # window, so temperature > 0 turns it off.
+        self.spec_k = max(0, int(spec_k))
+        self.spec_draft_layers = max(0, int(spec_draft_layers))
+        if self.spec_k and not (
+            0 < self.spec_draft_layers < self.spec.num_layers
+        ):
+            # a sane default: half-depth draft (at least one layer)
+            self.spec_draft_layers = max(1, self.spec.num_layers // 2)
+        if self.temperature > 0.0:
+            self.spec_k = 0
         dt = model.executor.compute_dtype
         self.kv = PagedKVCache(
             self.spec.num_layers, self.spec.heads, self.spec.head_dim,
             slots=self.slots, block_size=block_size,
             num_blocks=num_blocks, max_seq_len=self.spec.seq, dtype=dt,
+            prefix_sharing=prefix_sharing,
         )
         self.sched = ContinuousBatchingScheduler(self.slots, self.kv)
         self.metrics = MetricsStream(metrics_out)
@@ -260,8 +293,132 @@ class ServeEngine:
             nxt = jnp.argmax(probs, axis=-1).astype(jnp.int32)
             return nxt, probs, ck, cv
 
+        # --- speculative decoding programs (docs/SERVING.md) --------------
+        # The chain layout makes a depth-Ld draft model a SLICE of the
+        # stacked params: the draft trunk is layers 0..Ld-1 plus the
+        # shared final_ln/lm_head — no second set of weights.  The
+        # draft program is the decode step truncated to Ld layers
+        # (writing only those layers' K/V); the verify program is one
+        # batched paged-decode step over W = k+1 consecutive positions
+        # per slot that rewrites ALL layers and computes, ON DEVICE, the
+        # longest draft prefix the full model agrees with.  Both return
+        # their successors (next token, next position) as device arrays,
+        # so macro steps chain device-to-device exactly like plain
+        # decode — the zero-per-step-sync ledger is unchanged.
+        Ld, W = self.spec_draft_layers, self.spec_k + 1
+
+        def draft(params, ck, cv, tok, pos, bt):
+            # identical to decode through the first Ld layers; the
+            # rejected-position K/V this writes is rewritten by whichever
+            # program next processes those positions before any row's
+            # causal mask can expose it (see SERVING.md)
+            params = jax.tree.map(cast, params)
+            x = params["tok_embed"]["kernel"][tok]
+            x = x + params["pos_embed"]["value"][
+                jnp.clip(pos, 0, S_pos - 1)
+            ]
+            lane = jnp.arange(B)
+            blk = bt[lane, jnp.clip(pos // BS, 0, MB - 1)]
+            off = jnp.clip(pos % BS, 0, BS - 1)
+            mask = (jnp.arange(SV)[None, :] <= pos[:, None])[:, None, :]
+            for i in range(Ld):
+                p_at = params[f"dec{i}_attn"]
+                h = ln(params[f"dec{i}_ln0"], x)
+                q = h @ p_at["wq"]
+                k = h @ p_at["wk"]
+                v = h @ p_at["wv"]
+                if has_bias:
+                    q, k, v = q + p_at["bq"], k + p_at["bk"], v + p_at["bv"]
+                q = q.reshape(B, H, D)
+                k = k.reshape(B, H, D)
+                v = v.reshape(B, H, D)
+                ck = ck.at[i, blk, :, off, :].set(k)
+                cv = cv.at[i, blk, :, off, :].set(v)
+                keys = ck[i][bt].transpose(0, 2, 1, 3, 4).reshape(B, H, SV, D)
+                vals = cv[i][bt].transpose(0, 2, 1, 3, 4).reshape(B, H, SV, D)
+                o = attend(q, keys, vals, mask)
+                o = o.reshape(B, H * D) @ p_at["wo"]
+                if has_bias:
+                    o = o + p_at["bo"]
+                x = x + o
+                h = ln(params[f"dec{i}_ln1"], x)
+                p0, p1 = params[f"dec{i}_ff0"], params[f"dec{i}_ff1"]
+                f = jax.nn.gelu(h @ p0["kernel"] + p0["bias"])
+                f = f @ p1["kernel"] + p1["bias"]
+                x = x + f
+            x = jax.lax.optimization_barrier(x)
+            x = ln(params["final_ln"], x)
+            logits = x @ params["lm_head"]["kernel"]
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            nxt = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+            return nxt, ck, cv
+
+        def verify(params, ck, cv, toks, pos0, bt):
+            # toks (B, W): [current, draft_1..draft_k]; row j of slot b
+            # sits at position pos0[b] + j.  Every matmul flattens to
+            # (B*W, ...) 2-D and attention keeps the shared mul+reduce
+            # contraction, so each row's arithmetic is the decode
+            # step's — the full model's argmax, bit for bit (the
+            # bit-identity tests pin this)
+            params = jax.tree.map(cast, params)
+            lane = jnp.arange(B)
+            pos = pos0[:, None] + jnp.arange(W)[None, :]  # (B, W)
+            x = params["tok_embed"]["kernel"][toks]  # (B, W, hidden)
+            x = x + params["pos_embed"]["value"][jnp.clip(pos, 0, S_pos - 1)]
+            blk = bt[lane[:, None], jnp.clip(pos // BS, 0, MB - 1)]  # (B, W)
+            off = jnp.clip(pos % BS, 0, BS - 1)
+            mask = (
+                jnp.arange(SV)[None, None, :] <= pos[..., None]
+            )[:, :, None, :]  # (B, W, 1, SV)
+            hid = x.shape[-1]
+            for i in range(L):
+                p_at = params[f"dec{i}_attn"]
+                h = ln(params[f"dec{i}_ln0"], x).reshape(B * W, hid)
+                q = h @ p_at["wq"]
+                k = h @ p_at["wk"]
+                v = h @ p_at["wv"]
+                if has_bias:
+                    q, k, v = q + p_at["bq"], k + p_at["bk"], v + p_at["bv"]
+                q = q.reshape(B, W, H, D)
+                k = k.reshape(B, W, H, D)
+                v = v.reshape(B, W, H, D)
+                # scatter all W rows, THEN attend: row j's mask reaches
+                # rows 0..j of this same program, freshly written (the
+                # prefill-chunk discipline, batched over slots)
+                ck = ck.at[i, blk, :, off, :].set(k)
+                cv = cv.at[i, blk, :, off, :].set(v)
+                keys = ck[i][bt].transpose(0, 2, 1, 3, 4).reshape(B, H, SV, D)
+                vals = cv[i][bt].transpose(0, 2, 1, 3, 4).reshape(B, H, SV, D)
+                o = attend(q, keys[:, None], vals[:, None], mask)
+                o = o.reshape(B * W, H * D) @ p_at["wo"]
+                if has_bias:
+                    o = o + p_at["bo"]
+                x = x + o.reshape(B, W, hid)
+                h = ln(params[f"dec{i}_ln1"], x).reshape(B * W, hid)
+                p0, p1 = params[f"dec{i}_ff0"], params[f"dec{i}_ff1"]
+                f = jax.nn.gelu(h @ p0["kernel"] + p0["bias"])
+                f = f @ p1["kernel"] + p1["bias"]
+                x = x + f.reshape(B, W, hid)
+            x = jax.lax.optimization_barrier(x)
+            x = ln(params["final_ln"], x)
+            logits = x.reshape(B * W, hid) @ params["lm_head"]["kernel"]
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            n = jnp.argmax(probs, axis=-1).astype(jnp.int32).reshape(B, W)
+            # accept the longest agreeing prefix: draft j survives iff
+            # every draft before it did AND the full model's argmax at
+            # its predecessor row reproduces it
+            agree = (toks[:, 1:] == n[:, :-1]).astype(jnp.int32)  # (B, k)
+            acc = jnp.cumprod(agree, axis=1).sum(axis=1)  # (B,) in [0, k]
+            next_cur = n[lane, acc]  # the first token NOT yet fed
+            next_pos = pos0 + acc + 1
+            return n, acc, next_cur, next_pos, ck, cv
+
         self._decode = jax.jit(decode, donate_argnums=(1, 2))
         self._prefill = jax.jit(prefill, donate_argnums=(1, 2))
+        self._draft = self._verify = None
+        if self.spec_k:
+            self._draft = jax.jit(draft, donate_argnums=(1, 2))
+            self._verify = jax.jit(verify, donate_argnums=(1, 2))
 
         # warmup both programs once so the cache layout/sharding
         # stabilizes (same rationale as GPTDecodeSession) and steady
@@ -283,6 +440,20 @@ class ServeEngine:
         _, _, ck, cv = self._decode(
             model.executor.params, ck, cv, z, z, bt0,
         )
+        if self.spec_k:
+            # the speculative programs join the same warmup chain so
+            # all four agree on ONE buffer layout (a second layout
+            # would recompile every donated program once per layout)
+            _, ck, cv = self._draft(
+                model.executor.params, ck, cv, z, z, bt0,
+            )
+            _, _, _, _, ck, cv = self._verify(
+                model.executor.params, ck, cv,
+                jnp.zeros((B, W), jnp.int32), z, bt0,
+            )
+            _, _, ck, cv = self._decode(
+                model.executor.params, ck, cv, z, z, bt0,
+            )
         self._cache_sharding = (ck.sharding, cv.sharding)
         # keep the CHAINED warmup buffers as the live pool: the warmup
         # only ever wrote the trash block (all tables were zero), so
@@ -321,6 +492,9 @@ class ServeEngine:
         self.windows = 0
         self.decode_steps = 0
         self.prefill_chunks = 0
+        self.spec_drafted = 0  # draft tokens proposed (spec mode)
+        self.spec_accepted = 0  # draft tokens the full model confirmed
+        self.peak_active = 0
         self._occ_sum = 0.0
         self._t0: Optional[float] = None
 
@@ -333,11 +507,13 @@ class ServeEngine:
         req_id: int = -1,
         eos_id: Optional[int] = None,
         arrival_s: float = 0.0,
+        tenant: str = "default",
+        tier: str = "batch",
     ) -> Request:
         req = Request(
             prompt=prompt, max_new_tokens=max_new_tokens, id=req_id,
             eos_id=eos_id if eos_id is not None else self.eos_id,
-            arrival_s=arrival_s,
+            arrival_s=arrival_s, tenant=tenant, tier=tier,
         )
         # a budget past the compiled position range / pool size comes
         # back REJECTED with a reason (graceful, never a crash)
@@ -360,9 +536,12 @@ class ServeEngine:
         # the engine is reusable across runs; counters and the report
         # are per-run (the compiled programs and the pool persist)
         self.windows = self.decode_steps = self.prefill_chunks = 0
+        self.spec_drafted = self.spec_accepted = 0
+        self.peak_active = 0
         self._occ_sum = 0.0
         fin0 = len(self.sched.finished)
         rej0 = len(self.sched.rejected)
+        pre0 = self.sched.preemptions
         # requests queued via submit() before run() count as arriving
         # at run start for TTFT purposes
         for r in self.sched.queue:
@@ -390,6 +569,7 @@ class ServeEngine:
         return self._report(
             wall, ex.host_syncs - syncs0,
             self.sched.finished[fin0:], len(self.sched.rejected) - rej0,
+            self.sched.preemptions - pre0,
         )
 
     # --- one flush window ---------------------------------------------------
@@ -400,6 +580,9 @@ class ServeEngine:
         t_win = self._now()
         B, MB = self.slots, self.kv.max_blocks_per_seq
         fin_before = len(self.sched.finished)
+        # admission happened just before this window — sample the high-
+        # water mark now, before any in-window finishes release slots
+        self.peak_active = max(self.peak_active, len(self.sched.active))
 
         # 1) prefill: ONE chunk per mid-prefill slot, chunk arrays staged
         #    H2D ahead of compute through the shared DevicePrefetcher
@@ -435,12 +618,19 @@ class ServeEngine:
             req.prefill_pos = min(
                 req.prefill_pos + self.prefill_chunk, req.prompt_len
             )
+            # register the chunk's fully-written prompt blocks in the
+            # prefix index NOW (not at prefill end): a request arriving
+            # in the next admit round with the same system prompt
+            # re-attaches them instead of allocating — concurrent
+            # sharing, not just warm-cache sharing
+            self.kv.commit_prefix(req.slot, req.prompt, req.prefill_pos)
             if req.prefill_pos >= req.prompt_len:
                 prefill_done.append((req, nxt, probs))
 
         # 2) decode: chain device tokens for an adaptive window
         dec_slots = self.sched.decode_slots()
         buffered: List[Any] = []  # per-step (B,) next-token device arrays
+        spec_buf: List[Any] = []  # per-macro (n (B,W), acc (B,)) pairs
         probs_last = None
         steps = 0
         if dec_slots:
@@ -449,7 +639,6 @@ class ServeEngine:
                 - self.sched.active[s].done_tokens
                 for s in dec_slots
             ]
-            steps = max(1, min(self.sync_every, min(remaining)))
             cur = np.zeros((B,), np.int32)
             pos = np.zeros((B,), np.int32)
             bt = np.zeros((B, MB), np.int32)
@@ -460,21 +649,57 @@ class ServeEngine:
                 bt[s] = self.kv.tables[s]
             bt_d = self._jax.device_put(jnp.asarray(bt))
             cur_d = self._jax.device_put(jnp.asarray(cur))
-            for _ in range(steps):
-                nxt, probs_last, ck, cv = self._decode(
-                    ex.params, self.kv.cache_k, self.kv.cache_v,
-                    cur_d, jnp.asarray(pos), bt_d,
+            if self.spec_k:
+                # speculative macro steps: k chained draft calls on the
+                # shallow slice, ONE full-depth verify over the k+1 rows.
+                # verify returns the next macro's (token, position) as
+                # device arrays, so macros chain with NO host fetch —
+                # still one sync per window
+                k = self.spec_k
+                W = k + 1
+                macros = max(
+                    1, min(self.sync_every, -(-min(remaining) // W))
                 )
-                self.kv.cache_k, self.kv.cache_v = ck, cv
-                buffered.append(nxt)
-                cur_d = nxt  # device-to-device chain: NO host fetch
-                for s in dec_slots:
-                    pos[s] += 1
+                pos_d = self._jax.device_put(jnp.asarray(pos))
+                for _ in range(macros):
+                    cur_j, pos_j = cur_d, pos_d
+                    drafts = []
+                    for _j in range(k):
+                        dn, ck, cv = self._draft(
+                            ex.params, self.kv.cache_k, self.kv.cache_v,
+                            cur_j, pos_j, bt_d,
+                        )
+                        self.kv.cache_k, self.kv.cache_v = ck, cv
+                        drafts.append(dn)
+                        cur_j, pos_j = dn, pos_j + 1
+                    toks = jnp.stack([cur_d] + drafts, axis=1)  # (B, W)
+                    n, acc, cur_d, pos_d, ck, cv = self._verify(
+                        ex.params, self.kv.cache_k, self.kv.cache_v,
+                        toks, pos_d, bt_d,
+                    )
+                    self.kv.cache_k, self.kv.cache_v = ck, cv
+                    spec_buf.append((n, acc))
+                steps = macros * W  # program invocations this window
+            else:
+                steps = max(1, min(self.sync_every, min(remaining)))
+                for _ in range(steps):
+                    nxt, probs_last, ck, cv = self._decode(
+                        ex.params, self.kv.cache_k, self.kv.cache_v,
+                        cur_d, jnp.asarray(pos), bt_d,
+                    )
+                    self.kv.cache_k, self.kv.cache_v = ck, cv
+                    buffered.append(nxt)
+                    cur_d = nxt  # device-to-device chain: NO host fetch
+                    for s in dec_slots:
+                        pos[s] += 1
             self.decode_steps += steps
 
         # 3) flush: the window's ONE deliberate host sync
         t_sync = self._now()
         host_tok = [np.asarray(b) for b in buffered]
+        host_spec = [
+            (np.asarray(n), np.asarray(a)) for n, a in spec_buf
+        ]
         host_pre = [
             (req, int(np.asarray(nxt)), np.asarray(probs))
             for req, nxt, probs in prefill_done
@@ -482,9 +707,10 @@ class ServeEngine:
         stall = self._now() - t_sync
         ex.count_host_sync(1, stall)
         flushed_tokens = 0
+        spec_drafted_w = spec_accepted_w = 0
 
         # decode lanes: assign buffered tokens in step order
-        for k in range(len(host_tok)):
+        for ki in range(len(host_tok)):
             for s in dec_slots:
                 req = self.sched.active.get(s)
                 if req is None or req.state is not RequestState.DECODE:
@@ -498,10 +724,32 @@ class ServeEngine:
                         self.temperature, self._rng,
                     )[0])
                 else:
-                    tok = int(host_tok[k][s])
+                    tok = int(host_tok[ki][s])
                 req.tokens.append(tok)
                 flushed_tokens += 1
                 self._finish_if_done(req, tok)
+
+        # speculative lanes: each macro contributes its accepted prefix
+        # (acc drafts + the verify row's own argmax); tokens past an
+        # EOS/budget finish are overshoot and are discarded exactly like
+        # the plain-decode overshoot above
+        for n_h, acc_h in host_spec:
+            for s in dec_slots:
+                req = self.sched.active.get(s)
+                if req is None or req.state is not RequestState.DECODE:
+                    continue
+                a = int(acc_h[s])
+                spec_drafted_w += self.spec_k
+                spec_accepted_w += a
+                for j in range(a + 1):
+                    tok = int(n_h[s, j])
+                    req.tokens.append(tok)
+                    flushed_tokens += 1
+                    self._finish_if_done(req, tok)
+                    if req.state is not RequestState.DECODE:
+                        break
+        self.spec_drafted += spec_drafted_w
+        self.spec_accepted += spec_accepted_w
 
         # prefill completions: first generated token becomes visible now
         for req, tok, probs in host_pre:
@@ -528,10 +776,40 @@ class ServeEngine:
             fin = [
                 {
                     "id": r.id, "tokens": r.done_tokens,
-                    "reason": r.finish_reason, **r.latency_ms(),
+                    "reason": r.finish_reason, "tenant": r.tenant,
+                    "tier": r.tier, "preempted": r.preemptions,
+                    **r.latency_ms(),
                 }
                 for r in self.sched.finished[fin_before:]
             ]
+            # per-tenant fairness snapshot: occupancy share + progress
+            # (ADDITIVE ffmetrics/1 vocabulary — old readers ignore it)
+            tenants: Dict[str, Dict[str, Any]] = {}
+            for r in list(self.sched.active.values()) + self.sched.queue:
+                d = tenants.setdefault(r.tenant, {
+                    "tier": r.tier, "active": 0, "queued": 0,
+                })
+                d["active" if r.slot >= 0 else "queued"] += 1
+            serve_m: Dict[str, Any] = {
+                "queue_depth": self.sched.queue_depth,
+                "occupancy": self.sched.occupancy,
+                "decode_steps": steps,
+                "prefill_chunks": len(chunks),
+                "active": len(self.sched.active),
+                "finished": fin,
+                "rejected_total": len(self.sched.rejected),
+                "prefix_hit_rate": self.kv.prefix_hit_rate,
+                "cached_blocks": self.kv.cached_blocks,
+                "preemptions_total": self.sched.preemptions,
+                "tenants": tenants,
+            }
+            if self.spec_k:
+                serve_m["spec"] = {
+                    "k": self.spec_k,
+                    "draft_layers": self.spec_draft_layers,
+                    "drafted": spec_drafted_w,
+                    "accepted": spec_accepted_w,
+                }
             self.metrics.append(step_record(
                 step=self.windows - 1,
                 t=time.time(),
@@ -541,15 +819,7 @@ class ServeEngine:
                 samples=len(dec_slots),
                 predicted_step_s=self.predicted_step_s,
                 predicted_tok_s=self.predicted_tok_s,
-                metrics={"serve": {
-                    "queue_depth": self.sched.queue_depth,
-                    "occupancy": self.sched.occupancy,
-                    "decode_steps": steps,
-                    "prefill_chunks": len(chunks),
-                    "active": len(self.sched.active),
-                    "finished": fin,
-                    "rejected_total": len(self.sched.rejected),
-                }},
+                metrics={"serve": serve_m},
             ))
 
     def _finish_if_done(self, req: Request, tok: int) -> None:
@@ -561,12 +831,30 @@ class ServeEngine:
     # --- report -------------------------------------------------------------
     def _report(
         self, wall: float, host_syncs: int, fin=None, rejected=None,
+        preemptions: Optional[int] = None,
     ) -> ServeReport:
         fin = self.sched.finished if fin is None else fin
         lat = [r.latency_ms() for r in fin]
         ttft = [d["ttft_ms"] for d in lat]
         tpot = [d["tpot_ms"] for d in lat]
         new_tokens = sum(r.done_tokens for r in fin)
+        per_tier: Dict[str, Dict[str, Any]] = {}
+        for tier in sorted({r.tier for r in fin}):
+            rs = [r for r in fin if r.tier == tier]
+            tl = [r.latency_ms() for r in rs]
+            per_tier[tier] = {
+                "finished": len(rs),
+                "preemptions": sum(r.preemptions for r in rs),
+                "ttft_p50_ms": _pct([d["ttft_ms"] for d in tl], 50),
+                "ttft_p99_ms": _pct([d["ttft_ms"] for d in tl], 99),
+                "tpot_p99_ms": _pct([d["tpot_ms"] for d in tl], 99),
+            }
+        per_tenant: Dict[str, Dict[str, Any]] = {}
+        for tenant, d in sorted(self.sched.tenant_summary().items()):
+            ttfts = d.pop("ttft_ms")
+            d["ttft_p50_ms"] = _pct(ttfts, 50)
+            d["ttft_p99_ms"] = _pct(ttfts, 99)
+            per_tenant[tenant] = d
         rep = ServeReport(
             wall_s=wall,
             new_tokens=new_tokens,
@@ -590,10 +878,29 @@ class ServeEngine:
                 {
                     "id": r.id, "prompt_len": r.prompt_len,
                     "tokens": list(r.tokens), "reason": r.finish_reason,
+                    "tenant": r.tenant, "tier": r.tier,
+                    "preemptions": r.preemptions,
+                    "shared_prefix_pos": r.shared_prefix_pos,
                     **r.latency_ms(),
                 }
                 for r in fin
             ],
+            prefix_hit_rate=self.kv.prefix_hit_rate,
+            preemptions=(
+                self.sched.preemptions if preemptions is None
+                else preemptions
+            ),
+            per_tier=per_tier,
+            per_tenant=per_tenant,
+            spec_k=self.spec_k,
+            spec_draft_layers=self.spec_draft_layers if self.spec_k else 0,
+            spec_accept_rate=(
+                self.spec_accepted / self.spec_drafted
+                if self.spec_drafted else None
+            ),
+            spec_drafted=self.spec_drafted,
+            spec_accepted=self.spec_accepted,
+            peak_active=self.peak_active,
         )
         self.metrics.close()
         return rep

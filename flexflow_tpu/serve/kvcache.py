@@ -1,4 +1,5 @@
-"""Paged/block KV-cache allocator (docs/SERVING.md).
+"""Paged/block KV-cache allocator with copy-on-write prefix sharing
+(docs/SERVING.md).
 
 The dense decode session reserves a monolithic ``(L, B, H, S_max, D)``
 cache — every slot pays max-S HBM whether its conversation is 8 tokens
@@ -13,6 +14,25 @@ long requests then share HBM — the pool only needs to cover the sum of
 tests/test_serve.py pins a workload whose summed max-lengths exceed the
 monolithic footprint).
 
+**Prefix sharing (PR 11).**  Physical blocks are ref-counted and keyed
+by the cumulative hash of the prompt tokens they hold: block ``b`` of a
+prompt is registered under ``sha1(prompt[0:(b+1)*block_size])`` once its
+positions are fully written, so the key identifies both content AND
+position — two requests whose prompts agree on the first
+``(b+1)*block_size`` tokens provably hold bit-identical K/V there (the
+prefill program is deterministic and causal).  A later reservation that
+matches the index maps the existing physical block into its table and
+bumps the refcount instead of allocating; admission then charges only
+*unshared* blocks.  Registered blocks whose refcount drops to zero are
+RETAINED in an LRU cache (still indexed — a second wave of requests with
+the same system prompt hits warm) and evicted lazily when the free list
+runs dry.  Shared blocks are read-only by discipline: the engine only
+ever writes positions past the shared prefix, and
+:meth:`PagedKVCache.ensure_private` provides the copy-on-write escape
+hatch (allocate a fresh block, copy the device contents, drop the
+refcount) for any path that must write inside one —
+:meth:`shared_write_hazards` is the auditable invariant ffcheck pins.
+
 Allocation policy: blocks for a request's full declared budget
 (``prompt_len + max_new_tokens``) are reserved at admission, so
 mid-flight exhaustion cannot happen — a request that fits is never
@@ -23,15 +43,24 @@ surfaces in exactly two graceful forms: :meth:`PagedKVCache.can_reserve`
 = False (scheduler keeps the request queued, FIFO) and
 :exc:`KVCacheOOM` on a reserve that was not pre-checked.
 
-Physical block 0 is the TRASH block: never allocated, it absorbs the
-writes of inactive decode lanes and padded prefill rows (their block
-tables are all-zero), so the jitted step needs no masking scatter.
+**Spill/restore (SLO preemption).**  :meth:`spill` drains one slot's
+live K/V to host as a per-layer payload (the per-layer checkpoint
+convention: one ``layer{i} -> {k, v}`` entry per decoder layer, dtype
+preserved bit-for-bit) and releases its blocks; :meth:`restore` reserves
+fresh blocks (re-attaching any prefix blocks still in the index) and
+scatters the private positions back.  Because gather/scatter preserve
+bytes, a preempted request resumes the exact token stream.
+
+Physical block 0 is the TRASH block: never allocated, never registered,
+it absorbs the writes of inactive decode lanes and padded prefill rows
+(their block tables are all-zero), so the jitted step needs no masking.
 """
 
 from __future__ import annotations
 
-from collections import deque
-from typing import Dict, List, Optional, Tuple
+import hashlib
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -40,18 +69,19 @@ __all__ = ["PagedKVCache", "KVCacheOOM"]
 
 class KVCacheOOM(RuntimeError):
     """Raised when a reservation asks for more blocks than the free list
-    holds.  The scheduler pre-checks :meth:`PagedKVCache.can_reserve`,
-    so under the FIFO admission policy this surfaces only on misuse —
-    it exists so exhaustion is an explicit, catchable condition, never
-    a corrupted table."""
+    (plus evictable cached blocks) holds.  The scheduler pre-checks
+    :meth:`PagedKVCache.can_reserve`, so under the admission policy this
+    surfaces only on misuse — it exists so exhaustion is an explicit,
+    catchable condition, never a corrupted table."""
 
 
 class PagedKVCache:
     """Free-list block allocator + the device-side paged K/V arrays.
 
-    Host side: the free list, per-slot block tables, and the invariant
-    checks (a block is owned by at most one slot, double-free rejected).
-    Device side: ``cache_k``/``cache_v`` of shape
+    Host side: the free list, per-slot block tables, the prefix index
+    with per-block refcounts, and the invariant checks (a block's
+    refcount equals the number of tables mapping it, double-free
+    rejected).  Device side: ``cache_k``/``cache_v`` of shape
     ``(L, num_blocks, H, block_size, D)``, written/read by the serving
     programs in :mod:`flexflow_tpu.serve.engine` through gather/scatter
     indices derived from the block tables.
@@ -69,6 +99,7 @@ class PagedKVCache:
         max_blocks_per_seq: Optional[int] = None,
         max_seq_len: Optional[int] = None,
         dtype=None,
+        prefix_sharing: bool = True,
     ) -> None:
         import jax.numpy as jnp
 
@@ -78,6 +109,7 @@ class PagedKVCache:
         self.head_dim = head_dim
         self.slots = slots
         self.block_size = block_size
+        self.prefix_sharing = bool(prefix_sharing)
         if max_blocks_per_seq is None:
             assert max_seq_len is not None, (
                 "need max_blocks_per_seq or max_seq_len"
@@ -102,6 +134,20 @@ class PagedKVCache:
         # block 0 is the trash block — never enters the free list
         self._free: deque = deque(range(1, self.num_blocks))
         self._owned: Dict[int, List[int]] = {}  # slot -> blocks, in order
+        # sharing state: refcount per mapped block, cumulative-hash
+        # index, retained (refcount-0 but still indexed) LRU, and the
+        # per-slot count of leading READ-ONLY logical blocks (the CoW
+        # write-isolation boundary shared_write_hazards audits)
+        self._refcount: Dict[int, int] = {}
+        self._index: Dict[bytes, int] = {}  # cum-hash -> physical block
+        self._block_key: Dict[int, bytes] = {}  # reverse map
+        self._cached: "OrderedDict[int, bytes]" = OrderedDict()  # LRU
+        self._protected: Dict[int, int] = {}  # slot -> read-only blocks
+        # observability counters (cumulative; engine snapshots them)
+        self.prefix_hits = 0  # shareable block lookups that hit
+        self.prefix_lookups = 0  # shareable block lookups attempted
+        self.evictions = 0  # cached blocks recycled for fresh data
+        self.cow_copies = 0  # ensure_private device copies performed
         # per-slot block tables; row = logical block idx -> physical id
         self.tables = np.zeros(
             (slots, self.max_blocks_per_seq), np.int32
@@ -118,6 +164,11 @@ class PagedKVCache:
         return len(self._free)
 
     @property
+    def cached_blocks(self) -> int:
+        """Retained prefix blocks: refcount 0, still indexed, evictable."""
+        return len(self._cached)
+
+    @property
     def allocatable_blocks(self) -> int:
         """Total blocks a single request could ever hold (pool minus
         trash) — the *permanent* rejection bound."""
@@ -127,23 +178,119 @@ class PagedKVCache:
     def max_seq_len(self) -> int:
         return self.position_limit
 
+    @property
+    def prefix_hit_rate(self) -> Optional[float]:
+        """Fraction of shareable-block lookups served from the index
+        (None until the first lookup)."""
+        if not self.prefix_lookups:
+            return None
+        return self.prefix_hits / self.prefix_lookups
+
     def blocks_for(self, seq_len: int) -> int:
         return -(-int(seq_len) // self.block_size)
 
-    def can_reserve(self, seq_len: int) -> bool:
-        return self.blocks_for(seq_len) <= len(self._free)
+    def shareable_blocks(self, prompt) -> int:
+        """How many leading FULL blocks of ``prompt`` are eligible for
+        sharing.  The last prompt position is always kept private so the
+        consumer's own prefill computes the first next-token
+        distribution — hence blocks whose end reaches ``len(prompt)-1``
+        are excluded: ``(len(prompt) - 1) // block_size``."""
+        if prompt is None or not self.prefix_sharing:
+            return 0
+        return max(0, (int(len(prompt)) - 1) // self.block_size)
+
+    def _prefix_key(self, prompt, nblocks: int) -> bytes:
+        tok = np.asarray(prompt, np.int32)[: nblocks * self.block_size]
+        return hashlib.sha1(tok.tobytes()).digest()
+
+    def prefix_matches(self, prompt) -> List[int]:
+        """Physical ids of the longest indexed run of leading full
+        blocks of ``prompt`` (prefix property: stops at the first
+        miss).  Pure lookup — no refcounts change."""
+        out: List[int] = []
+        for b in range(self.shareable_blocks(prompt)):
+            blk = self._index.get(self._prefix_key(prompt, b + 1))
+            if blk is None:
+                break
+            out.append(blk)
+        return out
+
+    def blocks_needed(self, seq_len: int, prompt=None) -> Tuple[int, int]:
+        """``(total, shared)`` block counts for a reservation of
+        ``seq_len`` with ``prompt`` — admission charges only
+        ``total - shared`` (the budget arithmetic prefix sharing
+        changes; the scheduler's rejection reasons cite both)."""
+        total = self.blocks_for(seq_len)
+        shared = min(len(self.prefix_matches(prompt)), total)
+        return total, shared
+
+    def can_reserve(self, seq_len: int, prompt=None) -> bool:
+        total, shared = self.blocks_needed(seq_len, prompt)
+        # cached blocks the reservation itself would re-attach are not
+        # evictable for it, hence the subtraction is over the REST
+        evictable = sum(
+            1 for b in self._cached
+            if b not in set(self.prefix_matches(prompt))
+        )
+        return total - shared <= len(self._free) + evictable
 
     def fits_ever(self, seq_len: int) -> bool:
-        """Could this length be served by an EMPTY pool?  False means
-        the request must be rejected outright (graceful, not queued)."""
+        """Could this length be served by an EMPTY pool with no shared
+        prefix?  False means the raw budget alone overflows the pool —
+        see :meth:`fits_with_sharing` for the sharing-aware bound."""
         n = self.blocks_for(seq_len)
         return n <= self.allocatable_blocks and seq_len <= self.max_seq_len
 
+    def fits_with_sharing(self, seq_len: int, prompt=None) -> bool:
+        """Could this request EVER be admitted given the prefix blocks
+        currently indexed?  (Its private blocks must fit the pool.)"""
+        if seq_len > self.max_seq_len:
+            return False
+        total, shared = self.blocks_needed(seq_len, prompt)
+        return total - shared <= self.allocatable_blocks
+
     # --- reserve / release -------------------------------------------------
-    def reserve(self, slot: int, seq_len: int) -> List[int]:
-        """Take ``blocks_for(seq_len)`` blocks off the free list and map
-        them into ``slot``'s table.  Raises :exc:`KVCacheOOM` when the
-        free list is short (callers pre-check :meth:`can_reserve`)."""
+    def _acquire(self, n: int, protect=()) -> List[int]:
+        """Take ``n`` writable blocks: free list first, then evict LRU
+        retained prefix blocks (never one in ``protect`` — the blocks
+        this same reservation is re-attaching)."""
+        protect = set(protect)
+        out: List[int] = []
+        while len(out) < n:
+            if self._free:
+                out.append(self._free.popleft())
+                continue
+            victim = None
+            for b in self._cached:  # oldest first
+                if b not in protect:
+                    victim = b
+                    break
+            if victim is None:
+                # roll back — a failed reserve must take nothing
+                self._free.extendleft(reversed(out))
+                raise KVCacheOOM(
+                    f"need {n} KV blocks, {len(self._free)} free + "
+                    f"{len(self._cached)} cached (pool "
+                    f"{self.allocatable_blocks}, block {self.block_size})"
+                )
+            self._evict(victim)
+            out.append(self._free.popleft())
+        assert 0 not in out, "trash block leaked into the free list"
+        return out
+
+    def _evict(self, blk: int) -> None:
+        self._cached.pop(blk)
+        key = self._block_key.pop(blk)
+        self._index.pop(key, None)
+        self._free.append(blk)
+        self.evictions += 1
+
+    def reserve(self, slot: int, seq_len: int, prompt=None) -> List[int]:
+        """Map ``blocks_for(seq_len)`` blocks into ``slot``'s table —
+        prefix-index hits re-attached (refcount bump, zero allocation),
+        the remainder taken off the free list (evicting retained blocks
+        when it runs dry).  Raises :exc:`KVCacheOOM` when short (callers
+        pre-check :meth:`can_reserve`)."""
         assert 0 <= slot < self.slots
         assert slot not in self._owned, f"slot {slot} already reserved"
         n = self.blocks_for(seq_len)
@@ -151,45 +298,225 @@ class PagedKVCache:
             f"seq_len {seq_len} exceeds max_blocks_per_seq "
             f"{self.max_blocks_per_seq} x block_size {self.block_size}"
         )
-        if n > len(self._free):
-            raise KVCacheOOM(
-                f"need {n} KV blocks for seq_len {seq_len}, "
-                f"{len(self._free)} free "
-                f"(pool {self.allocatable_blocks}, block {self.block_size})"
-            )
-        blocks = [self._free.popleft() for _ in range(n)]
-        assert 0 not in blocks, "trash block leaked into the free list"
+        shared = self.prefix_matches(prompt)[:n]
+        want = self.shareable_blocks(prompt)
+        if want:
+            self.prefix_lookups += min(want, n)
+            self.prefix_hits += len(shared)
+        fresh = self._acquire(n - len(shared), protect=shared)
+        for b in shared:
+            if b in self._cached:  # revive a retained block
+                self._cached.pop(b)
+            self._refcount[b] = self._refcount.get(b, 0) + 1
+        for b in fresh:
+            assert self._refcount.get(b, 0) == 0
+            self._refcount[b] = 1
+        blocks = shared + fresh
         self._owned[slot] = blocks
+        self._protected[slot] = len(shared)
         self.tables[slot, :] = 0
         self.tables[slot, :n] = blocks
         return blocks
 
+    def shared_len(self, slot: int) -> int:
+        """Positions of ``slot`` served by re-attached prefix blocks —
+        the engine's prefill starts here."""
+        return self._protected.get(slot, 0) * self.block_size
+
     def release(self, slot: int) -> None:
-        """Return ``slot``'s blocks to the free list (mid-flight slot
-        recycling — the freed blocks are immediately reservable by a
-        queued request, no recompile)."""
+        """Drop ``slot``'s references (mid-flight slot recycling — the
+        freed blocks are immediately reservable by a queued request, no
+        recompile).  Registered blocks whose refcount reaches zero are
+        RETAINED in the LRU (warm prefix cache); unregistered ones go
+        straight back to the free list."""
         blocks = self._owned.pop(slot, None)
         assert blocks is not None, f"slot {slot} holds no reservation"
+        self._protected.pop(slot, None)
         free_set = set(self._free)
         for b in blocks:
-            assert b not in free_set, f"double-free of block {b}"
-            self._free.append(b)
+            rc = self._refcount.get(b, 0)
+            assert rc >= 1 and b not in free_set, f"double-free of block {b}"
+            rc -= 1
+            self._refcount[b] = rc
+            if rc == 0:
+                del self._refcount[b]
+                if b in self._block_key:
+                    self._cached[b] = self._block_key[b]  # LRU tail
+                else:
+                    self._free.append(b)
         self.tables[slot, :] = 0
+
+    def refcount(self, blk: int) -> int:
+        return self._refcount.get(blk, 0)
 
     def owned(self, slot: int) -> Tuple[int, ...]:
         return tuple(self._owned.get(slot, ()))
 
+    # --- prefix registration / copy-on-write -------------------------------
+    def commit_prefix(self, slot: int, prompt, upto: int) -> int:
+        """Register ``slot``'s fully-written full-prompt blocks (all of
+        whose positions are < ``upto`` AND prompt tokens) under their
+        cumulative hashes, making them shareable by later reservations.
+        Registered blocks become read-only for the producer too (the
+        protected boundary advances).  Returns how many blocks are now
+        registered for this slot."""
+        if not self.prefix_sharing or slot not in self._owned:
+            return 0
+        plen = int(len(prompt))
+        full = min(int(upto), plen) // self.block_size
+        done = 0
+        for b in range(min(full, len(self._owned[slot]))):
+            blk = self._owned[slot][b]
+            if blk in self._block_key:
+                done += 1
+                continue  # already registered (ours or re-attached)
+            key = self._prefix_key(prompt, b + 1)
+            if key in self._index:
+                # another slot registered identical content first; keep
+                # our private copy (merging would need a table rewrite)
+                continue
+            self._index[key] = blk
+            self._block_key[blk] = key
+            done += 1
+        self._protected[slot] = max(self._protected.get(slot, 0), done)
+        return done
+
+    def ensure_private(self, slot: int, logical_idx: int) -> int:
+        """Copy-on-write: make ``slot``'s ``logical_idx``-th block
+        writable.  A block shared with other tables (refcount > 1) is
+        replaced by a fresh copy of its device contents; a sole-owned
+        but still-indexed block is simply de-registered.  Returns the
+        (possibly new) physical id."""
+        blocks = self._owned[slot]
+        assert 0 <= logical_idx < len(blocks)
+        blk = blocks[logical_idx]
+        if self._refcount.get(blk, 0) <= 1:
+            if blk in self._block_key:  # de-register: sole owner writes
+                key = self._block_key.pop(blk)
+                self._index.pop(key, None)
+            self._protected[slot] = min(
+                self._protected.get(slot, 0), logical_idx
+            )
+            return blk
+        new = self._acquire(1, protect=blocks)[0]
+        self.cache_k = self.cache_k.at[:, new].set(self.cache_k[:, blk])
+        self.cache_v = self.cache_v.at[:, new].set(self.cache_v[:, blk])
+        self.cow_copies += 1
+        self._refcount[blk] -= 1
+        self._refcount[new] = 1
+        blocks[logical_idx] = new
+        self.tables[slot, logical_idx] = new
+        self._protected[slot] = min(self._protected.get(slot, 0), logical_idx)
+        return new
+
+    def shared_write_hazards(self) -> List[Tuple[int, int, int]]:
+        """The CoW-safety invariant ffcheck audits (docs/ANALYSIS.md):
+        every block a slot may WRITE (logical index at or past its
+        protected boundary) must be private and unindexed — the serving
+        programs donate the whole pool, so a shared block in the write
+        path would corrupt every other table mapping it.  Returns
+        ``(slot, logical_idx, block)`` rows; empty == safe."""
+        out: List[Tuple[int, int, int]] = []
+        for slot, blocks in self._owned.items():
+            for i in range(self._protected.get(slot, 0), len(blocks)):
+                b = blocks[i]
+                if self._refcount.get(b, 0) > 1 or b in self._block_key:
+                    out.append((slot, i, b))
+        return out
+
+    # --- spill / restore (preemption) --------------------------------------
+    def spill(self, slot: int, length: int) -> Dict[str, Any]:
+        """Drain ``slot``'s first ``length`` positions to host as a
+        per-layer payload (checkpoint convention: ``layer{i} -> {k, v}``
+        arrays of shape ``(H, length, D)``, dtype preserved) and release
+        the reservation.  The payload + :meth:`restore` round-trip is
+        bit-exact, so a preempted request resumes its exact stream."""
+        k, v = self.gather_dense(slot, length)
+        payload = {
+            "length": int(length),
+            "layers": {
+                f"layer{i}": {"k": np.asarray(k[i]), "v": np.asarray(v[i])}
+                for i in range(self.num_layers)
+            },
+        }
+        self.release(slot)
+        return payload
+
+    def restore(self, slot: int, payload: Dict[str, Any], seq_len: int,
+                prompt=None) -> int:
+        """Re-reserve ``seq_len`` for ``slot`` (prefix blocks still in
+        the index re-attach — their contents are provably identical to
+        the spilled data at those positions) and scatter the private
+        remainder of the payload back into the fresh blocks.  Returns
+        the re-attached shared length in positions."""
+        import jax.numpy as jnp
+
+        self.reserve(slot, seq_len, prompt=prompt)
+        shared_pos = self.shared_len(slot)
+        length = int(payload["length"])
+        if length <= shared_pos:
+            return shared_pos
+        L, H, BS, D = (
+            self.num_layers, self.heads, self.block_size, self.head_dim,
+        )
+        lo_blk = shared_pos // BS
+        hi_blk = self.blocks_for(length)
+        nb = hi_blk - lo_blk
+        pad = hi_blk * BS - length
+        k = np.stack([
+            np.asarray(payload["layers"][f"layer{i}"]["k"]) for i in range(L)
+        ])
+        v = np.stack([
+            np.asarray(payload["layers"][f"layer{i}"]["v"]) for i in range(L)
+        ])
+        if pad:
+            zeros = np.zeros((L, H, pad, D), k.dtype)
+            k = np.concatenate([k, zeros], axis=2)
+            v = np.concatenate([v, zeros], axis=2)
+        # (L, H, hi*BS, D) -> blocks (L, nb, H, BS, D) for the private span
+        k = k[:, :, lo_blk * BS:].reshape(L, H, nb, BS, D).transpose(
+            0, 2, 1, 3, 4
+        )
+        v = v[:, :, lo_blk * BS:].reshape(L, H, nb, BS, D).transpose(
+            0, 2, 1, 3, 4
+        )
+        ids = np.asarray(self._owned[slot][lo_blk:hi_blk], np.int32)
+        assert not any(
+            self._refcount.get(int(b), 0) > 1 or int(b) in self._block_key
+            for b in ids
+        ), "restore would write a shared block (CoW discipline breached)"
+        self.cache_k = self.cache_k.at[:, ids].set(jnp.asarray(k, self.dtype))
+        self.cache_v = self.cache_v.at[:, ids].set(jnp.asarray(v, self.dtype))
+        return shared_pos
+
+    # --- invariants ---------------------------------------------------------
     def check_invariants(self) -> None:
-        """Every block is either free or owned by exactly one slot, and
-        the trash block is neither."""
+        """Every block is free, retained (refcount 0 + indexed), or
+        mapped by >= 1 table with a matching refcount; the trash block is
+        none of these; the index and reverse map agree."""
         free = list(self._free)
+        cached = list(self._cached)
         owned = [b for bs in self._owned.values() for b in bs]
-        assert 0 not in free and 0 not in owned, "trash block allocated"
-        all_ = free + owned
-        assert len(all_) == len(set(all_)), "block owned twice"
+        assert 0 not in free + cached + owned, "trash block allocated"
+        counts: Dict[int, int] = {}
+        for b in owned:
+            counts[b] = counts.get(b, 0) + 1
+        assert counts == self._refcount, (
+            "refcounts disagree with table ownership",
+            counts, self._refcount,
+        )
+        assert not (set(free) | set(cached)) & set(owned), (
+            "block both free/cached and owned"
+        )
+        assert not set(free) & set(cached), "block both free and cached"
+        all_ = free + cached + sorted(set(owned))
         assert sorted(all_) == list(range(1, self.num_blocks)), (
             "blocks leaked or invented"
         )
+        for key, blk in self._index.items():
+            assert self._block_key.get(blk) == key, "index/reverse mismatch"
+        for blk in cached:
+            assert blk in self._block_key, "retained block lost its key"
 
     # --- device-side views -------------------------------------------------
     def table_row(self, slot: int):
@@ -200,7 +527,7 @@ class PagedKVCache:
         """Host-side re-assembly of ``slot``'s first ``seq_len`` cached
         positions into dense ``(L, H, seq_len, D)`` arrays — the
         bit-parity bridge the tests use to compare paged contents
-        against the dense session's cache."""
+        against the dense session's cache (dtype preserved)."""
         ck = np.asarray(self.cache_k)
         cv = np.asarray(self.cache_v)
         row = self.tables[slot]

@@ -31,7 +31,11 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional
 
-from flexflow_tpu.search.cost import TPUMachineModel, estimate_decode_step_time
+from flexflow_tpu.search.cost import (
+    TPUMachineModel,
+    estimate_decode_step_time,
+    estimate_speculative_decode,
+)
 from flexflow_tpu.tensor import Layer
 
 __all__ = ["ServeSpec", "ServeObjective"]
@@ -45,6 +49,13 @@ class ServeSpec:
     kv_len: int = 512  # steady-state prefix depth for the KV-read term
     slo_p99_ms: float = 50.0  # p99 per-token latency bound
     sync_every: int = 4  # engine flush cadence (observable-latency window)
+    # speculative decoding arm (0 = plain decode only).  When k > 0 the
+    # objective prices BOTH arms (plain vs accept-rate-weighted macro
+    # steps, estimate_speculative_decode) and takes the better one, so
+    # ``unity_search --objective serve`` can choose spec per placement
+    spec_k: int = 0
+    spec_accept: float = 0.7  # expected per-draft acceptance probability
+    spec_draft_frac: float = 0.5  # draft-slice depth / full depth
 
 
 class ServeObjective:
@@ -85,8 +96,33 @@ class ServeObjective:
                 self.calibration.correct_step("serve", step_s_raw), 1e-12
             )
             calibrated = step_s != step_s_raw
-        tok_s = self.spec.slots / step_s
-        p99_ms = step_s * self.spec.sync_every * 1e3
+        # speculative arm: accept-rate-weighted macro steps vs plain
+        # decode — the per-token step the SLO/throughput math sees is
+        # whichever arm is faster (spec_k = 0 keeps the plain arm only,
+        # byte-identical to the pre-spec objective)
+        spec_price = None
+        step_eff = step_s
+        if self.spec.spec_k > 0:
+            spec_price = estimate_speculative_decode(
+                step_s,
+                k=self.spec.spec_k,
+                accept_rate=self.spec.spec_accept,
+                draft_frac=self.spec.spec_draft_frac,
+            )
+            spec_price["chosen"] = (
+                spec_price["effective_step_s"] < step_s
+            )
+            if spec_price["chosen"]:
+                step_eff = spec_price["effective_step_s"]
+        tok_s = self.spec.slots / step_eff
+        # observable latency: a token flushes at its window's end; with
+        # spec chosen a window is sync_every MACRO steps
+        win_s = (
+            spec_price["macro_s"]
+            if spec_price is not None and spec_price["chosen"]
+            else step_eff
+        )
+        p99_ms = win_s * self.spec.sync_every * 1e3
         feasible = p99_ms <= self.spec.slo_p99_ms
         cost = 1.0 / tok_s
         if not feasible:
@@ -101,9 +137,10 @@ class ServeObjective:
             "slots": self.spec.slots,
             "kv_len": self.spec.kv_len,
             "sync_every": self.spec.sync_every,
-            "step_s": step_s,
+            "step_s": step_eff,
             "step_s_raw": step_s_raw,
             "calibrated": calibrated,
+            "spec": spec_price,
             "breakdown": {
                 k: d[k] for k in ("mem_s", "flops_s", "coll_s")
             },

@@ -1,4 +1,4 @@
-"""Continuous-batching scheduler (docs/SERVING.md).
+"""Continuous-batching scheduler with SLO tiers (docs/SERVING.md).
 
 The reference's inference story is a Legion backend serving one model
 instance per request stream; here the unit of batching is the SLOT — a
@@ -12,16 +12,34 @@ through it.
 
 Admission policy (pinned by tests/test_serve.py):
 
-* **strict FIFO** — the queue head blocks admission until both a slot
-  and its KV reservation are available (no reordering, no starvation of
-  long requests behind short ones);
+* **strict FIFO within a tier** — a tier's queue head blocks admission
+  until both a slot and its KV reservation are available (no
+  reordering, no starvation of long requests behind short ones);
 * **graceful rejection** — a request whose budget could never fit the
   pool (``prompt + max_new_tokens`` over the per-sequence table limit,
-  or more blocks than the whole pool owns) is rejected at submit with a
-  reason, not crashed on later;
+  or more *private* blocks than the whole pool owns — prefix sharing
+  changes the budget arithmetic, and the reasons say which bound bit)
+  is rejected at submit with a reason, not crashed on later;
 * **reservation at admission** — blocks for the full budget are taken
   up front (see kvcache.py), so decode windows never fault on
-  allocation.
+  allocation.  Admission charges only UNSHARED blocks: the reservation
+  re-attaches indexed prefix blocks, and ``prefill_pos`` starts past
+  them.
+
+**SLO tiers (PR 11).**  Every request carries a ``tenant`` label and a
+``tier`` — ``"interactive"`` (latency-sensitive: chat turns, tab
+completions) or ``"batch"`` (throughput work: evals, digests; the
+default, which keeps single-tier workloads exactly the old strict
+FIFO).  Interactive requests admit first, and when one is waiting with
+no admissible slot the scheduler PREEMPTS a batch request: the victim's
+live K/V is spilled to host through :meth:`PagedKVCache.spill` (the
+per-layer checkpoint convention), its slot and blocks are released, and
+it re-queues at the FRONT of the batch tier in ``PREEMPTED`` state.  On
+re-admission the spill payload is restored bit-exactly (shared prefix
+blocks re-attach from the index; private positions scatter back), so
+the victim resumes its exact token stream — the round-trip test pins
+this.  Spill and restore happen at flush boundaries inside the window's
+one host sync, so the zero-per-step-sync ledger is untouched.
 """
 
 from __future__ import annotations
@@ -29,19 +47,22 @@ from __future__ import annotations
 import dataclasses
 import enum
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from flexflow_tpu.serve.kvcache import PagedKVCache
 
-__all__ = ["Request", "RequestState", "ContinuousBatchingScheduler"]
+__all__ = ["Request", "RequestState", "ContinuousBatchingScheduler", "TIERS"]
+
+TIERS = ("interactive", "batch")
 
 
 class RequestState(enum.Enum):
     QUEUED = "queued"
     PREFILL = "prefill"
     DECODE = "decode"
+    PREEMPTED = "preempted"  # spilled to host, waiting to resume
     FINISHED = "finished"
     REJECTED = "rejected"
 
@@ -49,20 +70,26 @@ class RequestState(enum.Enum):
 @dataclasses.dataclass
 class Request:
     """One generation request: a prompt, a token budget, an optional
-    EOS, and the latency bookkeeping the metrics stream reports."""
+    EOS, a tenant/tier label, and the latency bookkeeping the metrics
+    stream reports."""
 
     prompt: np.ndarray  # (prompt_len,) int32
     max_new_tokens: int
     id: int = -1
     eos_id: Optional[int] = None
     arrival_s: float = 0.0  # open-loop arrival offset (traffic.py)
+    tenant: str = "default"
+    tier: str = "batch"  # "interactive" | "batch"
 
     # --- filled in by the scheduler/engine ---
     state: RequestState = RequestState.QUEUED
     slot: int = -1
     tokens: List[int] = dataclasses.field(default_factory=list)
-    prefill_pos: int = 0  # prompt tokens ingested so far
+    prefill_pos: int = 0  # prompt positions with live KV so far
     finish_reason: Optional[str] = None  # "eos" | "length" | "rejected:*"
+    preemptions: int = 0
+    kv_spill: Optional[Dict[str, Any]] = None  # spill payload while PREEMPTED
+    shared_prefix_pos: int = 0  # prompt positions served from shared blocks
     t_submit: Optional[float] = None
     arrival_abs_s: Optional[float] = None  # engine clock: t0 + arrival_s
     t_admitted: Optional[float] = None
@@ -73,6 +100,7 @@ class Request:
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
         assert len(self.prompt) >= 1, "empty prompt"
         assert self.max_new_tokens >= 1
+        assert self.tier in TIERS, f"unknown tier {self.tier!r}"
 
     @property
     def prompt_len(self) -> int:
@@ -108,63 +136,163 @@ class Request:
 
 
 class ContinuousBatchingScheduler:
-    """FIFO admission of :class:`Request`s into ``slots`` decode lanes
-    backed by a :class:`PagedKVCache` (see module docstring)."""
+    """Tiered FIFO admission of :class:`Request`s into ``slots`` decode
+    lanes backed by a :class:`PagedKVCache` (see module docstring)."""
 
     def __init__(self, slots: int, kvcache: PagedKVCache) -> None:
         assert kvcache.slots == slots, (kvcache.slots, slots)
         self.slots = slots
         self.kv = kvcache
-        self.queue: deque = deque()
+        self._queues: Dict[str, deque] = {t: deque() for t in TIERS}
         self.free_slots: deque = deque(range(slots))
         self.active: Dict[int, Request] = {}  # slot -> request
         self.finished: List[Request] = []
         self.rejected: List[Request] = []
+        self.preemptions = 0  # cumulative spill events
         self._next_id = 0
+
+    @property
+    def queue(self) -> List[Request]:
+        """Pending requests in admission order (interactive tier ahead
+        of batch; FIFO within each)."""
+        return [r for t in TIERS for r in self._queues[t]]
 
     # --- submission --------------------------------------------------------
     def submit(self, req: Request, now: float = 0.0) -> Request:
         """Queue a request, or reject it outright when its budget could
         never be served by this cache (graceful — the request comes back
-        marked REJECTED, nothing raises)."""
+        marked REJECTED, nothing raises).  Prefix sharing participates:
+        a budget that overflows the pool raw but fits once its indexed
+        prefix blocks are discounted IS queued, and a rejection reason
+        says whether shared blocks were considered."""
         if req.id < 0:
             req.id = self._next_id
         self._next_id = max(self._next_id, req.id) + 1
         req.t_submit = now
-        if not self.kv.fits_ever(req.max_len):
-            req.state = RequestState.REJECTED
-            req.finish_reason = (
-                f"rejected: max_len {req.max_len} needs "
-                f"{self.kv.blocks_for(req.max_len)} blocks, pool holds "
-                f"{self.kv.allocatable_blocks} "
-                f"(table limit {self.kv.max_seq_len} positions)"
-            )
-            self.rejected.append(req)
+        if not self.kv.fits_with_sharing(req.max_len, req.prompt):
+            self._reject(req, now)
             return req
         req.state = RequestState.QUEUED
-        self.queue.append(req)
+        self._queues[req.tier].append(req)
         return req
 
+    def _reject(self, req: Request, now: float) -> None:
+        total, shared = self.kv.blocks_needed(req.max_len, req.prompt)
+        reason = (
+            f"rejected: max_len {req.max_len} needs "
+            f"{total} blocks, pool holds "
+            f"{self.kv.allocatable_blocks} "
+            f"(table limit {self.kv.max_seq_len} positions)"
+        )
+        # distinguish "never fits" from "fits after shared blocks" so
+        # the message stays truthful under prefix sharing
+        if shared > 0:
+            reason += (
+                f"; {shared} shared prefix blocks discounted, "
+                f"{total - shared} private blocks still exceed the pool"
+            )
+        else:
+            reason += "; never fits (no shared prefix applies)"
+        req.state = RequestState.REJECTED
+        req.finish_reason = reason
+        req.t_done = now
+        self.rejected.append(req)
+
     # --- admission ---------------------------------------------------------
+    def _place(self, req: Request, now: float) -> None:
+        slot = self.free_slots.popleft()
+        if req.kv_spill is not None:
+            # resuming a preempted request: restore the spilled K/V
+            # bit-exactly and rejoin the decode pool directly (its
+            # prompt was fully ingested before the spill)
+            self.kv.restore(slot, req.kv_spill, req.max_len,
+                            prompt=req.prompt)
+            req.kv_spill = None
+            req.state = RequestState.DECODE
+            req.prefill_pos = req.prompt_len
+        else:
+            self.kv.reserve(slot, req.max_len, prompt=req.prompt)
+            req.state = RequestState.PREFILL
+            # shared prefix blocks already hold these positions' K/V —
+            # prefill starts past them (never past the last prompt
+            # token: shareable_blocks() keeps it private, so the first
+            # next-token distribution is always computed)
+            req.prefill_pos = req.shared_prefix_pos = min(
+                self.kv.shared_len(slot), req.prompt_len - 1
+            )
+        req.slot = slot
+        if req.t_admitted is None:
+            req.t_admitted = now
+        self.active[slot] = req
+
+    def _admit_tier(self, tier: str, now: float) -> List[Request]:
+        out: List[Request] = []
+        q = self._queues[tier]
+        while q and self.free_slots:
+            req = q[0]
+            if not self.kv.fits_with_sharing(req.max_len, req.prompt):
+                # the shared blocks that justified queueing were evicted
+                # — reject late rather than block the tier forever
+                q.popleft()
+                self._reject(req, now)
+                continue
+            if not self.kv.can_reserve(req.max_len, req.prompt):
+                break
+            q.popleft()
+            self._place(req, now)
+            out.append(req)
+        return out
+
+    def _preempt_one(self, now: float) -> bool:
+        """Spill ONE batch-tier victim to host and recycle its slot +
+        blocks.  Victim choice: the most recently admitted batch DECODE
+        request (least sunk work lost); a mid-PREFILL batch request is
+        the fallback (its KV is cheap to rebuild, so it just re-queues
+        without a payload).  Returns False when no victim exists."""
+        decode_victims = [
+            r for r in self.active.values()
+            if r.tier == "batch" and r.state is RequestState.DECODE
+        ]
+        prefill_victims = [
+            r for r in self.active.values()
+            if r.tier == "batch" and r.state is RequestState.PREFILL
+        ]
+        pool = decode_victims or prefill_victims
+        if not pool:
+            return False
+        victim = max(pool, key=lambda r: (r.t_admitted or 0.0, r.slot))
+        slot = victim.slot
+        del self.active[slot]
+        if victim.state is RequestState.DECODE:
+            # positions written so far: the full prompt + one KV write
+            # per decode step taken (the latest token is still pending
+            # as the next step's input, so it has no KV yet)
+            live = victim.prompt_len + max(0, victim.done_tokens - 1)
+            victim.kv_spill = self.kv.spill(slot, live)
+        else:
+            # mid-prefill: drop the partial KV, re-ingest on resume
+            self.kv.release(slot)
+            victim.kv_spill = None
+            victim.prefill_pos = 0
+        self.free_slots.append(slot)
+        victim.slot = -1
+        victim.state = RequestState.PREEMPTED
+        victim.preemptions += 1
+        self.preemptions += 1
+        self._queues["batch"].appendleft(victim)  # resume first
+        return True
+
     def admit(self, now: float = 0.0) -> List[Request]:
         """Admit queue-head requests into free slots while both a slot
-        and the full KV reservation are available (strict FIFO: a head
-        that doesn't fit YET blocks everything behind it until running
-        requests release blocks)."""
-        out: List[Request] = []
-        while self.queue and self.free_slots:
-            req = self.queue[0]
-            if not self.kv.can_reserve(req.max_len):
+        and the KV reservation (net of shared blocks) are available.
+        Interactive requests admit first and preempt batch slots when
+        they cannot be placed otherwise."""
+        out = self._admit_tier("interactive", now)
+        while self._queues["interactive"]:
+            if not self._preempt_one(now):
                 break
-            self.queue.popleft()
-            slot = self.free_slots.popleft()
-            self.kv.reserve(slot, req.max_len)
-            req.slot = slot
-            req.state = RequestState.PREFILL
-            req.prefill_pos = 0
-            req.t_admitted = now
-            self.active[slot] = req
-            out.append(req)
+            out.extend(self._admit_tier("interactive", now))
+        out.extend(self._admit_tier("batch", now))
         return out
 
     def finish(self, req: Request, now: float, reason: str) -> None:
@@ -184,7 +312,7 @@ class ContinuousBatchingScheduler:
     # --- introspection -----------------------------------------------------
     @property
     def queue_depth(self) -> int:
-        return len(self.queue)
+        return sum(len(q) for q in self._queues.values())
 
     @property
     def occupancy(self) -> float:
@@ -204,4 +332,39 @@ class ContinuousBatchingScheduler:
 
     @property
     def idle(self) -> bool:
-        return not self.queue and not self.active
+        return self.queue_depth == 0 and not self.active
+
+    def tenant_summary(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant fairness aggregates over everything this
+        scheduler has seen (the serve report / metrics vocabulary)."""
+        out: Dict[str, Dict[str, Any]] = {}
+
+        def row(tenant: str) -> Dict[str, Any]:
+            return out.setdefault(tenant, {
+                "finished": 0, "rejected": 0, "active": 0, "queued": 0,
+                "preemptions": 0, "tokens": 0, "ttft_ms": [],
+                "tier": None,
+            })
+
+        for r in self.finished:
+            d = row(r.tenant)
+            d["finished"] += 1
+            d["tokens"] += r.done_tokens
+            d["preemptions"] += r.preemptions
+            d["tier"] = r.tier
+            ttft = r.latency_ms()["ttft_ms"]
+            if ttft is not None:
+                d["ttft_ms"].append(ttft)
+        for r in self.rejected:
+            d = row(r.tenant)
+            d["rejected"] += 1
+            d["tier"] = r.tier
+        for r in self.active.values():
+            d = row(r.tenant)
+            d["active"] += 1
+            d["tier"] = r.tier
+        for r in self.queue:
+            d = row(r.tenant)
+            d["queued"] += 1
+            d["tier"] = r.tier
+        return out

@@ -9,6 +9,16 @@ ranges; everything is seeded, so a (seed, shape) pair identifies a
 workload exactly — ``bench.py`` records that identity
 (``serve_traffic``) and ``tools/bench_compare.py`` treats it as
 comparable metadata, the same pattern as ``stack_blocks``.
+
+**Multi-tenant shapes (PR 11).**  ``shared_prefix > 0`` prepends a
+per-tenant "system prompt" of that many tokens to every request — the
+traffic shape prefix sharing exists for (identical leading blocks
+across a tenant's requests).  ``tenants`` splits the stream across
+named tenants round-robin, each with its own system prompt and an SLO
+tier; ``interactive_frac`` marks that fraction of tenants (rounded up,
+at least one when positive) as the latency tier.  All of it is seeded
+and identity-stamped; the default values keep ``identity`` byte-equal
+to the single-tenant string older records pinned.
 """
 
 from __future__ import annotations
@@ -20,7 +30,7 @@ import numpy as np
 
 from flexflow_tpu.serve.scheduler import Request
 
-__all__ = ["TrafficSpec", "synthetic_requests"]
+__all__ = ["TrafficSpec", "synthetic_requests", "multi_tenant_requests"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -34,21 +44,37 @@ class TrafficSpec:
     prompt_len: Tuple[int, int] = (4, 12)  # inclusive range
     max_new: Tuple[int, int] = (4, 24)  # inclusive range
     vocab: int = 256
+    # multi-tenant extensions (defaults = the legacy single-tenant
+    # shape, so old identity strings stay byte-identical)
+    tenants: int = 1
+    shared_prefix: int = 0  # per-tenant system-prompt tokens
+    interactive_frac: float = 0.0  # fraction of tenants on the SLO tier
 
     @property
     def identity(self) -> str:
-        """The bench-record metadata string (seed + shape)."""
-        return (
+        """The bench-record metadata string (seed + shape).  Tenant
+        fields append ONLY when non-default — pre-PR-11 records compare
+        as the same workload."""
+        s = (
             f"seed{self.seed}/n{self.n_requests}"
             f"/p{self.prompt_len[0]}-{self.prompt_len[1]}"
             f"/g{self.max_new[0]}-{self.max_new[1]}"
             f"/r{self.rate_rps:g}/v{self.vocab}"
         )
+        if self.tenants != 1 or self.shared_prefix or self.interactive_frac:
+            s += (
+                f"/t{self.tenants}/sp{self.shared_prefix}"
+                f"/i{self.interactive_frac:g}"
+            )
+        return s
 
 
 def synthetic_requests(spec: TrafficSpec) -> List[Request]:
     """Deterministic workload for ``spec`` (same spec -> same token
-    streams and arrival times, any process)."""
+    streams and arrival times, any process).  Specs with tenant fields
+    route through :func:`multi_tenant_requests`."""
+    if spec.tenants != 1 or spec.shared_prefix or spec.interactive_frac:
+        return multi_tenant_requests(spec)
     rng = np.random.default_rng(spec.seed)
     out: List[Request] = []
     t = 0.0
@@ -60,5 +86,41 @@ def synthetic_requests(spec: TrafficSpec) -> List[Request]:
         prompt = rng.integers(0, spec.vocab, size=(plen,)).astype(np.int32)
         out.append(Request(
             prompt=prompt, max_new_tokens=gen, id=i, arrival_s=t,
+        ))
+    return out
+
+
+def multi_tenant_requests(spec: TrafficSpec) -> List[Request]:
+    """Deterministic multi-tenant workload: tenant ``j`` owns a fixed
+    ``shared_prefix``-token system prompt (drawn once per tenant from
+    the same seed stream) prepended to every one of its requests, and
+    the first ``ceil(tenants * interactive_frac)`` tenants serve on the
+    interactive tier.  Requests rotate across tenants round-robin so
+    tiers interleave in arrival order."""
+    rng = np.random.default_rng(spec.seed)
+    nt = max(1, int(spec.tenants))
+    n_inter = 0
+    if spec.interactive_frac > 0:
+        n_inter = min(nt, max(1, int(np.ceil(nt * spec.interactive_frac))))
+    sys_prompts = [
+        rng.integers(0, spec.vocab, size=(spec.shared_prefix,)).astype(
+            np.int32
+        )
+        for _ in range(nt)
+    ]
+    out: List[Request] = []
+    t = 0.0
+    for i in range(spec.n_requests):
+        if spec.rate_rps > 0:
+            t += float(rng.exponential(1.0 / spec.rate_rps))
+        j = i % nt
+        plen = int(rng.integers(spec.prompt_len[0], spec.prompt_len[1] + 1))
+        gen = int(rng.integers(spec.max_new[0], spec.max_new[1] + 1))
+        tail = rng.integers(0, spec.vocab, size=(plen,)).astype(np.int32)
+        prompt = np.concatenate([sys_prompts[j], tail])
+        out.append(Request(
+            prompt=prompt, max_new_tokens=gen, id=i, arrival_s=t,
+            tenant=f"tenant{j}",
+            tier="interactive" if j < n_inter else "batch",
         ))
     return out

@@ -75,6 +75,11 @@ DEFAULT_THRESHOLD = 0.15
 COMPARABLE_METADATA = (
     "metrics_sync_every", "stack_blocks", "serve_traffic", "cost_model_tier",
     "pipeline",
+    # serve_spec_k (r11, docs/SERVING.md): the speculative draft depth of
+    # the serve A/B — runs at different k are still the same experiment,
+    # but the gate surfaces the change because k shifts decode tokens/s
+    # for configuration (not regression) reasons
+    "serve_spec_k",
 )
 
 # (label, path into the record, higher_is_better) — the gated metrics.
@@ -98,6 +103,12 @@ GATED = (
     ("pipeline_bubble_frac", ("pipeline_bubble_frac",), False),
     ("serve_tok_s", ("serve_tok_s",), True),
     ("serve_p99_ms", ("serve_p99_ms",), False),
+    # serve_prefix_hit_rate (r11, docs/SERVING.md "Prefix sharing"):
+    # the shared-prefix A/B's prefix-cache hit rate gates
+    # higher-is-better — a drop means requests stopped re-attaching
+    # registered blocks (hash keying or CoW regression), which silently
+    # halves admissible concurrency long before throughput notices
+    ("serve_prefix_hit_rate", ("serve_prefix_hit_rate",), True),
     ("dlrm", ("secondary", "dlrm", "samples_per_sec"), True),
     ("bert_large", ("secondary", "bert_large", "samples_per_sec"), True),
     ("gpt_decode_cached", ("secondary", "gpt_decode", "cached_tok_per_s"), True),

@@ -12,6 +12,11 @@ docs/SERVING.md) and prints:
   * per-request latency percentiles — TTFT and TPOT p50/p90/p99 over
     every finished request in the stream;
   * the run's aggregate: new tokens, tokens/s, windows, finish reasons;
+  * multi-tenant scale-out facts (PR 11, additive vocabulary — absent
+    in older streams, rendered only when present): prefix-cache hit
+    rate + retained blocks, batch-tier preemption count, speculative
+    accept rate, and a per-tenant table (tier, finished requests,
+    TTFT p50/p99, TPOT p99, preemptions);
   * a per-window table (queue depth, batch occupancy, decode steps,
     prefill chunks, tokens) — ``--windows`` caps the rows, newest last.
 
@@ -105,6 +110,75 @@ def render(records: List[Dict], max_windows: int = 30) -> str:
         out.append(
             f"occupancy: mean {sum(occ) / len(occ):.3f}, "
             f"min {min(occ):.3f}, max {max(occ):.3f}"
+        )
+
+    # --- multi-tenant scale-out facts (PR 11; additive vocabulary) ---
+    # hit rate / preemptions are cumulative counters — the LAST window
+    # carries the run totals; absent keys mean a pre-PR-11 stream
+    last = serve[-1][1]
+    facts = []
+    if last.get("prefix_hit_rate") is not None:
+        facts.append(
+            f"prefix cache: hit rate {last['prefix_hit_rate']:.3f}, "
+            f"{last.get('cached_blocks', 0)} retained blocks at end"
+        )
+    if last.get("preemptions_total"):
+        facts.append(
+            f"preemptions: {last['preemptions_total']} batch-tier "
+            "spill/restore events"
+        )
+    spec_d = sum(
+        (s.get("spec") or {}).get("drafted", 0) for _, s in serve
+    )
+    spec_a = sum(
+        (s.get("spec") or {}).get("accepted", 0) for _, s in serve
+    )
+    if spec_d:
+        k = next(
+            s["spec"]["k"] for _, s in serve if s.get("spec")
+        )
+        facts.append(
+            f"speculative decode: k={k}, accept rate "
+            f"{spec_a / spec_d:.3f} ({spec_a}/{spec_d} drafts)"
+        )
+    if facts:
+        out.append("\n".join(facts))
+
+    # per-tenant latency table — only when any record names a tenant
+    by_tenant: Dict[str, Dict] = {}
+    for f in finished:
+        if f.get("tenant") is None:
+            continue
+        d = by_tenant.setdefault(
+            f["tenant"],
+            {"tier": f.get("tier", "?"), "n": 0, "ttft": [], "tpot": [],
+             "preempted": 0},
+        )
+        d["n"] += 1
+        if f.get("ttft_ms") is not None:
+            d["ttft"].append(f["ttft_ms"])
+        if f.get("tpot_ms") is not None:
+            d["tpot"].append(f["tpot_ms"])
+        d["preempted"] += int(f.get("preempted") or 0)
+    if by_tenant:
+        rows = [
+            [
+                t, d["tier"], d["n"],
+                f"{_pct(d['ttft'], 50):.3f}" if d["ttft"] else "-",
+                f"{_pct(d['ttft'], 99):.3f}" if d["ttft"] else "-",
+                f"{_pct(d['tpot'], 99):.3f}" if d["tpot"] else "-",
+                d["preempted"],
+            ]
+            for t, d in sorted(by_tenant.items())
+        ]
+        out.append(
+            "per-tenant (SLO tiers — docs/SERVING.md \"Admission "
+            "classes\"):\n"
+            + _table(
+                ["tenant", "tier", "done", "ttft_p50", "ttft_p99",
+                 "tpot_p99", "preempted"],
+                rows,
+            )
         )
 
     rows = []

@@ -930,6 +930,106 @@ def _serve_spec_ab(on_tpu: bool) -> dict:
     }
 
 
+def _recovery_ab(on_tpu: bool) -> dict:
+    """Kill-and-resume A/B (ISSUE 12 acceptance): train a tiny model to
+    completion (arm A), then re-run it with a deterministic injected
+    device loss mid-run and per-step checkpointing (arm B), time the
+    checkpoint restore (``recovery_s``), resume a FRESH model from the
+    last checkpoint, and check the resumed run's final weights are
+    BIT-identical to the uninterrupted arm (``resume_replay_exact`` —
+    gated at true by tools/bench_compare.py).  docs/RESILIENCE.md."""
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from flexflow_tpu import (
+        ActiMode, AdamOptimizer, FFConfig, FFModel, LossType, MachineMesh,
+    )
+    from flexflow_tpu.runtime.faults import FaultPlan, set_fault_plan
+
+    B, D, C = 16, 16, 8
+    N = B * 4  # 4 batches/epoch
+    epochs = 2
+    kill_step = 6  # mid-epoch-2 (steps are 1-based in the executor)
+    spec = f"fit:device_loss@{kill_step}"
+
+    def build():
+        cfg = FFConfig(batch_size=B, learning_rate=0.05)
+        m = FFModel(cfg)
+        t = m.create_tensor((B, D))
+        t = m.dense(t, 32, ActiMode.RELU)
+        t = m.dense(t, C)
+        m.softmax(t)
+        m.compile(
+            optimizer=AdamOptimizer(alpha=1e-2),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            mesh=MachineMesh((1, 1), ("data", "model")),
+            seed=0,
+        )
+        return m
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(N, D)).astype(np.float32)
+    y = rng.integers(0, C, size=(N, 1)).astype(np.int32)
+
+    def flat_weights(m):
+        return {
+            f"{ln}/{wn}": w
+            for ln, ws in m.get_weights().items()
+            for wn, w in ws.items()
+        }
+
+    with tempfile.TemporaryDirectory() as td:
+        ck = os.path.join(td, "recovery_ab.npz")
+        # arm A: uninterrupted reference run
+        ref = build()
+        ref.fit(x, y, epochs=epochs, shuffle=True, verbose=False)
+        ref_w = flat_weights(ref)
+
+        # arm B: same run killed at kill_step with per-step checkpoints
+        set_fault_plan(FaultPlan.parse(spec, seed=0))
+        killed = build()
+        try:
+            killed.fit(
+                x, y, epochs=epochs, shuffle=True, verbose=False,
+                checkpoint_every=1, checkpoint_path=ck,
+            )
+            raise RuntimeError("injected device loss did not fire")
+        except RuntimeError as e:
+            if getattr(e, "kind", None) != "device_loss":
+                raise
+        finally:
+            set_fault_plan(None)
+
+        # recovery_s: restore the last checkpoint into a fresh model
+        resumed = build()
+        t0 = _time.perf_counter()
+        resumed.load_checkpoint(ck)
+        recovery_s = _time.perf_counter() - t0
+        # exact resume: replay the remainder from the checkpoint
+        resumed = build()
+        resumed.fit(
+            x, y, epochs=epochs, shuffle=True, verbose=False, resume=ck
+        )
+        res_w = flat_weights(resumed)
+        exact = set(res_w) == set(ref_w) and all(
+            ref_w[k].dtype == res_w[k].dtype
+            and np.array_equal(
+                ref_w[k], res_w[k]
+            )
+            for k in ref_w
+        )
+
+    return {
+        "fault_plan": spec,
+        "kill_step": kill_step,
+        "steps_total": epochs * (N // B),
+        "recovery_s": round(recovery_s, 6),
+        "resume_replay_exact": bool(exact),
+    }
+
+
 def _bench_secondary(on_tpu: bool) -> dict:
     """The BASELINE.json north-star secondary configs; each failure is
     contained so it can never sink the headline metric."""
@@ -941,6 +1041,7 @@ def _bench_secondary(on_tpu: bool) -> dict:
         ("serve_continuous_ab", _serve_continuous_ab),
         ("serve_prefix_ab", _serve_prefix_ab),
         ("serve_spec_ab", _serve_spec_ab),
+        ("recovery_ab", _recovery_ab),
     ):
         try:
             out[name] = fn(on_tpu)
@@ -1159,6 +1260,13 @@ def run_bench(backend: str) -> None:
         # different k are different workloads)
         "serve_prefix_hit_rate": None,
         "serve_spec_k": None,
+        # resilience (ISSUE 12, docs/RESILIENCE.md): checkpoint-restore
+        # wall time (LOWER-is-better), the kill-and-resume bit-identity
+        # bit (gated AT TRUE), and the injected fault plan (comparable
+        # metadata — records with different plans are different runs)
+        "recovery_s": None,
+        "resume_replay_exact": None,
+        "fault_plan": None,
         # --verify-compiled ffcheck pass (docs/ANALYSIS.md): violation
         # count from the post-compile static analysis of the headline
         # step, gated AT ZERO by tools/bench_compare.py; null when the
@@ -1224,6 +1332,10 @@ def run_bench(backend: str) -> None:
     record["serve_prefix_hit_rate"] = pab.get("serve_prefix_hit_rate")
     xab = record["secondary"].get("serve_spec_ab") or {}
     record["serve_spec_k"] = xab.get("serve_spec_k")
+    rab = record["secondary"].get("recovery_ab") or {}
+    record["recovery_s"] = rab.get("recovery_s")
+    record["resume_replay_exact"] = rab.get("resume_replay_exact")
+    record["fault_plan"] = rab.get("fault_plan")
     print(json.dumps(record), flush=True)
 
 

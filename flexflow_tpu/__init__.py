@@ -42,11 +42,16 @@ from flexflow_tpu.initializer import (
     UniformInitializer,
     ZeroInitializer,
 )
-from flexflow_tpu.model import FFModel
+from flexflow_tpu.model import CheckpointError, FFModel
 from flexflow_tpu.obs import Tracer, get_tracer
 from flexflow_tpu.optimizer import AdamOptimizer, SGDOptimizer
 from flexflow_tpu.parallel.machine import MachineMesh
-from flexflow_tpu.runtime.recompile import RecompileState
+from flexflow_tpu.runtime.faults import (
+    FaultPlan,
+    get_fault_plan,
+    set_fault_plan,
+)
+from flexflow_tpu.runtime.recompile import RecompileState, RecoveryPolicy
 from flexflow_tpu.parallel.spec import TensorSharding
 from flexflow_tpu.parallel.strategy import (
     Strategy,
@@ -76,6 +81,11 @@ __all__ = [
     "data_parallel_strategy",
     "tensor_parallel_strategy",
     "RecompileState",
+    "RecoveryPolicy",
+    "CheckpointError",
+    "FaultPlan",
+    "get_fault_plan",
+    "set_fault_plan",
     "Tracer",
     "get_tracer",
     "GlorotUniform",

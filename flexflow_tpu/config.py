@@ -76,7 +76,7 @@ class FFConfig:
     # anomaly policy: non-finite loss/grad + EMA loss-spike detectors.
     # "dump"/"raise" write a debug bundle (config, strategy, last-N step
     # records, Chrome trace, memory snapshot) on the first anomaly.
-    health: str = "off"  # off | warn | dump | raise
+    health: str = "off"  # off | warn | dump | raise | restore
     health_dir: str = "health_bundles"  # bundle output directory
     health_window: int = 64  # flight-recorder ring size (last-N records)
     health_spike_factor: float = 4.0  # loss > factor * EMA(loss) => spike
@@ -191,6 +191,19 @@ class FFConfig:
     serve_spec_k: int = 0  # speculative draft depth (0 = off)
     serve_spec_draft_layers: int = 0  # draft slice depth (0 = half)
     serve_spec_accept: float = 0.7  # priced per-draft acceptance prob.
+    # --- resilience (docs/RESILIENCE.md) ---
+    # deterministic fault injection: a spec string ([site:]kind@step[:arg],
+    # comma-separated) or a JSON plan file; None = no plan, zero overhead
+    fault_plan: Optional[str] = None
+    checkpoint_every: int = 0  # snapshot every K optimizer steps (0 = off)
+    checkpoint_path: Optional[str] = None  # target .npz for --checkpoint-every
+    resume_from: Optional[str] = None  # checkpoint to restore before fit
+    max_restores: int = 1  # --health restore rewind budget per fit
+    coordinator_retries: int = 0  # transient connect retries (distributed)
+    coordinator_backoff_s: float = 1.0  # base backoff, doubles per attempt
+    serve_watchdog_s: float = 0.0  # flag windows slower than this (0 = off)
+    serve_shed_windows: int = 0  # shed batch tier after N SLO-breach windows
+    serve_drain_file: Optional[str] = None  # SIGTERM drain payload target
 
     def __post_init__(self) -> None:
         self._devices = None
@@ -372,6 +385,26 @@ class FFConfig:
                 self.serve_spec_draft_layers = int(take())
             elif a == "--serve-spec-accept":
                 self.serve_spec_accept = float(take())
+            elif a == "--fault-plan":
+                self.fault_plan = take()
+            elif a == "--checkpoint-every":
+                self.checkpoint_every = int(take())
+            elif a == "--checkpoint-path":
+                self.checkpoint_path = take()
+            elif a == "--resume":
+                self.resume_from = take()
+            elif a == "--max-restores":
+                self.max_restores = int(take())
+            elif a == "--coordinator-retries":
+                self.coordinator_retries = int(take())
+            elif a == "--coordinator-backoff-s":
+                self.coordinator_backoff_s = float(take())
+            elif a == "--serve-watchdog-s":
+                self.serve_watchdog_s = float(take())
+            elif a == "--serve-shed-windows":
+                self.serve_shed_windows = int(take())
+            elif a == "--serve-drain-file":
+                self.serve_drain_file = take()
             else:
                 rest.append(a)
             i += 1

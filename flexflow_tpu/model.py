@@ -22,7 +22,13 @@ What compile() does here vs the reference:
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
+import os
+import queue
+import threading
 import time
+import zipfile
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -46,6 +52,7 @@ from flexflow_tpu.fftype import (
 from flexflow_tpu.initializer import Initializer
 from flexflow_tpu.metrics import DeviceMetricAccumulator, Metrics, PerfMetrics
 from flexflow_tpu.obs import (
+    HealthError,
     configure_from_config,
     configure_monitor_from_config,
     get_monitor,
@@ -64,6 +71,149 @@ from flexflow_tpu.tensor import Layer, Tensor
 # epoch-end verbose print observe loss within a bounded, human-scale
 # window (docs/OBSERVABILITY.md, "Sync points")
 DEFAULT_METRICS_SYNC_EVERY = 32
+
+# checkpoint schema id, recorded in the manifest.  ffckpt/1 is the
+# PR-5 manifest-less format (still loadable, no digest check);
+# ffckpt/2 adds the manifest: step, rng seed, dataloader cursor,
+# strategy identity, and a content digest (docs/RESILIENCE.md)
+CHECKPOINT_SCHEMA = "ffckpt/2"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint file that must not be loaded: torn/truncated write,
+    unreadable manifest, or content-digest mismatch.  The message names
+    what failed — resume code catches this and falls back to the
+    previous complete checkpoint."""
+
+
+def _checkpoint_digest(flat: Dict[str, np.ndarray]) -> str:
+    """Content digest over the payload arrays (key order normalized,
+    dtype/shape included so a reinterpreted buffer also fails)."""
+    h = hashlib.sha256()
+    for key in sorted(flat):
+        arr = np.ascontiguousarray(flat[key])
+        h.update(key.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return f"sha256:{h.hexdigest()}"
+
+
+def _write_checkpoint_atomic(
+    path: str, flat: Dict[str, np.ndarray], manifest: Dict[str, Any],
+) -> str:
+    """Atomic checkpoint write: temp file in the target directory +
+    flush + fsync + ``os.replace``.  A reader (or a resumed run) either
+    sees the previous complete checkpoint or the new complete one —
+    never a torn file, no matter where a SIGKILL lands
+    (``tests/test_resilience.py`` kill-torture pins this).
+
+    The manifest (with the content digest over every payload array)
+    rides inside the archive as ``meta/manifest`` so the file stays a
+    single self-describing ``.npz``.  Returns the path written —
+    ``.npz`` is appended when missing, matching what ``np.savez`` does
+    with a str path (writing through a file object skips that, so we
+    replicate it for back-compat with ffckpt/1 call sites)."""
+    if not path.endswith(".npz"):
+        path += ".npz"
+    manifest = dict(manifest)
+    manifest["digest"] = _checkpoint_digest(flat)
+    payload = dict(flat)
+    payload["meta/manifest"] = np.frombuffer(
+        json.dumps(manifest).encode(), dtype=np.uint8
+    )
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    # fsync the directory so the rename itself survives a power cut
+    # (best-effort: not all filesystems allow O_RDONLY dir fds)
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+    return path
+
+
+class _CheckpointWriter:
+    """One background thread writing checkpoints off the step path
+    (``--checkpoint-every K``): fit hands over the host snapshot and
+    keeps stepping while the npz serialize + fsync happen here.  Queue
+    depth 1 — if the previous write is still in flight the handoff
+    blocks, which is the honest backpressure (checkpointing faster than
+    the disk can fsync would otherwise queue unbounded host copies)."""
+
+    def __init__(self) -> None:
+        self._q: "queue.Queue[Optional[Tuple[str, Dict[str, np.ndarray], Dict[str, Any]]]]" = (
+            queue.Queue(maxsize=1)
+        )
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._loop, name="ffckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                path, flat, manifest = item
+                _write_checkpoint_atomic(path, flat, manifest)
+            except BaseException as e:  # surfaced at the next flush/put
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def _raise_pending(self) -> None:
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise RuntimeError(
+                f"background checkpoint write failed: {err}"
+            ) from err
+
+    def put(
+        self, path: str, flat: Dict[str, np.ndarray],
+        manifest: Dict[str, Any],
+    ) -> None:
+        self._raise_pending()
+        self._q.put((path, flat, manifest))
+
+    def flush(self) -> None:
+        """Block until every queued write hit disk; re-raise a failure."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        """Happy-path close: drain, stop the thread, raise on failure."""
+        self._q.join()
+        self._q.put(None)
+        self._thread.join()
+        self._raise_pending()
+
+    def shutdown(self) -> None:
+        """No-raise close for ``finally`` blocks — a writer error must
+        not mask the in-flight exception that got us here."""
+        try:
+            self._q.join()
+            self._q.put(None)
+            self._thread.join()
+        except BaseException:
+            pass
 
 
 def _load_substitution_xfers(cfg: FFConfig):
@@ -94,6 +244,11 @@ class FFModel:
         # ... and the run-health monitor (--metrics-out / --health);
         # same contract: an off config leaves the current monitor alone
         configure_monitor_from_config(self.config)
+        # ... and the deterministic fault plan (--fault-plan,
+        # docs/RESILIENCE.md); an unset flag leaves the current plan alone
+        from flexflow_tpu.runtime.faults import configure_faults_from_config
+
+        configure_faults_from_config(self.config)
         # persistent compilation cache (--compile-cache-dir): must be
         # enabled before the first jit dispatch so every compile of this
         # run is cacheable (docs/OBSERVABILITY.md)
@@ -110,6 +265,8 @@ class FFModel:
             self.config.coordinator_address,
             self.config.num_nodes_cli,
             self.config.node_id,
+            retries=self.config.coordinator_retries,
+            backoff_s=self.config.coordinator_backoff_s,
         )
         self.layers: List[Layer] = []
         self.graph_inputs: List[Tensor] = []
@@ -118,6 +275,10 @@ class FFModel:
         self.strategy: Optional[Strategy] = None
         self.label_tensor: Optional[Tensor] = None
         self._optimizer: Optional[Optimizer] = None
+        # dataloader position of the most recent fit() step — what the
+        # checkpoint manifest records so resume replays the exact batch
+        # stream (docs/RESILIENCE.md, "Exact resume")
+        self._fit_cursor: Optional[Dict[str, Any]] = None
 
     # ------------------------------------------------------------------ util
     def _name(self, base: str, name: Optional[str]) -> str:
@@ -1172,6 +1333,10 @@ class FFModel:
         seed: int = 0,
         recompile_state: Optional["RecompileState"] = None,
         metrics_sync_every: Optional[int] = None,
+        resume: Optional[str] = None,
+        checkpoint_every: Optional[int] = None,
+        checkpoint_path: Optional[str] = None,
+        recovery: Optional["RecoveryPolicy"] = None,
     ) -> PerfMetrics:
         """Canonical training loop (reference ``FFModel.fit``,
         ``flexflow_cffi.py:2062-2104``).  Each iteration is one cached jit
@@ -1193,9 +1358,35 @@ class FFModel:
         K=1 restores the fully synchronous per-step ``float()`` path).
         The R17 recompile trigger is evaluated under the same window —
         it fires within K steps of its condition becoming true
-        (``RecompileState.observe_window``)."""
+        (``RecompileState.observe_window``).
+
+        Resilience (docs/RESILIENCE.md): ``resume=path`` restores a
+        :meth:`save_checkpoint` file INCLUDING its manifest cursor —
+        step count, per-step rng stream, and dataloader epoch/batch
+        position — so a killed-and-resumed run is bit-identical to the
+        uninterrupted one.  ``checkpoint_every=K`` snapshots every K
+        optimizer steps to ``checkpoint_path`` on a background writer
+        thread (the snapshot itself is the one counted host sync; the
+        npz serialize + fsync happen off the step path).  ``recovery``
+        (a :class:`~flexflow_tpu.runtime.recompile.RecoveryPolicy`)
+        catches device-loss ``RuntimeError``s, shrinks the machine
+        model, re-searches, restores, and continues; ``--health
+        restore`` rewinds anomalies to the last good checkpoint and
+        skips the poison batch (capped by ``--max-restores``)."""
         assert self.executor is not None, "call compile() first"
         cfg = self.config
+        if resume is None:
+            resume = cfg.resume_from or None
+        ckpt_every = (
+            checkpoint_every if checkpoint_every is not None
+            else cfg.checkpoint_every
+        )
+        ckpt_path = (
+            checkpoint_path if checkpoint_path is not None
+            else cfg.checkpoint_path
+        )
+        if ckpt_path and not ckpt_path.endswith(".npz"):
+            ckpt_path = ckpt_path + ".npz"  # match save_checkpoint/np.savez
         bs = batch_size or cfg.batch_size
         epochs = epochs or cfg.epochs
         xs = list(x) if isinstance(x, (list, tuple)) else [x]
@@ -1227,19 +1418,91 @@ class FFModel:
         profiling = cfg.profiling and jax.process_index() == 0
         K = self._resolve_metrics_sync_every(metrics_sync_every)
         nb = it.num_batches
+
+        # --- resume: restore weights/opt/step AND position -------------
+        start_epoch, skip_batches = 0, 0
+        if resume:
+            manifest = self.load_checkpoint(resume)
+            cursor = (manifest or {}).get("loader")
+            if cursor:
+                if (bool(cursor.get("shuffle", False)) != bool(shuffle)
+                        or int(cursor.get("seed", 0)) != int(seed)):
+                    raise CheckpointError(
+                        f"resume {resume!r}: checkpoint was written with "
+                        f"shuffle={cursor.get('shuffle')}/"
+                        f"seed={cursor.get('seed')} but fit was called "
+                        f"with shuffle={shuffle}/seed={seed} — the data "
+                        "order would diverge; pass the original values"
+                    )
+                if int(cursor.get("batches", nb)) != nb:
+                    raise CheckpointError(
+                        f"resume {resume!r}: checkpoint saw "
+                        f"{cursor.get('batches')} batches/epoch, this "
+                        f"fit has {nb} — dataset or batch size changed; "
+                        "the saved cursor does not map onto this run"
+                    )
+                start_epoch = int(cursor.get("epoch", 0))
+                skip_batches = int(cursor.get("batch", 0))
+                if skip_batches >= nb:  # killed exactly at an epoch edge
+                    start_epoch, skip_batches = start_epoch + 1, 0
+            # replay the loader's epoch permutations: each reset()
+            # advances the SAME persistent rng the original run used,
+            # so epoch start_epoch shuffles identically (the loop below
+            # contributes the one remaining reset)
+            for _ in range(start_epoch):
+                it.reset()
+
+        last_ckpt: Optional[str] = resume or None
+        writer = (
+            _CheckpointWriter()
+            if (ckpt_every and ckpt_every > 0 and ckpt_path) else None
+        )
         # place_fn resolves self.executor LATE so a mid-epoch recompile
-        # (R17) swaps the placement target along with the step program
+        # (R17) or an elastic recovery swaps the placement target along
+        # with the step program
         prefetch = DevicePrefetcher(
             it, lambda b: self.executor.place_batch(b), depth=depth
         )
+        ok = False
+        try:
+            pm = self._fit_loop(
+                prefetch=prefetch, it=it, epochs=epochs, nb=nb, bs=bs,
+                K=K, tracer=tracer, profiling=profiling, verbose=verbose,
+                shuffle=shuffle, seed=seed, recompile_state=recompile_state,
+                start_epoch=start_epoch, skip_batches=skip_batches,
+                writer=writer, ckpt_every=ckpt_every, ckpt_path=ckpt_path,
+                last_ckpt=last_ckpt, recovery=recovery, depth=depth,
+            )
+            ok = True
+        finally:
+            if writer is not None:
+                if ok:
+                    writer.close()  # drain + surface a failed write
+                else:
+                    writer.shutdown()  # never mask the in-flight error
+        if jax.process_index() == 0:
+            tracer.save()  # no-op without --trace-out
+        get_monitor().flush()  # fsync the metrics stream (no-op when off)
+        return pm  # the FINAL epoch's metrics (reference parity)
+
+    def _fit_loop(
+        self, *, prefetch, it, epochs, nb, bs, K, tracer, profiling,
+        verbose, shuffle, seed, recompile_state, start_epoch,
+        skip_batches, writer, ckpt_every, ckpt_path, last_ckpt,
+        recovery, depth,
+    ) -> PerfMetrics:
+        """The epoch/batch loop body of :meth:`fit`, factored out so the
+        checkpoint-writer lifecycle wraps it cleanly."""
+        cfg = self.config
         pm = PerfMetrics()
         loss = None
+        restores = 0
         with tracer.span(
             "fit", cat="fit", epochs=epochs, batches=nb, metrics_sync_every=K
         ):
             if tracer.enabled:
                 tracer.sample("fit.prefetch_depth", float(depth), level="step")
-            for epoch in range(epochs):
+            for epoch in range(start_epoch, epochs):
                 it.reset()
                 # per-EPOCH accumulation, like the reference's reset_metrics()
                 # at each epoch start (flexflow_cffi.py fit / base_model._train)
@@ -1248,8 +1511,73 @@ class FFModel:
                 window: List[Any] = []  # raw device (loss, metrics) for R17
                 with tracer.span("epoch", cat="fit", epoch=epoch):
                     for bi, (inputs, labels) in enumerate(prefetch):
-                        with tracer.span("batch", cat="fit", level="op", batch=bi):
-                            loss, m = self.executor.train_step(inputs, labels)
+                        if epoch == start_epoch and bi < skip_batches:
+                            # resume replay: the original run consumed
+                            # this batch before the kill — advance the
+                            # loader past it without training
+                            continue
+                        try:
+                            with tracer.span(
+                                "batch", cat="fit", level="op", batch=bi
+                            ):
+                                loss, m = self.executor.train_step(
+                                    inputs, labels
+                                )
+                        except HealthError:
+                            # --health restore: rewind to the last good
+                            # checkpoint and SKIP the poison batch
+                            # (docs/RESILIENCE.md, "Restore policy")
+                            if (cfg.health == "restore"
+                                    and last_ckpt is not None
+                                    and os.path.exists(last_ckpt)
+                                    and restores < cfg.max_restores):
+                                if writer is not None:
+                                    writer.flush()
+                                self.load_checkpoint(last_ckpt)
+                                restores += 1
+                                tracer.counter("health.restores")
+                                if tracer.enabled:
+                                    tracer.instant(
+                                        "health_restore", cat="health",
+                                        checkpoint=last_ckpt, batch=bi,
+                                        restores=restores,
+                                    )
+                                continue
+                            raise
+                        except RuntimeError as e:
+                            # elastic recovery: a matching device-loss
+                            # error shrinks the machine model,
+                            # re-searches, restores, and continues
+                            if recovery is not None and recovery.matches(e):
+                                if writer is not None:
+                                    writer.flush()
+                                recovery.recover(
+                                    self, e, checkpoint=last_ckpt
+                                )
+                                continue
+                            raise
+                        # position AFTER this step: the manifest cursor a
+                        # checkpoint written now embeds, so resume knows
+                        # exactly which batch comes next
+                        self._fit_cursor = {
+                            "epoch": epoch, "batch": bi + 1,
+                            "shuffle": bool(shuffle), "seed": int(seed),
+                            "batches": nb,
+                        }
+                        if (writer is not None
+                                and self.executor._step_count % ckpt_every
+                                == 0):
+                            # the host snapshot is the checkpoint's ONE
+                            # device sync — counted truthfully; the npz
+                            # serialize + fsync run on the writer thread
+                            t0 = time.perf_counter()
+                            flat, manifest = self._snapshot_checkpoint()
+                            self.executor.count_host_sync(
+                                1, stall_s=time.perf_counter() - t0
+                            )
+                            writer.put(ckpt_path, flat, manifest)
+                            last_ckpt = ckpt_path
+                            tracer.counter("fit.checkpoints")
                         # reference --profiling per-iteration ELAPSED prints
                         # (model.cc:3650-3653): per-step wall split
                         if profiling and self.executor.last_step_stats:
@@ -1287,7 +1615,7 @@ class FFModel:
                             if recompile_state is not None and window:
                                 recompile_state.observe_window(window, self)
                                 window = []
-                if verbose:
+                if verbose and loss is not None:
                     # the flush already forced the epoch's last step to
                     # completion, so this float() reads a ready scalar
                     print(
@@ -1295,10 +1623,7 @@ class FFModel:
                         f"accuracy={pm.accuracy:.4f} "
                         f"throughput={pm.throughput():.2f} samples/s"
                     )
-        if jax.process_index() == 0:
-            tracer.save()  # no-op without --trace-out
-        get_monitor().flush()  # fsync the metrics stream (no-op when off)
-        return pm  # the FINAL epoch's metrics (reference parity)
+        return pm  # the FINAL epoch's metrics
 
     def eval(
         self,
@@ -1459,15 +1784,14 @@ class FFModel:
         return np.asarray(x)
 
     # ----------------------------------------------- checkpoint / resume
-    def save_checkpoint(self, path: str) -> None:
-        """Full training checkpoint: params + stateful weights (BN stats)
-        + optimizer state + step count, one ``.npz``.
-
-        Exceeds the reference, which checkpoints weights only via tensor
-        attach (``parallel_tensor.h:164-169``; SURVEY §5: "No
-        optimizer-state checkpointing") — resuming there silently resets
-        Adam moments.  Multi-host callers should write from process 0.
-        """
+    def _snapshot_checkpoint(
+        self,
+    ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        """Host snapshot of the full training state (the ONE device
+        sync of a checkpoint — callers count it) plus the ffckpt/2
+        manifest: schema id, step, rng seed, dataloader cursor, and the
+        strategy identity, so resume can restore *position*, not just
+        weights (docs/RESILIENCE.md, "Manifest schema")."""
         assert self.executor is not None, "call compile() first"
         ex = self.executor
         flat: Dict[str, np.ndarray] = {}
@@ -1484,40 +1808,112 @@ class FFModel:
                 for wname, arr in ws.items():
                     flat[f"{prefix}/{lname}/{wname}"] = arr
 
+        put("params", ex.params)
+        put("state", ex.state)
+        for key, val in ex.opt_state.items():
+            if isinstance(val, dict):
+                put(f"opt/{key}", val)
+            else:
+                flat[f"opt_scalar/{key}"] = np.asarray(val)
+        flat["meta/step_count"] = np.asarray(ex._step_count)
+        strat = self.strategy
+        manifest: Dict[str, Any] = {
+            "schema": CHECKPOINT_SCHEMA,
+            "step": int(ex._step_count),
+            "rng_seed": int(ex.seed),
+            "strategy": {
+                "mesh_shape": list(strat.mesh.shape),
+                "axis_names": list(strat.mesh.axis_names),
+                "pipeline": (
+                    strat.pipeline.stages
+                    if getattr(strat, "pipeline", None) is not None
+                    else None
+                ),
+            } if strat is not None else None,
+            "loader": (
+                dict(self._fit_cursor) if self._fit_cursor else None
+            ),
+        }
+        return flat, manifest
+
+    def save_checkpoint(self, path: str) -> str:
+        """Full training checkpoint: params + stateful weights (BN stats)
+        + optimizer state + step count + the ffckpt/2 manifest, one
+        ``.npz`` written ATOMICALLY (temp + fsync + ``os.replace``) with
+        an embedded content digest — a reader never observes a torn
+        file, and :meth:`load_checkpoint` refuses a corrupt one.
+
+        Exceeds the reference, which checkpoints weights only via tensor
+        attach (``parallel_tensor.h:164-169``; SURVEY §5: "No
+        optimizer-state checkpointing") — resuming there silently resets
+        Adam moments.  Multi-host callers should write from process 0.
+        Returns the path actually written (``.npz`` appended when
+        missing, matching ``np.savez``).
+        """
         tracer = get_tracer()
         with tracer.span("checkpoint_save", cat="io", path=path):
-            put("params", ex.params)
-            put("state", ex.state)
-            for key, val in ex.opt_state.items():
-                if isinstance(val, dict):
-                    put(f"opt/{key}", val)
-                else:
-                    flat[f"opt_scalar/{key}"] = np.asarray(val)
-            flat["meta/step_count"] = np.asarray(ex._step_count)
-            np.savez(path, **flat)
+            flat, manifest = self._snapshot_checkpoint()
+            out = _write_checkpoint_atomic(path, flat, manifest)
         tracer.counter(
             "checkpoint.bytes_written",
             float(sum(a.nbytes for a in flat.values())),
         )
+        return out
 
-    def load_checkpoint(self, path: str) -> None:
+    def load_checkpoint(self, path: str) -> Optional[Dict[str, Any]]:
         """Restore a :meth:`save_checkpoint` file into the compiled model
         (weights re-placed with their current sharding — a checkpoint
-        written under one strategy loads under any other)."""
+        written under one strategy loads under any other).  Returns the
+        embedded manifest (None for legacy ffckpt/1 files, which carry
+        neither manifest nor digest).
+
+        REFUSES bad files with :class:`CheckpointError` naming what
+        failed: a torn/truncated archive (unreadable zip), an unreadable
+        manifest, or a content-digest mismatch.  Nothing is written into
+        the executor until the whole file has been read and verified."""
         assert self.executor is not None, "call compile() first"
         ex = self.executor
-        with get_tracer().span("checkpoint_load", cat="io", path=path), \
-                np.load(path) as z:
+        with get_tracer().span("checkpoint_load", cat="io", path=path):
+            try:
+                with np.load(path) as z:
+                    flat = {key: np.asarray(z[key]) for key in z.files}
+            except (zipfile.BadZipFile, ValueError, OSError, EOFError) as e:
+                raise CheckpointError(
+                    f"checkpoint {path!r} is torn or truncated — the "
+                    f"archive is unreadable ({type(e).__name__}: {e}); "
+                    "refusing to load. Recover from the previous "
+                    "complete checkpoint."
+                ) from e
+            manifest: Optional[Dict[str, Any]] = None
+            raw = flat.pop("meta/manifest", None)
+            if raw is not None:
+                try:
+                    manifest = json.loads(raw.tobytes().decode())
+                except (UnicodeDecodeError, ValueError) as e:
+                    raise CheckpointError(
+                        f"checkpoint {path!r} has an unreadable manifest "
+                        f"({e}); refusing to load"
+                    ) from e
+                want = manifest.get("digest")
+                got = _checkpoint_digest(flat)
+                if want != got:
+                    raise CheckpointError(
+                        f"checkpoint {path!r} failed its content-digest "
+                        f"check: manifest records {want}, the file hashes "
+                        f"to {got} — the payload was corrupted after "
+                        "writing; refusing to load"
+                    )
             weights: Dict[str, Dict[str, np.ndarray]] = {}
             opt: Dict[str, Dict[str, Dict[str, np.ndarray]]] = {}
-            for key in z.files:
+            step_count = None
+            for key, arr in flat.items():
                 # layer names may themselves contain '/', so parse as
                 # prefix[/okey]/<lname...>/wname with wname = last segment
                 # (weight names are framework-defined, never contain '/')
                 prefix, rest = key.split("/", 1)
-                arr = z[key]
                 if prefix == "meta":
-                    ex._step_count = int(arr)
+                    if rest == "step_count":
+                        step_count = int(arr)
                 elif prefix == "opt_scalar":
                     ex.opt_state[rest] = jax.device_put(arr)
                 elif prefix == "opt":
@@ -1527,6 +1923,8 @@ class FFModel:
                 else:  # params / state
                     lname, wname = rest.rsplit("/", 1)
                     weights.setdefault(lname, {})[wname] = arr
+            if step_count is not None:
+                ex._step_count = step_count
             # batch the writes: the per-layer entries route into whatever
             # layout the live executor uses (members of scan-stacked
             # chains land in their depth slice, each full bucket written
@@ -1534,6 +1932,7 @@ class FFModel:
             self.set_weights(weights)
             for okey, entries in opt.items():
                 ex.assign_opt_entries(okey, entries)
+        return manifest
 
     @property
     def num_parameters(self) -> int:

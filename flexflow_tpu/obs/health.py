@@ -17,11 +17,14 @@ Detectors (active when ``--health`` is not ``off``):
     warmup of finite observations (EMA over finite losses only, so one
     NaN doesn't poison the baseline)
 
-Policies (``--health off|warn|dump|raise``):
-  * ``warn``  — print one warning line + a tracer instant event
-  * ``dump``  — warn + write the debug bundle (at most ONE per run; a
+Policies (``--health off|warn|dump|raise|restore``):
+  * ``warn``    — print one warning line + a tracer instant event
+  * ``dump``    — warn + write the debug bundle (at most ONE per run; a
     diverged run would otherwise dump every subsequent step)
-  * ``raise`` — dump + raise :class:`HealthError` out of ``train_step``
+  * ``raise``   — dump + raise :class:`HealthError` out of ``train_step``
+  * ``restore`` — dump + raise, but ``fit`` catches the error, rewinds
+    to the last good checkpoint, and skips the poison batch — capped by
+    ``--max-restores`` (docs/RESILIENCE.md)
 
 Like the tracer, ONE process-wide monitor (``get_monitor()``); the
 executor's untraced fast path checks a single ``enabled`` attribute, so
@@ -46,7 +49,7 @@ from flexflow_tpu.obs.metrics import (
 )
 from flexflow_tpu.obs.trace import get_tracer
 
-HEALTH_POLICIES = ("off", "warn", "dump", "raise")
+HEALTH_POLICIES = ("off", "warn", "dump", "raise", "restore")
 DRIFT_POLICIES = ("off", "warn", "dump")
 
 
@@ -328,9 +331,13 @@ class HealthMonitor:
             flush=True,
         )
         path = None
-        if self.policy in ("dump", "raise"):
+        if self.policy in ("dump", "raise", "restore"):
             path = self.dump_bundle(reason, rec)
-        if self.policy == "raise":
+        if self.policy in ("raise", "restore"):
+            # "restore" raises the same HealthError — fit's restore
+            # handler catches it, rewinds to the last good checkpoint,
+            # and skips the poison batch (docs/RESILIENCE.md); without
+            # a checkpoint in reach it degrades to "raise"
             raise HealthError(reason, step, path or self.bundle_path)
         return reason
 

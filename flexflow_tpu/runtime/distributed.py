@@ -22,11 +22,30 @@ server, so ``initialize_distributed()`` with no args is correct there.
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional, Sequence
 
 import jax
 
 _initialized = False
+
+# coordinator-connect failures worth retrying: the coordinator hasn't
+# bound its port yet (rolling restart), or the connection raced a
+# network blip.  Anything else (bad address, protocol mismatch) fails
+# the same way on every attempt — retrying it only hides the error.
+_TRANSIENT_CONNECT_MARKERS = (
+    "deadline exceeded",
+    "unavailable",
+    "connection refused",
+    "connection reset",
+    "timed out",
+    "failed to connect",
+)
+
+
+def _is_transient_connect_error(err: BaseException) -> bool:
+    msg = str(err).lower()
+    return any(mark in msg for mark in _TRANSIENT_CONNECT_MARKERS)
 
 
 def initialize_distributed(
@@ -34,6 +53,8 @@ def initialize_distributed(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     local_device_ids: Optional[Sequence[int]] = None,
+    retries: int = 0,
+    backoff_s: float = 1.0,
 ) -> None:
     """Start the multi-controller runtime.  Idempotent; a no-op for
     single-process runs (nothing configured and no env vars set).
@@ -42,6 +63,14 @@ def initialize_distributed(
     GASNet bootstrap (``src/runtime/cpp_driver.cc:26-46`` under mpirun);
     here every process runs the same program and jax stitches them into
     one logical device world.
+
+    ``retries``/``backoff_s`` (``--coordinator-retries`` /
+    ``--coordinator-backoff-s``): in a rolling restart the coordinator
+    process routinely comes up AFTER its workers, so a transient
+    connect failure gets up to ``retries`` more attempts with
+    exponential backoff (``backoff_s * 2**attempt``).  Non-transient
+    errors raise immediately; exhausting the budget raises one
+    ``RuntimeError`` listing every attempt's failure.
     """
     global _initialized
     if _initialized:
@@ -103,10 +132,27 @@ def initialize_distributed(
                     "--coordinator-address/--num-nodes/--node-id explicitly."
                 )
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids,
+    attempts = []
+    for attempt in range(max(0, retries) + 1):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids,
+            )
+            _initialized = True
+            return
+        except RuntimeError as e:
+            if not _is_transient_connect_error(e):
+                raise
+            attempts.append(f"attempt {attempt + 1}: {e}")
+            if attempt >= retries:
+                break
+            time.sleep(backoff_s * (2 ** attempt))
+    raise RuntimeError(
+        f"could not connect to coordinator {coordinator_address!r} after "
+        f"{len(attempts)} attempt(s) "
+        f"(--coordinator-retries {retries}, base backoff {backoff_s}s):\n  "
+        + "\n  ".join(attempts)
     )
-    _initialized = True

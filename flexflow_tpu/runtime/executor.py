@@ -49,6 +49,7 @@ from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
 from flexflow_tpu.optimizer import Optimizer
 from flexflow_tpu.parallel.spec import TensorSharding
 from flexflow_tpu.parallel.strategy import Strategy
+from flexflow_tpu.runtime.faults import get_fault_plan
 from flexflow_tpu.tensor import Layer, Tensor
 
 
@@ -1296,6 +1297,12 @@ class Executor:
             print(report.format_human())
 
     def train_step(self, inputs: Sequence[Any], labels: Any) -> Tuple[float, Dict[str, float]]:
+        # fault-injection hook (--fault-plan, docs/RESILIENCE.md): one
+        # call + None check when no plan is installed — the same cost
+        # class as the get_monitor() probe below, ledger-pinned
+        plan = get_fault_plan()
+        if plan is not None:
+            plan.on_train_step(self)
         tracer = get_tracer()
         if not (tracer.enabled or self.profiling or get_monitor().enabled):
             # fast path — no clock reads, no forced device sync (async

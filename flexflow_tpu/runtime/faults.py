@@ -1,0 +1,280 @@
+"""Deterministic fault injection — the resilience layer's test substrate.
+
+Production reality at scale is that preemptions, device losses, and
+loader hiccups are the steady state (ROADMAP #2); a recovery path that
+only runs when real hardware dies is a recovery path that never runs in
+CI.  This module makes faults a *first-class, seeded, replayable input*:
+a :class:`FaultPlan` (``--fault-plan``) names exactly which fault fires
+at which step, and the executor/serve-engine hook points inject it at
+the same place a real failure would surface.
+
+Spec grammar (comma-separated events)::
+
+    [site:]kind@step[:arg]
+    [site:]kind@~lo-hi[:arg]     # step drawn from [lo, hi] by the seed
+
+``site`` is ``fit`` (default — keyed on ``Executor._step_count``) or
+``serve`` (keyed on ``ServeEngine.windows``).  Kinds:
+
+  * ``device_loss``  — raise :class:`InjectedFault` (a ``RuntimeError``,
+    like XLA's real device-loss errors) out of the step/window.
+  * ``loader_stall`` — sleep ``arg`` seconds (default 0.05) on the host,
+    simulating an input-pipeline stall.
+  * ``nan_grads``    — poison one parameter leaf with NaN on device (an
+    async device op — no host sync), so the NEXT step's loss/grads go
+    non-finite and the HealthMonitor detectors fire.  Fit-site only.
+  * ``sigterm``      — ``os.kill(os.getpid(), SIGTERM)``: exercises the
+    serve drain handler / an external supervisor, for real.
+  * ``dcn_degrade``  — set ``dcn_degraded`` on the target and sleep
+    ``arg`` seconds, simulating a slow cross-slice link.
+
+The random form (``kind@~lo-hi``) resolves at PARSE time from the plan
+seed, so the same ``(spec, seed)`` always yields the same event steps —
+"deterministic" means a failing torture run replays exactly.
+
+Zero-overhead contract (ledger-pinned, like the disabled tracer and
+monitor): when no plan is installed the hook is one module-level call
+returning ``None`` plus one ``is None`` check — no clock reads, no
+device syncs, no allocation.  ``tests/test_resilience.py`` pins the
+``host_syncs`` ledger byte-identical with faults off.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import time
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = [
+    "FAULT_KINDS",
+    "FAULT_SITES",
+    "FaultEvent",
+    "FaultPlan",
+    "InjectedFault",
+    "configure_faults_from_config",
+    "get_fault_plan",
+    "set_fault_plan",
+]
+
+FAULT_KINDS = (
+    "device_loss", "loader_stall", "nan_grads", "sigterm", "dcn_degrade",
+)
+FAULT_SITES = ("fit", "serve")
+
+
+class InjectedFault(RuntimeError):
+    """An injected failure, raised where the real one would surface.
+    Subclasses ``RuntimeError`` because that is what XLA's device-loss /
+    transfer errors are — recovery code that handles this handles those."""
+
+    def __init__(self, kind: str, step: int, site: str):
+        self.kind = kind
+        self.step = step
+        self.site = site
+        super().__init__(
+            f"injected fault {kind!r} at {site} step {step} (--fault-plan)"
+        )
+
+
+@dataclasses.dataclass
+class FaultEvent:
+    """One scheduled fault.  ``fired`` latches so an event injects
+    exactly once even when the run rewinds past its step (a restored
+    checkpoint replays step N without replaying the fault — otherwise a
+    recovery loop would re-kill itself forever)."""
+
+    kind: str
+    step: int
+    site: str = "fit"
+    arg: float = 0.0
+    fired: bool = False
+
+    def __post_init__(self) -> None:
+        assert self.kind in FAULT_KINDS, (
+            f"unknown fault kind {self.kind!r}; kinds: {FAULT_KINDS}"
+        )
+        assert self.site in FAULT_SITES, (
+            f"unknown fault site {self.site!r}; sites: {FAULT_SITES}"
+        )
+        assert self.step >= 0, f"fault step must be >= 0, got {self.step}"
+        if self.kind == "nan_grads" and self.site != "fit":
+            raise ValueError(
+                "nan_grads faults only apply at the fit site "
+                "(serving has no gradients)"
+            )
+
+
+class FaultPlan:
+    """A seeded, ordered set of :class:`FaultEvent`s plus the two hook
+    entry points the runtime calls.  ``identity`` round-trips into bench
+    records so ``tools/bench_compare.py`` can refuse to compare runs
+    tortured differently."""
+
+    def __init__(
+        self, events: List[FaultEvent], seed: int = 0, spec: str = "",
+    ) -> None:
+        self.events = list(events)
+        self.seed = int(seed)
+        self.spec = spec
+
+    # --- construction ------------------------------------------------------
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0) -> "FaultPlan":
+        """Parse the ``[site:]kind@step[:arg]`` grammar (module doc).
+        ``@~lo-hi`` steps are drawn here, from ``seed`` — parse twice
+        with the same seed, get the same plan."""
+        rng = np.random.default_rng(seed)
+        events: List[FaultEvent] = []
+        for raw in spec.split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            site = "fit"
+            body = raw
+            head, sep, tail = raw.partition(":")
+            if sep and head in FAULT_SITES:
+                site, body = head, tail
+            kind, sep, rest = body.partition("@")
+            if not sep:
+                raise ValueError(
+                    f"fault event {raw!r} lacks '@step' "
+                    "(grammar: [site:]kind@step[:arg])"
+                )
+            step_s, _, arg_s = rest.partition(":")
+            if step_s.startswith("~"):
+                lo, _, hi = step_s[1:].partition("-")
+                lo_i, hi_i = int(lo), int(hi or lo)
+                step = int(rng.integers(lo_i, hi_i + 1))
+            else:
+                step = int(step_s)
+            events.append(FaultEvent(
+                kind=kind, step=step, site=site,
+                arg=float(arg_s) if arg_s else 0.0,
+            ))
+        events.sort(key=lambda e: (e.site, e.step))
+        return cls(events, seed=seed, spec=spec)
+
+    @classmethod
+    def from_file(cls, path: str) -> "FaultPlan":
+        """Load a JSON plan: ``{"seed": 0, "events": [{"kind": ...,
+        "step": ..., "site": ..., "arg": ...}, ...]}`` or
+        ``{"seed": 0, "spec": "..."}`` (the CLI grammar in a file)."""
+        with open(path) as f:
+            doc = json.load(f)
+        seed = int(doc.get("seed", 0))
+        if "spec" in doc:
+            return cls.parse(doc["spec"], seed=seed)
+        events = [
+            FaultEvent(
+                kind=e["kind"], step=int(e["step"]),
+                site=e.get("site", "fit"), arg=float(e.get("arg", 0.0)),
+            )
+            for e in doc.get("events", ())
+        ]
+        events.sort(key=lambda e: (e.site, e.step))
+        return cls(events, seed=seed, spec=f"file:{path}")
+
+    @property
+    def identity(self) -> str:
+        """Stable description for bench/metrics metadata (comparable
+        metadata in ``tools/bench_compare.py``, like ``serve_traffic``)."""
+        ev = ";".join(
+            f"{e.site}:{e.kind}@{e.step}" + (f":{e.arg:g}" if e.arg else "")
+            for e in self.events
+        )
+        return f"seed{self.seed}[{ev}]"
+
+    def _due(self, site: str, step: int) -> Optional[FaultEvent]:
+        for e in self.events:
+            if e.site == site and not e.fired and e.step <= step:
+                e.fired = True
+                return e
+        return None
+
+    # --- hook entry points -------------------------------------------------
+    def on_train_step(self, ex) -> None:
+        """Called at the TOP of ``Executor.train_step`` (before the
+        fast/instrumented branch), keyed on ``ex._step_count`` — a
+        ``device_loss`` at step N dies before step N commits, exactly
+        like a real loss mid-dispatch."""
+        ev = self._due("fit", ex._step_count)
+        if ev is None:
+            return
+        self._inject(ev, ex)
+
+    def on_serve_window(self, engine) -> None:
+        """Called at the top of ``ServeEngine._window``, keyed on
+        ``engine.windows``."""
+        ev = self._due("serve", engine.windows)
+        if ev is None:
+            return
+        self._inject(ev, engine)
+
+    def _inject(self, ev: FaultEvent, target) -> None:
+        from flexflow_tpu.obs import get_tracer
+
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.instant(
+                "fault_injected", cat="health",
+                kind=ev.kind, step=ev.step, site=ev.site,
+            )
+            tracer.counter("faults.injected")
+        if ev.kind == "device_loss":
+            raise InjectedFault(ev.kind, ev.step, ev.site)
+        if ev.kind == "loader_stall":
+            time.sleep(ev.arg or 0.05)
+            return
+        if ev.kind == "nan_grads":
+            # poison ONE param leaf in place with a device op: the write
+            # dispatches asynchronously (no host sync, ledger untouched)
+            # and the next step's loss/grad norms go non-finite
+            for ws in target.params.values():
+                for wname, arr in ws.items():
+                    ws[wname] = arr * float("nan")
+                    return
+            return
+        if ev.kind == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        if ev.kind == "dcn_degrade":
+            target.dcn_degraded = True
+            if ev.arg:
+                time.sleep(ev.arg)
+            return
+
+
+# --- process-wide singleton (the disabled-tracer pattern) --------------------
+_PLAN: Optional[FaultPlan] = None
+
+
+def get_fault_plan() -> Optional[FaultPlan]:
+    """``None`` when no plan is installed — the hook sites check exactly
+    this, so the faults-off cost is one call + one ``is None``."""
+    return _PLAN
+
+
+def set_fault_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    global _PLAN
+    _PLAN = plan
+    return _PLAN
+
+
+def configure_faults_from_config(cfg) -> Optional[FaultPlan]:
+    """Wire the process plan to ``--fault-plan`` (a spec string or a
+    JSON file path).  An unset flag leaves the current plan alone — the
+    same contract as ``configure_monitor_from_config``, so a test-
+    installed plan survives auxiliary FFModel constructions."""
+    spec = getattr(cfg, "fault_plan", None)
+    if not spec:
+        return _PLAN
+    if os.path.exists(spec):
+        plan = FaultPlan.from_file(spec)
+    else:
+        plan = FaultPlan.parse(spec, seed=getattr(cfg, "rng_seed", 0))
+    return set_fault_plan(plan)

@@ -17,7 +17,126 @@ reference re-running its compile pipeline.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional
+import dataclasses
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+# message substrings that identify a device/slice loss or transport
+# failure worth recovering from (matched case-insensitively against the
+# RuntimeError text; InjectedFault device_loss matches by kind instead)
+_DEVICE_LOSS_MARKERS = (
+    "device lost",
+    "data transfer failed",
+    "unavailable",
+    "failed to connect",
+    "slice health",
+)
+
+
+@dataclasses.dataclass
+class RecoveryPolicy:
+    """Elastic recovery for ``fit`` (docs/RESILIENCE.md): when a training
+    step dies with a device-loss ``RuntimeError`` (real, or injected by
+    ``--fault-plan device_loss@N``), shrink the machine model to the
+    surviving mesh, re-run the strategy search via ``recompile()``, and
+    restore the last checkpoint so the loss continues from the restored
+    step instead of re-initializing.
+
+    ``shrink_axis`` names the mesh axis to halve (the dead slice's
+    axis); None picks the first axis of size > 1 — on the 2-slice
+    machine model that is the DCN axis, i.e. "the other slice died".
+    The data the run consumed between the restored checkpoint and the
+    fault is replayed from the checkpoint's cursor, so recovery rewinds
+    AT MOST ``checkpoint_every`` steps of progress."""
+
+    checkpoint_path: Optional[str] = None
+    max_recoveries: int = 1
+    shrink_axis: Optional[str] = None
+    recoveries: int = 0
+    last_recovery_s: float = 0.0
+
+    def matches(self, err: BaseException) -> bool:
+        """Is this error a recoverable device loss?  InjectedFault
+        carries its kind; real XLA errors are matched by message."""
+        kind = getattr(err, "kind", None)
+        if kind is not None:
+            return kind == "device_loss"
+        msg = str(err).lower()
+        return any(mark in msg for mark in _DEVICE_LOSS_MARKERS)
+
+    def _shrink_mesh(self, mesh) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+        """Halve one axis of the machine model — the surviving topology
+        after a slice/device loss."""
+        shape = list(mesh.shape)
+        names = list(mesh.axis_names)
+        if self.shrink_axis is not None and self.shrink_axis in names:
+            idx = names.index(self.shrink_axis)
+        else:
+            idx = next(
+                (i for i, s in enumerate(shape) if s > 1), None
+            )
+            if idx is None:
+                raise RuntimeError(
+                    f"cannot shrink mesh {tuple(shape)}: no axis has "
+                    "size > 1 — nothing survives the device loss"
+                )
+        if shape[idx] <= 1:
+            raise RuntimeError(
+                f"cannot shrink mesh axis {names[idx]!r}: already size 1"
+            )
+        shape[idx] = shape[idx] // 2
+        return tuple(shape), tuple(names)
+
+    def recover(self, model, err: BaseException, checkpoint=None) -> None:
+        """Shrink → re-search (``recompile()``) → restore → continue.
+        Raises the ORIGINAL error when the recovery budget is spent."""
+        if self.recoveries >= self.max_recoveries:
+            raise RuntimeError(
+                f"recovery budget spent ({self.recoveries}/"
+                f"{self.max_recoveries} used) — re-raising the device "
+                f"loss: {err}"
+            ) from err
+        from flexflow_tpu.obs import get_tracer
+        from flexflow_tpu.parallel.machine import MachineMesh
+
+        tracer = get_tracer()
+        t0 = time.perf_counter()
+        old_mesh = model.strategy.mesh
+        new_shape, names = self._shrink_mesh(old_mesh)
+        ckpt = self.checkpoint_path or checkpoint
+        with tracer.span(
+            "elastic_recovery", cat="health",
+            old_mesh=str(tuple(old_mesh.shape)), new_mesh=str(new_shape),
+            error=str(err)[:200],
+        ):
+            # re-point the retained compile() arguments at the surviving
+            # mesh and drop the dead strategy so unity_search re-resolves
+            # on the shrunken machine model
+            model._compile_call["mesh"] = MachineMesh(new_shape, names)
+            model._compile_call["strategy"] = None
+            if ckpt is not None:
+                # weights come from the checkpoint (complete, verified);
+                # recompile from scratch then restore — no silent re-init
+                model.recompile(preserve_weights=False)
+                model.load_checkpoint(ckpt)
+            else:
+                # no checkpoint yet: carry live weights through the
+                # recompile (best-effort — fine for losses injected
+                # before the device state was actually torn)
+                model.recompile(preserve_weights=True)
+        self.recoveries += 1
+        self.last_recovery_s = time.perf_counter() - t0
+        tracer.counter("health.restores")
+        if tracer.enabled:
+            tracer.sample(
+                "recovery_s", self.last_recovery_s, level="step"
+            )
+            tracer.instant(
+                "elastic_recovered", cat="health",
+                recoveries=self.recoveries,
+                recovery_s=round(self.last_recovery_s, 6),
+                mesh=str(new_shape),
+            )
 
 
 class RecompileState:

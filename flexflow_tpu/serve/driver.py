@@ -20,6 +20,14 @@ sharing traffic) and SLO tiers; ``--serve-prefix-sharing off``,
 allocator and speculative decoding.  The JSON summary then carries
 ``prefix_hit_rate``, ``preemptions``, per-tier TTFT percentiles, and
 the speculative accept rate.
+
+Resilience (docs/RESILIENCE.md): ``--deadline-ms D`` stamps every
+synthetic request with a queue deadline (expired requests are rejected
+truthfully and counted); ``--serve-drain-file F`` + SIGTERM drains
+in-flight work to an ffdrain/1 payload, and ``--resume-drain F``
+re-queues it on the next run; ``--serve-watchdog-s`` /
+``--serve-shed-windows`` arm the window watchdog and batch-tier
+shedding.
 """
 
 from __future__ import annotations
@@ -47,6 +55,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         requests=16, rate=0.0, prompt_len=(4, 12), gen_len=(4, 24),
         hidden=64, heads=4, ff_dim=128, num_layers=2, vocab=256, seq=64,
         traffic_seed=0, tenants=1, shared_prefix=0, interactive_frac=0.0,
+        deadline_ms=0.0, resume_drain=None,
     )
     i = 0
     while i < len(rest):
@@ -85,6 +94,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             opts["shared_prefix"] = int(take())
         elif a == "--interactive-frac":
             opts["interactive_frac"] = float(take())
+        elif a == "--deadline-ms":
+            opts["deadline_ms"] = float(take())
+        elif a == "--resume-drain":
+            opts["resume_drain"] = take()
         elif a in ("-h", "--help"):
             print(__doc__, file=sys.stderr)
             return 0
@@ -119,7 +132,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         prefix_sharing=cfg.serve_prefix_sharing,
         spec_k=cfg.serve_spec_k,
         spec_draft_layers=cfg.serve_spec_draft_layers,
+        watchdog_s=cfg.serve_watchdog_s,
+        shed_after_windows=cfg.serve_shed_windows,
+        slo_ms=cfg.serve_slo_ms,
+        drain_path=cfg.serve_drain_file,
     )
+    if opts["resume_drain"]:
+        from flexflow_tpu.serve.engine import load_drain
+
+        engine.resume_from_drain(load_drain(opts["resume_drain"]))
     spec = TrafficSpec(
         n_requests=opts["requests"], seed=opts["traffic_seed"],
         rate_rps=opts["rate"], prompt_len=opts["prompt_len"],
@@ -135,6 +156,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         r.max_new_tokens = max(
             1, min(r.max_new_tokens, opts["seq"] - r.prompt_len)
         )
+        if opts["deadline_ms"] > 0:
+            r.deadline_ms = opts["deadline_ms"]
     report = engine.run(reqs)
 
     out = {

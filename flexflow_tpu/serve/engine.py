@@ -32,6 +32,7 @@ knob the ServeObjective prices (objective.py).
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -41,6 +42,7 @@ import numpy as np
 from flexflow_tpu.dataloader import DevicePrefetcher
 from flexflow_tpu.models.gpt_decode import GPTSpec, layer_norm, make_cast
 from flexflow_tpu.obs import MetricsStream, get_tracer, step_record
+from flexflow_tpu.runtime.faults import get_fault_plan
 from flexflow_tpu.serve.kvcache import PagedKVCache
 from flexflow_tpu.serve.scheduler import (
     ContinuousBatchingScheduler,
@@ -48,7 +50,106 @@ from flexflow_tpu.serve.scheduler import (
     RequestState,
 )
 
-__all__ = ["ServeEngine", "ServeReport"]
+__all__ = [
+    "ServeEngine",
+    "ServeReport",
+    "load_drain",
+    "save_drain",
+]
+
+# drain payload schema id (docs/RESILIENCE.md): in-flight KV spills +
+# queue contents, written atomically so a killed drain leaves either
+# nothing or a complete payload
+DRAIN_SCHEMA = "ffdrain/1"
+
+
+def save_drain(path: str, payload: Dict[str, Any]) -> str:
+    """Persist a :meth:`ServeEngine.drain` payload as one atomic,
+    digest-checked ``.npz`` (the checkpoint writer's temp + fsync +
+    ``os.replace`` discipline).  Returns the path written."""
+    from flexflow_tpu.model import _write_checkpoint_atomic
+
+    flat: Dict[str, np.ndarray] = {}
+    metas: List[Dict[str, Any]] = []
+    for i, r in enumerate(payload["requests"]):
+        flat[f"r{i}/prompt"] = np.asarray(r["prompt"], np.int32)
+        flat[f"r{i}/tokens"] = np.asarray(r["tokens"], np.int64)
+        kv = r.get("kv_spill")
+        if kv is not None:
+            for lname, d in kv["layers"].items():
+                flat[f"r{i}/kv/{lname}/k"] = np.asarray(d["k"])
+                flat[f"r{i}/kv/{lname}/v"] = np.asarray(d["v"])
+        metas.append({
+            "id": int(r["id"]),
+            "max_new_tokens": int(r["max_new_tokens"]),
+            "eos_id": r.get("eos_id"),
+            "tenant": r.get("tenant", "default"),
+            "tier": r.get("tier", "batch"),
+            "deadline_ms": r.get("deadline_ms"),
+            "preemptions": int(r.get("preemptions", 0)),
+            "kv_length": int(kv["length"]) if kv is not None else None,
+        })
+    return _write_checkpoint_atomic(
+        path, flat, {"schema": DRAIN_SCHEMA, "requests": metas},
+    )
+
+
+def load_drain(path: str) -> Dict[str, Any]:
+    """Read a :func:`save_drain` file back into the in-memory payload
+    shape :meth:`ServeEngine.resume_from_drain` consumes.  Refuses
+    torn/corrupt files with the checkpoint loader's truthful errors."""
+    import zipfile
+
+    from flexflow_tpu.model import CheckpointError, _checkpoint_digest
+
+    try:
+        with np.load(path) as z:
+            flat = {k: np.asarray(z[k]) for k in z.files}
+    except (zipfile.BadZipFile, ValueError, OSError, EOFError) as e:
+        raise CheckpointError(
+            f"drain file {path!r} is torn or truncated "
+            f"({type(e).__name__}: {e}); refusing to load"
+        ) from e
+    raw = flat.pop("meta/manifest", None)
+    if raw is None:
+        raise CheckpointError(
+            f"drain file {path!r} has no manifest — not a "
+            f"{DRAIN_SCHEMA} payload"
+        )
+    manifest = json.loads(raw.tobytes().decode())
+    want, got = manifest.get("digest"), _checkpoint_digest(flat)
+    if want != got:
+        raise CheckpointError(
+            f"drain file {path!r} failed its content-digest check: "
+            f"manifest records {want}, file hashes to {got}; "
+            "refusing to load"
+        )
+    requests: List[Dict[str, Any]] = []
+    for i, meta in enumerate(manifest["requests"]):
+        kv = None
+        if meta.get("kv_length") is not None:
+            layers: Dict[str, Any] = {}
+            j = 0
+            while f"r{i}/kv/layer{j}/k" in flat:
+                layers[f"layer{j}"] = {
+                    "k": flat[f"r{i}/kv/layer{j}/k"],
+                    "v": flat[f"r{i}/kv/layer{j}/v"],
+                }
+                j += 1
+            kv = {"length": int(meta["kv_length"]), "layers": layers}
+        requests.append({
+            "id": meta["id"],
+            "prompt": flat[f"r{i}/prompt"],
+            "max_new_tokens": meta["max_new_tokens"],
+            "eos_id": meta.get("eos_id"),
+            "tenant": meta.get("tenant", "default"),
+            "tier": meta.get("tier", "batch"),
+            "deadline_ms": meta.get("deadline_ms"),
+            "preemptions": meta.get("preemptions", 0),
+            "tokens": [int(t) for t in flat[f"r{i}/tokens"]],
+            "kv_spill": kv,
+        })
+    return {"schema": manifest["schema"], "requests": requests}
 
 
 def _pct(vals: Sequence[float], q: float) -> Optional[float]:
@@ -92,6 +193,11 @@ class ServeReport:
     spec_drafted: int = 0
     spec_accepted: int = 0
     peak_active: int = 0  # max simultaneously-admitted requests
+    # --- resilience (docs/RESILIENCE.md) ---
+    requests_expired: int = 0  # deadline_ms expiries while queued
+    drained: bool = False  # run ended via SIGTERM drain, not queue-empty
+    shed: int = 0  # batch requests shed under sustained SLO pressure
+    watchdog_fires: int = 0  # windows slower than --serve-watchdog-s
 
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -126,6 +232,10 @@ class ServeEngine:
         prefix_sharing: bool = True,
         spec_k: int = 0,
         spec_draft_layers: int = 0,
+        watchdog_s: float = 0.0,
+        shed_after_windows: int = 0,
+        slo_ms: float = 50.0,
+        drain_path: Optional[str] = None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -497,6 +607,18 @@ class ServeEngine:
         self.peak_active = 0
         self._occ_sum = 0.0
         self._t0: Optional[float] = None
+        # --- resilience state (docs/RESILIENCE.md) ------------------------
+        # SIGTERM drain: the handler only sets a flag; the loop drains at
+        # the next window boundary (inside the window's own sync budget)
+        self.watchdog_s = float(watchdog_s)  # 0 = watchdog off
+        self.shed_after_windows = int(shed_after_windows)  # 0 = shed off
+        self.slo_ms = float(slo_ms)
+        self.drain_path = drain_path
+        self._drain_requested = False
+        self.drained = False
+        self.drain_payload: Optional[Dict[str, Any]] = None
+        self.watchdog_fires = 0
+        self._slo_breach_windows = 0  # consecutive over-SLO windows
 
     # --- submission --------------------------------------------------------
     def submit(
@@ -509,11 +631,13 @@ class ServeEngine:
         arrival_s: float = 0.0,
         tenant: str = "default",
         tier: str = "batch",
+        deadline_ms: Optional[float] = None,
     ) -> Request:
         req = Request(
             prompt=prompt, max_new_tokens=max_new_tokens, id=req_id,
             eos_id=eos_id if eos_id is not None else self.eos_id,
             arrival_s=arrival_s, tenant=tenant, tier=tier,
+            deadline_ms=deadline_ms,
         )
         # a budget past the compiled position range / pool size comes
         # back REJECTED with a reason (graceful, never a crash)
@@ -539,41 +663,187 @@ class ServeEngine:
         self.spec_drafted = self.spec_accepted = 0
         self.peak_active = 0
         self._occ_sum = 0.0
+        self.watchdog_fires = 0
+        self._slo_breach_windows = 0
         fin0 = len(self.sched.finished)
         rej0 = len(self.sched.rejected)
         pre0 = self.sched.preemptions
+        exp0 = self.sched.expired
+        shed0 = self.sched.shed
         # requests queued via submit() before run() count as arriving
-        # at run start for TTFT purposes
+        # at run start for TTFT purposes — and their queue-wait clock
+        # (deadline_ms) rebases onto the run-relative timeline the loop
+        # passes to admit()
         for r in self.sched.queue:
             if r.arrival_abs_s is None:
                 r.arrival_abs_s = t0
+                r.t_submit = 0.0
+        # SIGTERM = drain request (docs/RESILIENCE.md): the handler only
+        # sets a flag; the loop drains at the next window BOUNDARY, so
+        # the spill happens inside the normal sync discipline.  Restored
+        # in finally; install can fail off the main thread (tests,
+        # embedding) — drain then remains available via request_drain().
+        import signal as _signal
+
+        self.drained = False
+        self._drain_requested = False
+        old_handler = None
+        try:
+            old_handler = _signal.signal(
+                _signal.SIGTERM, lambda signum, frame: self.request_drain()
+            )
+        except ValueError:
+            pass
         n_sub = 0
-        while True:
-            now = self._now() - t0
-            while n_sub < len(pending) and pending[n_sub].arrival_s <= now:
-                r = pending[n_sub]
-                self.sched.submit(r, now=now)
-                r.arrival_abs_s = t0 + r.arrival_s
-                n_sub += 1
-            self.sched.admit(now=now)
-            if self.sched.idle:
-                if n_sub >= len(pending):
+        try:
+            while True:
+                if self._drain_requested:
+                    self.drain_payload = self.drain()
+                    if self.drain_path:
+                        save_drain(self.drain_path, self.drain_payload)
+                    self.drained = True
                     break
-                # open loop: idle until the next arrival is due
-                dt_next = pending[n_sub].arrival_s - (self._now() - t0)
-                if dt_next > 0:
-                    time.sleep(min(dt_next, 0.05))
-                continue
-            self._window()
+                now = self._now() - t0
+                while (n_sub < len(pending)
+                       and pending[n_sub].arrival_s <= now):
+                    r = pending[n_sub]
+                    self.sched.submit(r, now=now)
+                    r.arrival_abs_s = t0 + r.arrival_s
+                    n_sub += 1
+                self.sched.admit(now=now)
+                if self.sched.idle:
+                    if n_sub >= len(pending):
+                        break
+                    # open loop: idle until the next arrival is due
+                    dt_next = pending[n_sub].arrival_s - (self._now() - t0)
+                    if dt_next > 0:
+                        time.sleep(min(dt_next, 0.05))
+                    continue
+                self._window()
+        finally:
+            if old_handler is not None:
+                try:
+                    _signal.signal(_signal.SIGTERM, old_handler)
+                except ValueError:
+                    pass
         wall = self._now() - t0
         return self._report(
             wall, ex.host_syncs - syncs0,
             self.sched.finished[fin0:], len(self.sched.rejected) - rej0,
             self.sched.preemptions - pre0,
+            expired=self.sched.expired - exp0,
+            shed=self.sched.shed - shed0,
         )
+
+    def request_drain(self) -> None:
+        """Ask the run loop to drain at the next window boundary (what
+        the SIGTERM handler calls; also callable directly)."""
+        self._drain_requested = True
+
+    # --- drain / restore (docs/RESILIENCE.md) -------------------------------
+    def drain(self) -> Dict[str, Any]:
+        """Spill every in-flight slot to host and unload the queues into
+        one payload a restarted engine resumes from.  DECODE slots spill
+        their live K/V bit-exactly (:meth:`PagedKVCache.spill` — the
+        preemption convention); mid-PREFILL slots drop their partial KV
+        and re-ingest on resume (deterministic, so the output stream is
+        unchanged).  Greedy decode + bit-exact restore ⇒ the combined
+        pre-drain + post-restart token streams equal an uninterrupted
+        run's, which the drain/restart test pins byte for byte."""
+        sched = self.sched
+        tracer = get_tracer()
+        reqs: List[Request] = []
+        spilled = 0
+        for slot in sorted(sched.active):
+            req = sched.active.pop(slot)
+            if req.state is RequestState.DECODE and req.done_tokens > 0:
+                # positions with live KV: the full prompt + one write per
+                # decode step taken (the latest token is the next step's
+                # input — no KV yet); same arithmetic as _preempt_one
+                live = req.prompt_len + max(0, req.done_tokens - 1)
+                req.kv_spill = self.kv.spill(slot, live)
+                req.state = RequestState.PREEMPTED
+                spilled += 1
+            else:
+                self.kv.release(slot)
+                req.kv_spill = None
+                req.prefill_pos = 0
+                req.state = RequestState.QUEUED
+            sched.free_slots.append(slot)
+            req.slot = -1
+            reqs.append(req)
+        reqs.extend(sched.queue)  # admission order, interactive first
+        for q in sched._queues.values():
+            q.clear()
+        if tracer.enabled:
+            tracer.instant(
+                "serve_drain", cat="health",
+                requests=len(reqs), spilled=spilled,
+            )
+            tracer.counter("serve.drains")
+        return {
+            "schema": DRAIN_SCHEMA,
+            "requests": [
+                {
+                    "id": int(r.id),
+                    "prompt": np.asarray(r.prompt, np.int32),
+                    "max_new_tokens": int(r.max_new_tokens),
+                    "eos_id": r.eos_id,
+                    "tenant": r.tenant,
+                    "tier": r.tier,
+                    "deadline_ms": r.deadline_ms,
+                    "preemptions": int(r.preemptions),
+                    "tokens": list(r.tokens),
+                    "kv_spill": r.kv_spill,
+                }
+                for r in reqs
+            ],
+        }
+
+    def resume_from_drain(self, payload: Dict[str, Any]) -> List[Request]:
+        """Reload a :meth:`drain` payload into this engine's queues.
+        Spilled requests re-enter as PREEMPTED — the scheduler's
+        ``_place`` restores their K/V bit-exactly and they rejoin decode
+        mid-stream; the rest re-queue for normal admission.  Call before
+        :meth:`run`."""
+        schema = payload.get("schema")
+        assert schema == DRAIN_SCHEMA, (
+            f"drain payload schema {schema!r} != {DRAIN_SCHEMA!r}"
+        )
+        out: List[Request] = []
+        for d in payload["requests"]:
+            req = Request(
+                prompt=d["prompt"],
+                max_new_tokens=int(d["max_new_tokens"]),
+                id=int(d["id"]),
+                eos_id=d.get("eos_id"),
+                tenant=d.get("tenant", "default"),
+                tier=d.get("tier", "batch"),
+                deadline_ms=d.get("deadline_ms"),
+            )
+            req.tokens = [int(t) for t in d.get("tokens", ())]
+            req.preemptions = int(d.get("preemptions", 0))
+            kv = d.get("kv_spill")
+            if kv is not None:
+                req.kv_spill = kv
+                req.state = RequestState.PREEMPTED
+            else:
+                req.state = RequestState.QUEUED
+            # bypass submit(): admissibility was proven before the drain
+            # and re-checking would re-run the shared-prefix arithmetic
+            # against a cold index
+            self.sched._queues[req.tier].append(req)
+            self.sched._next_id = max(self.sched._next_id, req.id) + 1
+            out.append(req)
+        return out
 
     # --- one flush window ---------------------------------------------------
     def _window(self) -> None:
+        # fault-injection hook (--fault-plan serve:..., docs/RESILIENCE.md):
+        # one call + None check when no plan is installed, ledger-pinned
+        plan = get_fault_plan()
+        if plan is not None:
+            plan.on_serve_window(self)
         jnp = self._jnp
         ex = self.model.executor
         tracer = get_tracer()
@@ -768,6 +1038,40 @@ class ServeEngine:
         self.windows += 1
         self._occ_sum += self.sched.occupancy
         win_wall = self._now() - t_win
+        # window watchdog (--serve-watchdog-s): a window slower than the
+        # budget is flagged loudly — a stalled loader, a GC pause, or a
+        # degraded DCN link shows up here long before SLO percentiles do
+        if self.watchdog_s and win_wall > self.watchdog_s:
+            self.watchdog_fires += 1
+            if tracer.enabled:
+                tracer.counter("serve.watchdog_fires")
+                tracer.instant(
+                    "serve_watchdog", cat="health",
+                    window=self.windows - 1,
+                    wall_s=round(win_wall, 6),
+                    budget_s=self.watchdog_s,
+                )
+        # graceful shedding (--serve-shed-windows): after N CONSECUTIVE
+        # windows over the per-token SLO, reject the queued batch tier
+        # with a truthful reason — shrinking the backlog instead of
+        # letting every tier's latency collapse together
+        if self.shed_after_windows and flushed_tokens:
+            per_tok_ms = win_wall / flushed_tokens * 1e3
+            if per_tok_ms > self.slo_ms:
+                self._slo_breach_windows += 1
+            else:
+                self._slo_breach_windows = 0
+            if self._slo_breach_windows >= self.shed_after_windows:
+                now_rel = self._now() - (self._t0 or 0.0)
+                n = self.sched.shed_batch_queue(
+                    now_rel,
+                    f"sustained SLO pressure: per-token "
+                    f"{per_tok_ms:.1f} ms > {self.slo_ms:.1f} ms SLO "
+                    f"for {self._slo_breach_windows} consecutive windows",
+                )
+                self._slo_breach_windows = 0
+                if n and tracer.enabled:
+                    tracer.counter("serve.shed", float(n))
         if tracer.enabled:
             tracer.counter("serve.windows", 1.0)
             if steps:
@@ -798,6 +1102,8 @@ class ServeEngine:
                 "active": len(self.sched.active),
                 "finished": fin,
                 "rejected_total": len(self.sched.rejected),
+                "expired_total": self.sched.expired,
+                "shed_total": self.sched.shed,
                 "prefix_hit_rate": self.kv.prefix_hit_rate,
                 "cached_blocks": self.kv.cached_blocks,
                 "preemptions_total": self.sched.preemptions,
@@ -832,6 +1138,7 @@ class ServeEngine:
     def _report(
         self, wall: float, host_syncs: int, fin=None, rejected=None,
         preemptions: Optional[int] = None,
+        expired: Optional[int] = None, shed: Optional[int] = None,
     ) -> ServeReport:
         fin = self.sched.finished if fin is None else fin
         lat = [r.latency_ms() for r in fin]
@@ -901,6 +1208,12 @@ class ServeEngine:
             spec_drafted=self.spec_drafted,
             spec_accepted=self.spec_accepted,
             peak_active=self.peak_active,
+            requests_expired=(
+                self.sched.expired if expired is None else expired
+            ),
+            drained=self.drained,
+            shed=self.sched.shed if shed is None else shed,
+            watchdog_fires=self.watchdog_fires,
         )
         self.metrics.close()
         return rep

@@ -80,6 +80,10 @@ class Request:
     arrival_s: float = 0.0  # open-loop arrival offset (traffic.py)
     tenant: str = "default"
     tier: str = "batch"  # "interactive" | "batch"
+    # queue-wait deadline: a request still QUEUED this many ms after
+    # submit is expired with a truthful reason instead of waiting
+    # forever (docs/RESILIENCE.md).  None = wait indefinitely.
+    deadline_ms: Optional[float] = None
 
     # --- filled in by the scheduler/engine ---
     state: RequestState = RequestState.QUEUED
@@ -149,6 +153,8 @@ class ContinuousBatchingScheduler:
         self.finished: List[Request] = []
         self.rejected: List[Request] = []
         self.preemptions = 0  # cumulative spill events
+        self.expired = 0  # deadline_ms expiries while queued
+        self.shed = 0  # batch requests shed under SLO pressure
         self._next_id = 0
 
     @property
@@ -282,11 +288,58 @@ class ContinuousBatchingScheduler:
         self._queues["batch"].appendleft(victim)  # resume first
         return True
 
+    def _expire(self, now: float) -> int:
+        """Sweep every tier queue for requests past their
+        ``deadline_ms``: each is rejected with a truthful reason (how
+        long it waited vs its deadline) instead of occupying the queue
+        forever.  Runs before admission so an expired queue head never
+        blocks a live request behind it."""
+        n = 0
+        for q in self._queues.values():
+            keep = deque()
+            while q:
+                req = q.popleft()
+                waited_ms = (now - (req.t_submit or 0.0)) * 1e3
+                if (req.deadline_ms is not None
+                        and waited_ms > req.deadline_ms):
+                    req.state = RequestState.REJECTED
+                    req.finish_reason = (
+                        f"rejected: deadline {req.deadline_ms:.0f} ms "
+                        f"exceeded while queued (waited {waited_ms:.0f} ms"
+                        f", tier {req.tier!r})"
+                    )
+                    req.t_done = now
+                    self.rejected.append(req)
+                    self.expired += 1
+                    n += 1
+                else:
+                    keep.append(req)
+            q.extend(keep)
+        return n
+
+    def shed_batch_queue(self, now: float, reason: str) -> int:
+        """Graceful load shedding under sustained SLO pressure: reject
+        every QUEUED batch-tier request with ``reason`` (truthful — it
+        names the pressure that triggered the shed).  Active slots are
+        untouched; interactive requests are never shed."""
+        q = self._queues["batch"]
+        n = len(q)
+        while q:
+            req = q.popleft()
+            req.state = RequestState.REJECTED
+            req.finish_reason = f"rejected: shed ({reason})"
+            req.t_done = now
+            self.rejected.append(req)
+        self.shed += n
+        return n
+
     def admit(self, now: float = 0.0) -> List[Request]:
         """Admit queue-head requests into free slots while both a slot
         and the KV reservation (net of shared blocks) are available.
         Interactive requests admit first and preempt batch slots when
-        they cannot be placed otherwise."""
+        they cannot be placed otherwise.  Deadline-expired requests are
+        swept out first (:meth:`_expire`)."""
+        self._expire(now)
         out = self._admit_tier("interactive", now)
         while self._queues["interactive"]:
             if not self._preempt_one(now):
@@ -341,8 +394,8 @@ class ContinuousBatchingScheduler:
 
         def row(tenant: str) -> Dict[str, Any]:
             return out.setdefault(tenant, {
-                "finished": 0, "rejected": 0, "active": 0, "queued": 0,
-                "preemptions": 0, "tokens": 0, "ttft_ms": [],
+                "finished": 0, "rejected": 0, "expired": 0, "active": 0,
+                "queued": 0, "preemptions": 0, "tokens": 0, "ttft_ms": [],
                 "tier": None,
             })
 
@@ -358,6 +411,8 @@ class ContinuousBatchingScheduler:
         for r in self.rejected:
             d = row(r.tenant)
             d["rejected"] += 1
+            if (r.finish_reason or "").startswith("rejected: deadline"):
+                d["expired"] += 1
             d["tier"] = r.tier
         for r in self.active.values():
             d = row(r.tenant)

@@ -80,6 +80,10 @@ COMPARABLE_METADATA = (
     # but the gate surfaces the change because k shifts decode tokens/s
     # for configuration (not regression) reasons
     "serve_spec_k",
+    # fault_plan (r12, docs/RESILIENCE.md): the recovery A/B's injected
+    # fault spec — a different plan kills the run at a different step,
+    # shifting recovery_s for configuration (not regression) reasons
+    "fault_plan",
 )
 
 # (label, path into the record, higher_is_better) — the gated metrics.
@@ -124,6 +128,17 @@ GATED = (
 # predates the field, or verify_compiled=off) is not gated.
 ZERO_GATED = (
     ("analysis_violations", ("analysis_violations",)),
+)
+
+# (label, path) — metrics gated AT TRUE: the current value must be
+# exactly 1.0 (True) whenever present, regardless of the baseline.
+# resume_replay_exact (r12, docs/RESILIENCE.md) is the kill-and-resume
+# bit-identity bit from bench.py's recovery A/B: a resumed run drifting
+# from the uninterrupted run by even one bit is a determinism
+# regression at ANY threshold.  A null/missing current value (record
+# predates the field, or the A/B errored) is not gated.
+TRUE_GATED = (
+    ("resume_replay_exact", ("resume_replay_exact",)),
 )
 
 
@@ -221,6 +236,20 @@ def compare(
             # zero-gate: threshold-free — any non-zero count fails even
             # when the baseline predates the field (base treated as 0)
             "regressed": cur > 0,
+        })
+    for label, path in TRUE_GATED:
+        cur = _dig(current, path)
+        if cur is None:
+            continue
+        base = _dig(baseline, path)
+        rows.append({
+            "metric": label,
+            "baseline": base if base is not None else 1.0,
+            "current": cur,
+            "ratio": cur,
+            # true-gate: threshold-free — the bit must hold at 1.0 even
+            # when the baseline predates the field
+            "regressed": cur != 1.0,
         })
     return rows
 

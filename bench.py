@@ -930,6 +930,172 @@ def _serve_spec_ab(on_tpu: bool) -> dict:
     }
 
 
+def _serve_disagg_ab(on_tpu: bool) -> dict:
+    """Disaggregated prefill/decode A/B (ISSUE 13 acceptance,
+    docs/SERVING.md "Disaggregated prefill/decode"): the SAME compiled
+    model serves the SAME bursty workload colocated (one engine, so
+    prefill chunks and decode steps share every flush window) vs split
+    into a prefill pool + a decode pool joined by the priced ffkv/1
+    handoff.
+
+    A decode token is observable at its window's flush, so its latency
+    is its window's wall — and under bursty arrivals the colocated
+    windows carry prefill chunks for the whole incoming wave while the
+    decode pool's windows never do.  The gated fact is the
+    per-decode-token window latency (``step_wall_s / decode_steps``
+    over decode-bearing windows, read off the ffmetrics streams both
+    arms write): ``serve_disagg_p99_tpot_ms`` is the disagg decode
+    pool's p99 (LOWER-is-better gate), ``interference_ratio`` =
+    colocated p99 / disagg p99 pins the >= 1.3x improvement, and every
+    request's token stream must stay bit-identical across arms (greedy
+    argmax, same weights — batching composition must not change the
+    math)."""
+    import tempfile
+
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.transformer import gpt_decoder
+    from flexflow_tpu.obs.metrics import read_metrics
+    from flexflow_tpu.parallel.network import load_machine_model
+    from flexflow_tpu.serve import (
+        DisaggregatedCluster,
+        ServeEngine,
+        TrafficSpec,
+        synthetic_requests,
+    )
+
+    slots = 8 if on_tpu else 4
+    seq = 512 if on_tpu else 160
+    shape = (
+        dict(hidden=512, heads=8, ff_dim=2048, num_layers=6)
+        if on_tpu
+        else dict(hidden=128, heads=4, ff_dim=256, num_layers=2)
+    )
+    vocab = 32000 if on_tpu else 256
+    cfg = FFConfig(
+        batch_size=slots, compute_dtype="bfloat16" if on_tpu else "float32",
+    )
+    model = FFModel(cfg)
+    gpt_decoder(model, slots, seq, vocab=vocab, **shape)
+    model.compile(seed=0)
+
+    # bursty contended shape: prompts long enough that a prefill chunk
+    # clearly dominates a mixed window, bursts (burst_factor=4) so new
+    # waves land while earlier requests are mid-decode
+    spec = TrafficSpec(
+        n_requests=32 if on_tpu else 16,
+        seed=0,
+        rate_rps=25.0,
+        burst_factor=4.0,
+        prompt_len=(128, 256) if on_tpu else (48, 96),
+        max_new=(48, 96) if on_tpu else (24, 48),
+        vocab=vocab,
+    )
+    machine = load_machine_model(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "examples", "machine_configs", "v5p_2slice.json",
+    ))
+
+    def _pctl(vals, q):
+        vals = sorted(vals)
+        idx = (len(vals) - 1) * q / 100.0
+        lo = int(idx)
+        hi = min(lo + 1, len(vals) - 1)
+        return vals[lo] * (1 - (idx - lo)) + vals[hi] * (idx - lo)
+
+    def _decode_window_tpot_ms(path):
+        # per-decode-token observable latency of each decode-bearing
+        # window; the disagg stream's prefill-pool windows (phase ==
+        # "prefill") never decode, but skip them explicitly anyway
+        vals = []
+        for r in read_metrics(path):
+            s = (r.get("metrics") or {}).get("serve")
+            if not s or not s.get("decode_steps"):
+                continue
+            if s.get("phase") == "prefill":
+                continue
+            vals.append(
+                (r.get("step_wall_s") or 0.0) / s["decode_steps"] * 1e3
+            )
+        return vals
+
+    with tempfile.TemporaryDirectory() as td:
+        col_path = os.path.join(td, "colocated.jsonl")
+        dis_path = os.path.join(td, "disagg.jsonl")
+
+        engine = ServeEngine(
+            model, slots=slots, block_size=16 if on_tpu else 8,
+            sync_every=4, metrics_out=col_path,
+        )
+        rep_c = engine.run(synthetic_requests(spec))
+        col = {
+            r.id: np.asarray(r.tokens, np.int32)
+            for r in engine.sched.finished
+        }
+
+        cluster = DisaggregatedCluster(
+            model, prefill_slots=slots, decode_slots=slots,
+            prefill_block_size=16 if on_tpu else 8,
+            decode_block_size=32 if on_tpu else 16,
+            sync_every=4, machine=machine, metrics_out=dis_path,
+        )
+        rep_d = cluster.run(synthetic_requests(spec))
+        dis = {}
+        for eng in (cluster.prefill, cluster.decode):
+            for r in eng.sched.finished:
+                dis[r.id] = np.asarray(r.tokens, np.int32)
+
+        tpot_c = _decode_window_tpot_ms(col_path)
+        tpot_d = _decode_window_tpot_ms(dis_path)
+
+    outputs_match = set(col) == set(dis) and all(
+        np.array_equal(col[i], dis[i]) for i in col
+    )
+    p99_c = _pctl(tpot_c, 99) if tpot_c else None
+    p99_d = _pctl(tpot_d, 99) if tpot_d else None
+    return {
+        "config": (
+            f"{'mid' if on_tpu else 'tiny'} gpt pools {rep_d.split} "
+            f"{spec.n_requests} reqs bursty"
+        ),
+        "serve_traffic": spec.identity,
+        "serve_disagg_split": rep_d.split,
+        "serve_disagg_p99_tpot_ms": (
+            round(p99_d, 4) if p99_d is not None else None
+        ),
+        "colocated_p99_tpot_ms": (
+            round(p99_c, 4) if p99_c is not None else None
+        ),
+        "interference_ratio": (
+            round(p99_c / p99_d, 3) if p99_c and p99_d else None
+        ),
+        "outputs_match": bool(outputs_match),
+        "serve_handoff_ms": (
+            round(rep_d.handoff_p99_ms, 4)
+            if rep_d.handoff_p99_ms is not None else None
+        ),
+        "handoff_p50_ms": (
+            round(rep_d.handoff_p50_ms, 4)
+            if rep_d.handoff_p50_ms is not None else None
+        ),
+        "migrated": rep_d.migrated,
+        "migrated_kv_bytes": rep_d.migrated_kv_bytes,
+        "transport_backpressure": rep_d.transport_backpressure,
+        "prefill_windows": rep_d.prefill_windows,
+        "decode_windows": rep_d.decode_windows,
+        "colocated_windows": rep_c.windows,
+        "ttft_p99_colocated_ms": (
+            round(rep_c.ttft_p99_ms, 3)
+            if rep_c.ttft_p99_ms is not None else None
+        ),
+        "ttft_p99_disagg_ms": (
+            round(rep_d.ttft_p99_ms, 3)
+            if rep_d.ttft_p99_ms is not None else None
+        ),
+    }
+
+
 def _recovery_ab(on_tpu: bool) -> dict:
     """Kill-and-resume A/B (ISSUE 12 acceptance): train a tiny model to
     completion (arm A), then re-run it with a deterministic injected
@@ -1041,6 +1207,7 @@ def _bench_secondary(on_tpu: bool) -> dict:
         ("serve_continuous_ab", _serve_continuous_ab),
         ("serve_prefix_ab", _serve_prefix_ab),
         ("serve_spec_ab", _serve_spec_ab),
+        ("serve_disagg_ab", _serve_disagg_ab),
         ("recovery_ab", _recovery_ab),
     ):
         try:
@@ -1260,6 +1427,15 @@ def run_bench(backend: str) -> None:
         # different k are different workloads)
         "serve_prefix_hit_rate": None,
         "serve_spec_k": None,
+        # disaggregated prefill/decode (ISSUE 13, docs/SERVING.md
+        # "Disaggregated prefill/decode"): decode pool p99 per-token
+        # window latency under bursty traffic (LOWER-is-better gate),
+        # with the handoff latency and the pool split as comparable
+        # metadata — different splits are different deployments, not
+        # regressions
+        "serve_disagg_p99_tpot_ms": None,
+        "serve_handoff_ms": None,
+        "serve_disagg_split": None,
         # resilience (ISSUE 12, docs/RESILIENCE.md): checkpoint-restore
         # wall time (LOWER-is-better), the kill-and-resume bit-identity
         # bit (gated AT TRUE), and the injected fault plan (comparable
@@ -1332,6 +1508,10 @@ def run_bench(backend: str) -> None:
     record["serve_prefix_hit_rate"] = pab.get("serve_prefix_hit_rate")
     xab = record["secondary"].get("serve_spec_ab") or {}
     record["serve_spec_k"] = xab.get("serve_spec_k")
+    dab = record["secondary"].get("serve_disagg_ab") or {}
+    record["serve_disagg_p99_tpot_ms"] = dab.get("serve_disagg_p99_tpot_ms")
+    record["serve_handoff_ms"] = dab.get("serve_handoff_ms")
+    record["serve_disagg_split"] = dab.get("serve_disagg_split")
     rab = record["secondary"].get("recovery_ab") or {}
     record["recovery_s"] = rab.get("recovery_s")
     record["resume_replay_exact"] = rab.get("resume_replay_exact")

@@ -20,6 +20,7 @@ use from tests via :func:`analyze_program`.
 """
 
 from flexflow_tpu.analysis.capture import (
+    analyze_disagg_cluster,
     analyze_executor,
     analyze_serve_engine,
     artifact_from_executor_step,
@@ -57,6 +58,7 @@ __all__ = [
     "ProgramArtifact",
     "Violation",
     "analyze_artifacts",
+    "analyze_disagg_cluster",
     "analyze_executor",
     "analyze_program",
     "analyze_serve_engine",

@@ -328,3 +328,51 @@ def analyze_serve_engine(
             for slot, idx, blk in hazards
         ])
     return report
+
+
+def analyze_disagg_cluster(
+    cluster, checks: Optional[Sequence[str]] = None
+) -> AnalysisReport:
+    """Analyze a :class:`~flexflow_tpu.serve.disagg.DisaggregatedCluster`:
+    both pools' serve programs (renamed ``prefill.*`` / ``decode.*``)
+    plus the ``serve_handoff`` audit — every delivered or in-flight
+    ``ffkv/1`` frame must digest-verify, the pools must not share KV
+    device buffers (cross-pool donation would corrupt both), and no
+    request may be active in both pools at once.  Per-pool CoW safety
+    rides on each pool's own ``serve_cow`` check."""
+    import dataclasses as _dc
+
+    from flexflow_tpu.analysis.core import Violation
+
+    report = AnalysisReport()
+    for pool, eng in (
+        ("prefill", cluster.prefill), ("decode", cluster.decode),
+    ):
+        sub = analyze_serve_engine(eng, checks)
+        for name in sub.programs:
+            report.add_program(f"{pool}.{name}")
+        report.extend([
+            _dc.replace(v, program=f"{pool}.{v.program}")
+            for v in sub.violations
+        ])
+    if checks is None or "serve_handoff" in checks:
+        report.add_program("disagg.handoff")
+        try:
+            rows = list(cluster.handoff_audit())
+        except Exception:
+            rows = []  # checks are total: never raise
+        report.extend([
+            Violation(
+                check="serve_handoff",
+                severity="error",
+                program="disagg.handoff",
+                message=f"[{r.get('check')}] {r.get('message')}",
+                where=str(r.get("check", "")),
+                details=dict(r),
+            )
+            # pool-local CoW rows are already reported by each pool's
+            # serve_cow check above — don't double-count them here
+            for r in rows
+            if r.get("check") != "serve_cow"
+        ])
+    return report

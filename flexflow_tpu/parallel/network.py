@@ -197,6 +197,34 @@ class NetworkedMachineModel(_networked_base()):
         flows: at most ``hosts_per_slice`` uplink sets engage."""
         return min(max(1, m), self.hosts_per_slice) * self.host_dcn_bw
 
+    def subset(self, num_slices: int) -> "NetworkedMachineModel":
+        """A machine model over ``num_slices`` of this pod's slices —
+        the disaggregated serving search (docs/SERVING.md) prices each
+        pool (prefill submesh / decode submesh) on its own slice
+        subset.  Everything but the slice count (and the DCN span it
+        implies) is inherited; routing-decision tallies are NOT shared,
+        since each pool's search is its own pricing run."""
+        assert 1 <= int(num_slices) <= self.num_slices, (
+            num_slices, self.num_slices,
+        )
+        m = NetworkedMachineModel(
+            slice_topology=self.slice_topology,
+            num_slices=int(num_slices),
+            hosts_per_slice=self.hosts_per_slice,
+            peak_flops=self.peak_flops,
+            hbm_bw=self.hbm_bw,
+            dcn_bw_per_uplink=self.dcn_bw_per_uplink,
+            dcn_uplinks_per_host=self.dcn_uplinks_per_host,
+            dcn_latency=self.dcn_latency,
+            dcn_contention=self.dcn_contention,
+            dcn_axes=self.dcn_axes,
+            latency=self.latency,
+        )
+        m.source = (
+            f"{getattr(self, 'source', 'machine')}/slices{int(num_slices)}"
+        )
+        return m
+
     # --- mesh binding ------------------------------------------------------
     def _plan(self, mesh: MachineMesh):
         """(dcn_axis_name | None, slice_factor, intra embedding) or None.

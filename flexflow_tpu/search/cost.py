@@ -1233,6 +1233,29 @@ def estimate_speculative_decode(
     }
 
 
+def estimate_kv_handoff_time(nbytes: float, machine=None) -> float:
+    """One prefill→decode KV handoff over DCN (docs/SERVING.md,
+    "Disaggregated prefill/decode"): a point-to-point transfer of the
+    request's dense spill payload, priced as one DCN phase latency plus
+    the bytes over one host's aggregate uplink bandwidth (the handoff
+    is a single logical flow, so it rides ``host_dcn_bw`` like the flat
+    ring's slice-boundary hop — not the slice-aggregate rate a spread
+    collective gets).
+
+    ``machine=None`` prices zero (a colocated cluster has no wire);
+    a scalar :class:`TPUMachineModel` falls back to its flat ``dcn_bw``.
+    Pure host math — the disagg search arm and the in-process transport
+    both inject exactly this number.
+    """
+    if machine is None:
+        return 0.0
+    bw = getattr(machine, "host_dcn_bw", None) or getattr(
+        machine, "dcn_bw", 0.0
+    )
+    lat = float(getattr(machine, "dcn_latency", 0.0))
+    return lat + (float(nbytes) / bw if bw else 0.0)
+
+
 def _chain_assignment_uniform(chain, strategy: Strategy) -> bool:
     """Every repeat of the chain carries the same per-position OpSharding
     (the precondition for price-once-multiply).  Compared by

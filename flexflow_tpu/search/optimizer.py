@@ -147,6 +147,163 @@ def _pipeline_variants(
     return best
 
 
+def _handoff_bytes(layers, kv_len: int) -> float:
+    """Dense KV bytes one migrated request carries at steady-state
+    prefix depth ``kv_len``: k + v per attention layer (the exact
+    quantity :func:`flexflow_tpu.serve.wire.kv_payload_nbytes` reports
+    for a real spill — block padding never crosses the wire)."""
+    from flexflow_tpu.search.cost import _dtype_nbytes
+    from flexflow_tpu.tensor import OperatorType
+
+    total = 0.0
+    for layer in layers:
+        if layer.op_type == OperatorType.MULTIHEAD_ATTENTION:
+            e = layer.attrs.get("embed_dim", 0)
+            nb = _dtype_nbytes(layer.outputs[0].dtype)
+            total += 2.0 * kv_len * e * nb
+    return total
+
+
+def _disagg_arm(
+    layers, mesh, graph_inputs, machine, serve_obj, budget, alpha, beam,
+    extra_xfers, struct_xfers, inference,
+):
+    """Price the disaggregated prefill/decode arm (docs/SERVING.md):
+    for every split of the machine's slices into a prefill pool (``p``
+    slices) and a decode pool (``d = num_slices - p``), search each
+    pool's OWN mesh/strategy on its :meth:`NetworkedMachineModel.subset`
+    — prefill wants the forward pass fast (compute/TP), decode wants
+    the weight-streaming roofline (the ServeObjective) — and price the
+    KV handoff between them on the full machine's DCN.
+
+    Split cost combines the two pools as concurrent stages: per
+    generated token the cluster pays ``max(decode objective cost,
+    prefill feed cost)`` (whichever pool is the bottleneck; the other
+    overlaps) plus the per-request handoff amortized over ~``kv_len``
+    generated tokens.  The prefill feed cost charges one forward pass
+    per ``train_tokens`` prompt positions — the steady-state assumption
+    that generation and prompt lengths are comparable; bench A/Bs
+    measure the real ratio.
+
+    Returns the best split as a JSON-able dict (what lands in
+    ``serve_price["disagg"]``) plus the two pool strategies, or None
+    when the machine cannot split."""
+    from flexflow_tpu.obs import get_tracer
+    from flexflow_tpu.search.cost import estimate_kv_handoff_time
+    from flexflow_tpu.serve.objective import ServeObjective
+
+    n = int(getattr(machine, "num_slices", 1) or 1)
+    if n < 2 or not hasattr(machine, "subset"):
+        return None
+    chips_per_slice = mesh.size // n
+    if chips_per_slice * n != mesh.size:
+        return None
+    spec = serve_obj.spec
+    kv_bytes = _handoff_bytes(layers, spec.kv_len)
+
+    def pool_winner(n_slices, pool_machine, pricer):
+        seed = MachineMesh(
+            (chips_per_slice * n_slices,)
+            + (1,) * (len(mesh.axis_names) - 1),
+            mesh.axis_names,
+        )
+        best = None
+        seen = set()
+        for mv in seed.enumerate_views():
+            if mv.shape in seen:
+                continue
+            seen.add(mv.shape)
+            if not pool_machine.legal_mesh(mv):
+                continue
+            try:
+                res = graph_optimize(
+                    layers, graph_inputs, mv, pool_machine,
+                    budget=budget, alpha=alpha, beam=beam,
+                    lambda_mem=0.0, extra_xfers=extra_xfers,
+                    struct_xfers=struct_xfers, inference=inference,
+                    return_joint=True, forward_only=True,
+                )
+            except ShardingError:
+                continue
+            st = Strategy(mv)
+            st.ops = res.assign
+            if res.layers is not layers:
+                st.rewritten_layers = res.layers
+                st.output_remap = res.remap
+                st.applied_rewrites = tuple(res.applied)
+                st.applied_detail = tuple(res.applied_detail)
+            cost, price = pricer(res, st)
+            if best is None or cost < best[0]:
+                best = (cost, st, price)
+        return best
+
+    def prefill_price(res, st):
+        # chunked prefill IS the forward pass: the DP's forward-only
+        # step time over train_tokens prompt positions
+        return res.cost, {"step_s": res.cost}
+
+    best = None
+    for p in range(1, n):
+        d = n - p
+        pm, dm = machine.subset(p), machine.subset(d)
+        with get_tracer().span(
+            "search_disagg_split", cat="search", split=f"{p}+{d}",
+        ):
+            pw = pool_winner(p, pm, prefill_price)
+            if pw is None:
+                continue
+            d_obj = ServeObjective(
+                dm, spec, serve_obj.train_tokens,
+                calibration=serve_obj.calibration,
+            )
+
+            def decode_price(res, st, _o=d_obj):
+                pr = _o.price(
+                    res.layers if res.layers is not layers else layers,
+                    st,
+                )
+                return pr["cost"], pr
+
+            dw = pool_winner(d, dm, decode_price)
+        if dw is None:
+            continue
+        p_cost, p_st, p_price = pw
+        d_cost, d_st, d_price = dw
+        handoff_s = estimate_kv_handoff_time(kv_bytes, machine)
+        # per-generated-token: pools overlap (max), handoff amortizes
+        # over one request's ~kv_len generated tokens
+        feed_cost = p_cost / max(1, serve_obj.train_tokens)
+        split_cost = (
+            max(d_cost, feed_cost) + handoff_s / max(1, spec.kv_len)
+        )
+        if best is not None and split_cost >= best[0]:
+            continue
+        best = (split_cost, {
+            "split": f"{p}+{d}",
+            "cost": split_cost,
+            "prefill": {
+                "slices": p,
+                "mesh": list(p_st.mesh.shape),
+                "axes": list(p_st.mesh.axis_names),
+                "step_s": p_price["step_s"],
+            },
+            "decode": {
+                "slices": d,
+                "mesh": list(d_st.mesh.shape),
+                "axes": list(d_st.mesh.axis_names),
+                "step_s": d_price.get("step_s"),
+                "tok_s": d_price.get("tok_s"),
+                "p99_ms": d_price.get("p99_ms"),
+                "feasible": d_price.get("feasible"),
+            },
+            "handoff_ms": handoff_s * 1e3,
+            "handoff_bytes": kv_bytes,
+        }, p_st, d_st)
+    if best is None:
+        return None
+    return best[1], best[2], best[3]
+
+
 def _train_tokens(graph_inputs) -> int:
     """Tokens one training step of this graph moves (batch x seq of the
     first sequence-shaped input, else batch) — the scale factor the
@@ -473,6 +630,26 @@ def _unity_search_impl(
     if forced_best is not None:
         best = forced_best[1]
     assert best is not None, "no feasible mesh factorization"
+    # disaggregated serving arm (docs/SERVING.md): jointly pick the
+    # slice split and PER-POOL strategies — prefill and decode pools
+    # price under different objectives, so their winners can (and on
+    # multi-slice machines do) differ.  The arm rides along on the
+    # colocated winner as serve_price["disagg"]; the caller compares
+    # its cost against the colocated one.
+    if (serve_obj is not None
+            and getattr(serve_obj.spec, "disagg", False)
+            and best.serve_price is not None):
+        arm = _disagg_arm(
+            layers, mesh, graph_inputs, machine, serve_obj, budget,
+            alpha, beam, extra_xfers, struct_xfers, inference,
+        )
+        if arm is not None:
+            price, p_st, d_st = arm
+            best.serve_price["disagg"] = price
+            # the pool strategies themselves, for callers that compile
+            # the pools (not serialized — serve_price stays JSON-able)
+            best.disagg_prefill = p_st
+            best.disagg_decode = d_st
     # attach the winner's implied collective multiset (docs/ANALYSIS.md):
     # the golden tests and --verify-compiled reconcile the lowered
     # program against exactly what this placement priced

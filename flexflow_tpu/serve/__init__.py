@@ -24,8 +24,14 @@ compiled decode path (:mod:`flexflow_tpu.models.gpt_decode`):
   so ``unity_search --objective serve`` emits placements for inference.
 * :mod:`flexflow_tpu.serve.driver` — the ``python -m flexflow_tpu
   --serve`` entry point.
+* :mod:`flexflow_tpu.serve.disagg` / :mod:`flexflow_tpu.serve.wire` /
+  :mod:`flexflow_tpu.serve.transport` — disaggregated prefill/decode:
+  a split-pool cluster whose prefill and decode engines run on
+  disjoint submeshes, handing KV across a priced, digest-checked
+  ``ffkv/1`` transport.
 """
 
+from flexflow_tpu.serve.disagg import DisaggregatedCluster, DisaggReport
 from flexflow_tpu.serve.engine import ServeEngine, ServeReport
 from flexflow_tpu.serve.kvcache import KVCacheOOM, PagedKVCache
 from flexflow_tpu.serve.objective import ServeObjective, ServeSpec
@@ -38,6 +44,17 @@ from flexflow_tpu.serve.traffic import (
     TrafficSpec,
     multi_tenant_requests,
     synthetic_requests,
+)
+from flexflow_tpu.serve.transport import (
+    InProcessTransport,
+    Transport,
+    TransportFull,
+)
+from flexflow_tpu.serve.wire import (
+    KV_SCHEMA,
+    HandoffError,
+    decode_handoff,
+    encode_handoff,
 )
 
 __all__ = [
@@ -53,4 +70,13 @@ __all__ = [
     "TrafficSpec",
     "synthetic_requests",
     "multi_tenant_requests",
+    "DisaggregatedCluster",
+    "DisaggReport",
+    "Transport",
+    "InProcessTransport",
+    "TransportFull",
+    "KV_SCHEMA",
+    "HandoffError",
+    "encode_handoff",
+    "decode_handoff",
 ]

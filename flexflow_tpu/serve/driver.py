@@ -21,6 +21,17 @@ allocator and speculative decoding.  The JSON summary then carries
 ``prefix_hit_rate``, ``preemptions``, per-tier TTFT percentiles, and
 the speculative accept rate.
 
+Disaggregated serving (docs/SERVING.md "Disaggregated prefill/decode"):
+``--disagg`` serves through a
+:class:`~flexflow_tpu.serve.disagg.DisaggregatedCluster` — a
+prefill-only pool (``--serve-slots`` wide) feeding a decode-only pool
+(``--disagg-decode-slots``, default the same width) over the priced
+ffkv/1 handoff; ``--machine-model-file`` prices the DCN hop, and
+``--burst-factor F`` makes the synthetic arrivals bursty (the traffic
+shape the split-pool topology exists for).  The summary line then
+carries the migration/handoff facts (``migrated``, ``handoff_p99_ms``,
+``split``).
+
 Resilience (docs/RESILIENCE.md): ``--deadline-ms D`` stamps every
 synthetic request with a queue deadline (expired requests are rejected
 truthfully and counted); ``--serve-drain-file F`` + SIGTERM drains
@@ -56,6 +67,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         hidden=64, heads=4, ff_dim=128, num_layers=2, vocab=256, seq=64,
         traffic_seed=0, tenants=1, shared_prefix=0, interactive_frac=0.0,
         deadline_ms=0.0, resume_drain=None,
+        disagg=False, disagg_decode_slots=0, burst_factor=1.0,
     )
     i = 0
     while i < len(rest):
@@ -98,6 +110,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             opts["deadline_ms"] = float(take())
         elif a == "--resume-drain":
             opts["resume_drain"] = take()
+        elif a == "--disagg":
+            opts["disagg"] = True
+        elif a == "--disagg-decode-slots":
+            opts["disagg_decode_slots"] = int(take())
+        elif a == "--burst-factor":
+            opts["burst_factor"] = float(take())
         elif a in ("-h", "--help"):
             print(__doc__, file=sys.stderr)
             return 0
@@ -105,6 +123,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"--serve: unknown flag {a!r}", file=sys.stderr)
             return 2
         i += 1
+
+    if opts["disagg"] and opts["resume_drain"]:
+        print("--serve: --resume-drain is a colocated-engine flag "
+              "(incompatible with --disagg)", file=sys.stderr)
+        return 2
 
     from flexflow_tpu import FFModel
     from flexflow_tpu.models.transformer import gpt_decoder
@@ -121,32 +144,57 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     model.compile(seed=cfg.rng_seed)
 
-    engine = ServeEngine(
-        model,
-        slots=slots,
-        block_size=cfg.serve_block_size,
-        num_blocks=cfg.serve_num_blocks or None,
-        prefill_chunk=cfg.serve_prefill_chunk,
-        sync_every=cfg.serve_sync_every,
-        metrics_out=cfg.metrics_out,
-        prefix_sharing=cfg.serve_prefix_sharing,
-        spec_k=cfg.serve_spec_k,
-        spec_draft_layers=cfg.serve_spec_draft_layers,
-        watchdog_s=cfg.serve_watchdog_s,
-        shed_after_windows=cfg.serve_shed_windows,
-        slo_ms=cfg.serve_slo_ms,
-        drain_path=cfg.serve_drain_file,
-    )
-    if opts["resume_drain"]:
-        from flexflow_tpu.serve.engine import load_drain
+    if opts["disagg"]:
+        from flexflow_tpu.serve import DisaggregatedCluster
 
-        engine.resume_from_drain(load_drain(opts["resume_drain"]))
+        machine = None
+        if cfg.machine_model_file:
+            from flexflow_tpu.parallel.network import load_machine_model
+
+            machine = load_machine_model(cfg.machine_model_file)
+        engine = DisaggregatedCluster(
+            model,
+            prefill_slots=slots,
+            decode_slots=opts["disagg_decode_slots"] or slots,
+            prefill_block_size=cfg.serve_block_size,
+            decode_block_size=cfg.serve_block_size,
+            prefill_num_blocks=cfg.serve_num_blocks or None,
+            decode_num_blocks=cfg.serve_num_blocks or None,
+            prefill_chunk=cfg.serve_prefill_chunk,
+            sync_every=cfg.serve_sync_every,
+            metrics_out=cfg.metrics_out,
+            prefix_sharing=cfg.serve_prefix_sharing,
+            slo_ms=cfg.serve_slo_ms,
+            machine=machine,
+        )
+    else:
+        engine = ServeEngine(
+            model,
+            slots=slots,
+            block_size=cfg.serve_block_size,
+            num_blocks=cfg.serve_num_blocks or None,
+            prefill_chunk=cfg.serve_prefill_chunk,
+            sync_every=cfg.serve_sync_every,
+            metrics_out=cfg.metrics_out,
+            prefix_sharing=cfg.serve_prefix_sharing,
+            spec_k=cfg.serve_spec_k,
+            spec_draft_layers=cfg.serve_spec_draft_layers,
+            watchdog_s=cfg.serve_watchdog_s,
+            shed_after_windows=cfg.serve_shed_windows,
+            slo_ms=cfg.serve_slo_ms,
+            drain_path=cfg.serve_drain_file,
+        )
+        if opts["resume_drain"]:
+            from flexflow_tpu.serve.engine import load_drain
+
+            engine.resume_from_drain(load_drain(opts["resume_drain"]))
     spec = TrafficSpec(
         n_requests=opts["requests"], seed=opts["traffic_seed"],
         rate_rps=opts["rate"], prompt_len=opts["prompt_len"],
         max_new=opts["gen_len"], vocab=opts["vocab"],
         tenants=opts["tenants"], shared_prefix=opts["shared_prefix"],
         interactive_frac=opts["interactive_frac"],
+        burst_factor=opts["burst_factor"],
     )
     # clamp generated budgets to the compiled position range
     reqs = synthetic_requests(spec)
@@ -168,9 +216,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"v{opts['vocab']} s{opts['seq']}"
         ),
         "slots": slots,
-        "block_size": engine.kv.block_size,
-        "num_blocks": engine.kv.num_blocks,
-        "sync_every": engine.sync_every,
+        "block_size": (
+            engine.decode.kv.block_size if opts["disagg"]
+            else engine.kv.block_size
+        ),
+        "num_blocks": (
+            engine.decode.kv.num_blocks if opts["disagg"]
+            else engine.kv.num_blocks
+        ),
+        "sync_every": (
+            engine.decode.sync_every if opts["disagg"]
+            else engine.sync_every
+        ),
         **report.to_dict(),
     }
     sp = getattr(model.strategy, "serve_price", None)

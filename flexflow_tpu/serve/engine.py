@@ -32,7 +32,6 @@ knob the ServeObjective prices (objective.py).
 from __future__ import annotations
 
 import dataclasses
-import json
 import math
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -59,7 +58,10 @@ __all__ = [
 
 # drain payload schema id (docs/RESILIENCE.md): in-flight KV spills +
 # queue contents, written atomically so a killed drain leaves either
-# nothing or a complete payload
+# nothing or a complete payload.  The flattening/digest machinery is
+# shared with the ffkv/1 handoff wire format (serve/wire.py) — one
+# codec, two framings (a whole engine to disk vs one request over a
+# pool-to-pool transport).
 DRAIN_SCHEMA = "ffdrain/1"
 
 
@@ -68,27 +70,9 @@ def save_drain(path: str, payload: Dict[str, Any]) -> str:
     digest-checked ``.npz`` (the checkpoint writer's temp + fsync +
     ``os.replace`` discipline).  Returns the path written."""
     from flexflow_tpu.model import _write_checkpoint_atomic
+    from flexflow_tpu.serve.wire import flatten_requests
 
-    flat: Dict[str, np.ndarray] = {}
-    metas: List[Dict[str, Any]] = []
-    for i, r in enumerate(payload["requests"]):
-        flat[f"r{i}/prompt"] = np.asarray(r["prompt"], np.int32)
-        flat[f"r{i}/tokens"] = np.asarray(r["tokens"], np.int64)
-        kv = r.get("kv_spill")
-        if kv is not None:
-            for lname, d in kv["layers"].items():
-                flat[f"r{i}/kv/{lname}/k"] = np.asarray(d["k"])
-                flat[f"r{i}/kv/{lname}/v"] = np.asarray(d["v"])
-        metas.append({
-            "id": int(r["id"]),
-            "max_new_tokens": int(r["max_new_tokens"]),
-            "eos_id": r.get("eos_id"),
-            "tenant": r.get("tenant", "default"),
-            "tier": r.get("tier", "batch"),
-            "deadline_ms": r.get("deadline_ms"),
-            "preemptions": int(r.get("preemptions", 0)),
-            "kv_length": int(kv["length"]) if kv is not None else None,
-        })
+    flat, metas = flatten_requests(payload["requests"])
     return _write_checkpoint_atomic(
         path, flat, {"schema": DRAIN_SCHEMA, "requests": metas},
     )
@@ -100,7 +84,12 @@ def load_drain(path: str) -> Dict[str, Any]:
     torn/corrupt files with the checkpoint loader's truthful errors."""
     import zipfile
 
-    from flexflow_tpu.model import CheckpointError, _checkpoint_digest
+    from flexflow_tpu.model import CheckpointError
+    from flexflow_tpu.serve.wire import (
+        HandoffError,
+        unflatten_requests,
+        verify_flat,
+    )
 
     try:
         with np.load(path) as z:
@@ -110,45 +99,11 @@ def load_drain(path: str) -> Dict[str, Any]:
             f"drain file {path!r} is torn or truncated "
             f"({type(e).__name__}: {e}); refusing to load"
         ) from e
-    raw = flat.pop("meta/manifest", None)
-    if raw is None:
-        raise CheckpointError(
-            f"drain file {path!r} has no manifest — not a "
-            f"{DRAIN_SCHEMA} payload"
-        )
-    manifest = json.loads(raw.tobytes().decode())
-    want, got = manifest.get("digest"), _checkpoint_digest(flat)
-    if want != got:
-        raise CheckpointError(
-            f"drain file {path!r} failed its content-digest check: "
-            f"manifest records {want}, file hashes to {got}; "
-            "refusing to load"
-        )
-    requests: List[Dict[str, Any]] = []
-    for i, meta in enumerate(manifest["requests"]):
-        kv = None
-        if meta.get("kv_length") is not None:
-            layers: Dict[str, Any] = {}
-            j = 0
-            while f"r{i}/kv/layer{j}/k" in flat:
-                layers[f"layer{j}"] = {
-                    "k": flat[f"r{i}/kv/layer{j}/k"],
-                    "v": flat[f"r{i}/kv/layer{j}/v"],
-                }
-                j += 1
-            kv = {"length": int(meta["kv_length"]), "layers": layers}
-        requests.append({
-            "id": meta["id"],
-            "prompt": flat[f"r{i}/prompt"],
-            "max_new_tokens": meta["max_new_tokens"],
-            "eos_id": meta.get("eos_id"),
-            "tenant": meta.get("tenant", "default"),
-            "tier": meta.get("tier", "batch"),
-            "deadline_ms": meta.get("deadline_ms"),
-            "preemptions": meta.get("preemptions", 0),
-            "tokens": [int(t) for t in flat[f"r{i}/tokens"]],
-            "kv_spill": kv,
-        })
+    try:
+        manifest = verify_flat(flat, f"drain file {path!r}")
+    except HandoffError as e:
+        raise CheckpointError(str(e)) from e
+    requests = unflatten_requests(flat, manifest["requests"])
     return {"schema": manifest["schema"], "requests": requests}
 
 
@@ -236,6 +191,7 @@ class ServeEngine:
         shed_after_windows: int = 0,
         slo_ms: float = 50.0,
         drain_path: Optional[str] = None,
+        phase: Optional[str] = None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -276,6 +232,15 @@ class ServeEngine:
         )
         self.sched = ContinuousBatchingScheduler(self.slots, self.kv)
         self.metrics = MetricsStream(metrics_out)
+        # disaggregated-pool role (docs/SERVING.md): None = colocated
+        # (the classic engine, records unchanged); "prefill"/"decode"
+        # stamp every window record's serve vocabulary with the pool
+        # the window ran on — ADDITIVE ffmetrics/1, old readers ignore
+        # it and tools/serve_report.py renders a per-phase section
+        self.phase = phase
+        self._handoff_ms_w: List[float] = []
+        self._migrated_blocks_w = 0
+        self._migrated_bytes_w = 0
         self.prefetch_depth = max(1, int(prefetch_depth))
         # search prediction pairing (calibration loop): a strategy from
         # ``unity_search --objective serve`` carries the ServeObjective's
@@ -740,6 +705,15 @@ class ServeEngine:
         the SIGTERM handler calls; also callable directly)."""
         self._drain_requested = True
 
+    def note_handoff(self, ms: float, blocks: int, nbytes: int) -> None:
+        """Record one KV migration landing on this pool (the disagg
+        router calls this at delivery).  Accumulates into the NEXT
+        window record's ``handoff_ms``/``migrated_blocks``/
+        ``handoff_bytes`` serve vocabulary — additive ffmetrics/1."""
+        self._handoff_ms_w.append(float(ms))
+        self._migrated_blocks_w += int(blocks)
+        self._migrated_bytes_w += int(nbytes)
+
     # --- drain / restore (docs/RESILIENCE.md) -------------------------------
     def drain(self) -> Dict[str, Any]:
         """Spill every in-flight slot to host and unload the queues into
@@ -1109,6 +1083,16 @@ class ServeEngine:
                 "preemptions_total": self.sched.preemptions,
                 "tenants": tenants,
             }
+            # disaggregated-pool vocabulary (ADDITIVE — absent on
+            # colocated engines, so pre-r13 streams are unchanged)
+            if self.phase is not None:
+                serve_m["phase"] = self.phase
+            if self._handoff_ms_w:
+                serve_m["handoff_ms"] = [
+                    round(x, 4) for x in self._handoff_ms_w
+                ]
+                serve_m["migrated_blocks"] = self._migrated_blocks_w
+                serve_m["handoff_bytes"] = self._migrated_bytes_w
             if self.spec_k:
                 serve_m["spec"] = {
                     "k": self.spec_k,
@@ -1127,6 +1111,11 @@ class ServeEngine:
                 predicted_tok_s=self.predicted_tok_s,
                 metrics={"serve": serve_m},
             ))
+        # handoff accumulators are per-window whether or not a metrics
+        # stream is attached
+        self._handoff_ms_w = []
+        self._migrated_blocks_w = 0
+        self._migrated_bytes_w = 0
 
     def _finish_if_done(self, req: Request, tok: int) -> None:
         if req.eos_id is not None and tok == req.eos_id:

@@ -430,7 +430,13 @@ class PagedKVCache:
         per-layer payload (checkpoint convention: ``layer{i} -> {k, v}``
         arrays of shape ``(H, length, D)``, dtype preserved) and release
         the reservation.  The payload + :meth:`restore` round-trip is
-        bit-exact, so a preempted request resumes its exact stream."""
+        bit-exact, so a preempted request resumes its exact stream.
+
+        The payload is DENSE — it carries no trace of this pool's
+        ``block_size``/``num_blocks`` geometry, so it restores into a
+        pool with a *different* geometry (the disagg prefill→decode
+        handoff, serve/wire.py); only the model shape (layers, heads,
+        head_dim) must match, which :meth:`restore` checks."""
         k, v = self.gather_dense(slot, length)
         payload = {
             "length": int(length),
@@ -448,7 +454,15 @@ class PagedKVCache:
         the index re-attach — their contents are provably identical to
         the spilled data at those positions) and scatter the private
         remainder of the payload back into the fresh blocks.  Returns
-        the re-attached shared length in positions."""
+        the re-attached shared length in positions.
+
+        The payload may come from a pool with a DIFFERENT
+        ``block_size``/``num_blocks`` geometry (it is dense per layer —
+        see :meth:`spill`): re-chunking happens here against THIS
+        pool's block size, and the cross-geometry property test pins
+        the round trip bit-exact.  Only the model shape must agree —
+        a payload whose (layers, heads, head_dim) differ is refused
+        before any block is written."""
         import jax.numpy as jnp
 
         self.reserve(slot, seq_len, prompt=prompt)
@@ -469,6 +483,14 @@ class PagedKVCache:
         v = np.stack([
             np.asarray(payload["layers"][f"layer{i}"]["v"]) for i in range(L)
         ])
+        if k.shape != (L, H, length, D) or v.shape != k.shape:
+            self.release(slot)
+            raise ValueError(
+                f"KV payload shape {k.shape} does not match this pool's "
+                f"model shape (layers={L}, heads={H}, length={length}, "
+                f"head_dim={D}) — payloads are portable across block "
+                f"geometries, not across model shapes"
+            )
         if pad:
             zeros = np.zeros((L, H, pad, D), k.dtype)
             k = np.concatenate([k, zeros], axis=2)

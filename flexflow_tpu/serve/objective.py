@@ -56,6 +56,13 @@ class ServeSpec:
     spec_k: int = 0
     spec_accept: float = 0.7  # expected per-draft acceptance probability
     spec_draft_frac: float = 0.5  # draft-slice depth / full depth
+    # disaggregated prefill/decode arm (docs/SERVING.md): when True and
+    # the machine model has >= 2 slices, ``unity_search`` additionally
+    # prices every slice split into a prefill pool + a decode pool
+    # (each pool gets its own mesh/strategy search on its submesh, the
+    # KV handoff priced on the DCN) and attaches the best split as
+    # ``serve_price["disagg"]``
+    disagg: bool = False
 
 
 class ServeObjective:

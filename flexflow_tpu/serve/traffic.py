@@ -19,6 +19,17 @@ tier; ``interactive_frac`` marks that fraction of tenants (rounded up,
 at least one when positive) as the latency tier.  All of it is seeded
 and identity-stamped; the default values keep ``identity`` byte-equal
 to the single-tenant string older records pinned.
+
+**Bursty arrivals (PR 13).**  Real traffic is not Poisson — it clumps.
+``burst_factor > 1`` Markov-modulates the arrival process between an ON
+state (rate x burst_factor) and an OFF state (rate / burst_factor),
+flipping with probability 1/4 per arrival: same long-run mean rate,
+much heavier short-term clumps.  Bursts are what make colocated
+prefill/decode interference visible (a clump of arrivals floods the
+shared engine with prefill chunks exactly when the running decodes need
+the step) — the disaggregated A/B uses this shape.  The default
+``burst_factor=1.0`` takes the legacy code path and consumes exactly
+the legacy rng draws, so existing streams stay byte-identical.
 """
 
 from __future__ import annotations
@@ -31,6 +42,37 @@ import numpy as np
 from flexflow_tpu.serve.scheduler import Request
 
 __all__ = ["TrafficSpec", "synthetic_requests", "multi_tenant_requests"]
+
+
+class _ArrivalClock:
+    """Draws one arrival time per call.  The ``burst_factor == 1.0``
+    branch consumes exactly the legacy draws (one exponential per
+    arrival, nothing else), so default-spec token streams stay
+    byte-identical to pre-burst records; the bursty branch adds one
+    uniform draw per arrival for the on/off flip."""
+
+    _FLIP_P = 0.25  # per-arrival state-flip probability
+
+    def __init__(self, spec: TrafficSpec, rng: np.random.Generator) -> None:
+        assert spec.burst_factor > 0, spec.burst_factor
+        self._spec, self._rng = spec, rng
+        self._t = 0.0
+        self._on = True  # bursts start hot — the worst case arrives first
+
+    def next(self) -> float:
+        spec, rng = self._spec, self._rng
+        if spec.rate_rps <= 0:
+            return self._t  # everything at t=0 (batch-saturation shape)
+        if spec.burst_factor == 1.0:
+            self._t += float(rng.exponential(1.0 / spec.rate_rps))
+            return self._t
+        if rng.random() < self._FLIP_P:
+            self._on = not self._on
+        rate = spec.rate_rps * (
+            spec.burst_factor if self._on else 1.0 / spec.burst_factor
+        )
+        self._t += float(rng.exponential(1.0 / rate))
+        return self._t
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,12 +91,15 @@ class TrafficSpec:
     tenants: int = 1
     shared_prefix: int = 0  # per-tenant system-prompt tokens
     interactive_frac: float = 0.0  # fraction of tenants on the SLO tier
+    # Markov-modulated on/off burstiness (1.0 = plain Poisson; only
+    # meaningful when rate_rps > 0)
+    burst_factor: float = 1.0
 
     @property
     def identity(self) -> str:
-        """The bench-record metadata string (seed + shape).  Tenant
-        fields append ONLY when non-default — pre-PR-11 records compare
-        as the same workload."""
+        """The bench-record metadata string (seed + shape).  Tenant and
+        burst fields append ONLY when non-default — pre-PR-11/13
+        records compare as the same workload."""
         s = (
             f"seed{self.seed}/n{self.n_requests}"
             f"/p{self.prompt_len[0]}-{self.prompt_len[1]}"
@@ -66,6 +111,8 @@ class TrafficSpec:
                 f"/t{self.tenants}/sp{self.shared_prefix}"
                 f"/i{self.interactive_frac:g}"
             )
+        if self.burst_factor != 1.0:
+            s += f"/b{self.burst_factor:g}"
         return s
 
 
@@ -76,11 +123,10 @@ def synthetic_requests(spec: TrafficSpec) -> List[Request]:
     if spec.tenants != 1 or spec.shared_prefix or spec.interactive_frac:
         return multi_tenant_requests(spec)
     rng = np.random.default_rng(spec.seed)
+    clock = _ArrivalClock(spec, rng)
     out: List[Request] = []
-    t = 0.0
     for i in range(spec.n_requests):
-        if spec.rate_rps > 0:
-            t += float(rng.exponential(1.0 / spec.rate_rps))
+        t = clock.next()
         plen = int(rng.integers(spec.prompt_len[0], spec.prompt_len[1] + 1))
         gen = int(rng.integers(spec.max_new[0], spec.max_new[1] + 1))
         prompt = rng.integers(0, spec.vocab, size=(plen,)).astype(np.int32)
@@ -108,11 +154,10 @@ def multi_tenant_requests(spec: TrafficSpec) -> List[Request]:
         )
         for _ in range(nt)
     ]
+    clock = _ArrivalClock(spec, rng)
     out: List[Request] = []
-    t = 0.0
     for i in range(spec.n_requests):
-        if spec.rate_rps > 0:
-            t += float(rng.exponential(1.0 / spec.rate_rps))
+        t = clock.next()
         j = i % nt
         plen = int(rng.integers(spec.prompt_len[0], spec.prompt_len[1] + 1))
         gen = int(rng.integers(spec.max_new[0], spec.max_new[1] + 1))

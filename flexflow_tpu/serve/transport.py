@@ -1,0 +1,108 @@
+"""Pluggable pool-to-pool handoff transport (docs/SERVING.md,
+"Disaggregated prefill/decode").
+
+The contract is deliberately tiny — a transport moves opaque ``ffkv/1``
+frames (serve/wire.py) from the prefill pool to the decode pool:
+
+* :meth:`Transport.try_send` enqueues one frame with a delivery delay
+  (the DCN price the cluster computes from its
+  :class:`~flexflow_tpu.parallel.network.NetworkedMachineModel`);
+  returns ``False`` when the bounded queue is full — backpressure the
+  router absorbs by holding the spilled payload and retrying next loop
+  iteration, exactly what a full DCN send buffer does to a real router.
+* :meth:`Transport.recv_ready` pops, in FIFO order, every frame whose
+  delivery delay has elapsed at ``now`` (the cluster's run-relative
+  clock).  Frames are delivered at-most-once, in order.
+
+``InProcessTransport`` is the CPU-CI implementation: a bounded deque
+carrying the SAME wire bytes a real DCN transport would (encode →
+bytes → decode with digest verification — nothing shortcuts the
+serialization), with the priced latency injected as the delivery gate
+so CPU smoke reflects DCN cost.  A real multi-host transport plugs in
+behind the same three methods.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import List, Tuple
+
+__all__ = ["Transport", "InProcessTransport", "TransportFull"]
+
+
+class TransportFull(RuntimeError):
+    """Raised by :meth:`Transport.send` (the non-try variant) when the
+    bounded queue is full.  Routers should prefer :meth:`try_send` and
+    treat ``False`` as backpressure."""
+
+
+class Transport:
+    """Abstract handoff channel; see module docstring for the contract."""
+
+    def try_send(
+        self, frame: bytes, *, now: float, delay_s: float = 0.0,
+    ) -> bool:
+        raise NotImplementedError
+
+    def send(self, frame: bytes, *, now: float, delay_s: float = 0.0) -> None:
+        if not self.try_send(frame, now=now, delay_s=delay_s):
+            raise TransportFull(
+                f"handoff queue full ({self.pending()} frames in flight)"
+            )
+
+    def recv_ready(self, now: float) -> List[bytes]:
+        raise NotImplementedError
+
+    def pending(self) -> int:
+        raise NotImplementedError
+
+
+class InProcessTransport(Transport):
+    """Bounded in-process queue carrying real ``ffkv/1`` wire bytes.
+
+    ``capacity`` bounds the frames in flight (a DCN send buffer is
+    finite; an unbounded queue would hide prefill-pool overrun).  Each
+    frame is stamped ``ready_at = now + delay_s`` at send; delivery is
+    FIFO among the frames whose stamp has passed — deterministic given
+    the caller's clock, which is what lets tests pin handoff behavior.
+    """
+
+    def __init__(self, capacity: int = 16) -> None:
+        assert capacity >= 1
+        self.capacity = int(capacity)
+        self._q: deque = deque()  # (ready_at_s, frame_bytes)
+        # observability (the serve report / ffcheck audit read these)
+        self.frames_sent = 0
+        self.frames_delivered = 0
+        self.bytes_sent = 0
+        self.send_rejects = 0  # backpressure events
+
+    def try_send(
+        self, frame: bytes, *, now: float, delay_s: float = 0.0,
+    ) -> bool:
+        if len(self._q) >= self.capacity:
+            self.send_rejects += 1
+            return False
+        self._q.append((float(now) + float(delay_s), bytes(frame)))
+        self.frames_sent += 1
+        self.bytes_sent += len(frame)
+        return True
+
+    def recv_ready(self, now: float) -> List[bytes]:
+        out: List[bytes] = []
+        # FIFO: stop at the first undelivered frame so ordering holds
+        # even when a later frame's delay is shorter (DCN reordering is
+        # a problem we choose not to have — one logical channel)
+        while self._q and self._q[0][0] <= now:
+            out.append(self._q.popleft()[1])
+        self.frames_delivered += len(out)
+        return out
+
+    def pending(self) -> int:
+        return len(self._q)
+
+    def in_flight(self) -> List[Tuple[float, bytes]]:
+        """Snapshot of undelivered (ready_at, frame) pairs — what the
+        ffcheck handoff audit digest-verifies without disturbing the
+        queue."""
+        return list(self._q)

@@ -84,6 +84,13 @@ COMPARABLE_METADATA = (
     # fault spec — a different plan kills the run at a different step,
     # shifting recovery_s for configuration (not regression) reasons
     "fault_plan",
+    # serve_handoff_ms / serve_disagg_split (r13, docs/SERVING.md
+    # "Disaggregated prefill/decode"): the disagg A/B's priced KV
+    # handoff p99 and its pool split — a different split or a
+    # re-priced DCN shifts the handoff for topology (not regression)
+    # reasons, so the gate surfaces the change and still compares
+    "serve_handoff_ms",
+    "serve_disagg_split",
 )
 
 # (label, path into the record, higher_is_better) — the gated metrics.
@@ -113,6 +120,12 @@ GATED = (
     # registered blocks (hash keying or CoW regression), which silently
     # halves admissible concurrency long before throughput notices
     ("serve_prefix_hit_rate", ("serve_prefix_hit_rate",), True),
+    # serve_disagg_p99_tpot_ms (r13, docs/SERVING.md "Disaggregated
+    # prefill/decode") gates LOWER-is-better: the decode pool's p99
+    # per-token window latency under bursty traffic — the number the
+    # split-pool topology exists to protect; it growing means prefill
+    # work leaked back into decode windows or the handoff got slower
+    ("serve_disagg_p99_tpot_ms", ("serve_disagg_p99_tpot_ms",), False),
     ("dlrm", ("secondary", "dlrm", "samples_per_sec"), True),
     ("bert_large", ("secondary", "bert_large", "samples_per_sec"), True),
     ("gpt_decode_cached", ("secondary", "gpt_decode", "cached_tok_per_s"), True),

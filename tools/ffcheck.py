@@ -16,6 +16,10 @@ over what the compiler actually produced:
   * ``stacked_bert`` — scan-stacked encoder, dp2 x tp4 (fit)
   * ``pipelined``    — searched 2-stage 1F1B pipeline on the 2-slice
                        machine model, real stage submeshes (fit)
+  * ``disagg``       — disaggregated prefill/decode cluster after a
+                       small workload: both pools' serve programs plus
+                       the ffkv/1 handoff audit (digest, cross-pool
+                       donation, duplicate-request)
 
 Exit status: 0 when every analyzed program is clean, 1 when any check
 reports a violation (``--strict`` additionally raises on the spot so
@@ -43,7 +47,8 @@ sys.path.insert(
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CONFIGS = ("mlp", "dlrm", "gpt_decode", "stacked_bert", "pipelined")
+CONFIGS = ("mlp", "dlrm", "gpt_decode", "stacked_bert", "pipelined",
+           "disagg")
 
 
 def _build_mlp():
@@ -166,6 +171,37 @@ def analyze_config(name: str, checks=None):
         gm.compile(seed=0)
         eng = ServeEngine(gm, slots=slots, block_size=8, sync_every=4)
         report = analyze_serve_engine(eng, checks=checks)
+    elif name == "disagg":
+        from flexflow_tpu import FFConfig, FFModel
+        from flexflow_tpu.analysis import analyze_disagg_cluster
+        from flexflow_tpu.models.transformer import gpt_decoder
+        from flexflow_tpu.parallel.network import load_machine_model
+        from flexflow_tpu.serve import (
+            DisaggregatedCluster,
+            TrafficSpec,
+            synthetic_requests,
+        )
+
+        slots, seq, vocab = 4, 48, 31
+        gm = FFModel(FFConfig(batch_size=slots))
+        gpt_decoder(gm, slots, seq, use_flash=False, hidden=32, heads=4,
+                    ff_dim=64, num_layers=2, vocab=vocab)
+        gm.compile(seed=0)
+        machine = load_machine_model(os.path.join(
+            REPO, "examples", "machine_configs", "v5p_2slice.json"
+        ))
+        cluster = DisaggregatedCluster(
+            gm, prefill_slots=slots, decode_slots=slots,
+            prefill_block_size=8, decode_block_size=16,
+            sync_every=4, machine=machine,
+        )
+        # run a small workload so the handoff audit has real frames
+        # (migrations, digests, both pools' allocators exercised)
+        cluster.run(synthetic_requests(TrafficSpec(
+            n_requests=6, seed=1, prompt_len=(4, 10), max_new=(3, 8),
+            vocab=vocab,
+        )))
+        report = analyze_disagg_cluster(cluster, checks=checks)
     else:
         builder = {
             "mlp": _build_mlp,

@@ -144,6 +144,54 @@ def render(records: List[Dict], max_windows: int = 30) -> str:
     if facts:
         out.append("\n".join(facts))
 
+    # --- disaggregated prefill/decode (PR 13; additive vocabulary) ---
+    # pool windows carry serve.phase ("prefill"/"decode") and deliveries
+    # carry handoff_ms/migrated_blocks/handoff_bytes; a pre-r13 stream
+    # has neither key and this whole section stays absent
+    phased = [(r, s) for r, s in serve if s.get("phase") is not None]
+    if phased:
+        rows = []
+        for phase in ("prefill", "decode"):
+            ws = [(r, s) for r, s in phased if s["phase"] == phase]
+            if not ws:
+                continue
+            p_occ = [s.get("occupancy", 0.0) for _, s in ws]
+            p_tok = sum(
+                int(round((r.get("tokens_per_s") or 0.0)
+                          * (r.get("step_wall_s") or 0.0)))
+                for r, _ in ws
+            )
+            rows.append([
+                phase, len(ws),
+                f"{sum(p_occ) / len(p_occ):.3f}",
+                sum(s.get("decode_steps", 0) for _, s in ws),
+                sum(s.get("prefill_chunks", 0) for _, s in ws),
+                p_tok,
+            ])
+        lines = [
+            "disaggregated pools (docs/SERVING.md \"Disaggregated "
+            "prefill/decode\"):\n"
+            + _table(
+                ["phase", "windows", "occ_mean", "decode", "prefill",
+                 "tokens"],
+                rows,
+            )
+        ]
+        handoffs = [
+            ms for _, s in phased for ms in s.get("handoff_ms", ())
+        ]
+        if handoffs:
+            blocks = sum(s.get("migrated_blocks", 0) for _, s in phased)
+            nbytes = sum(s.get("handoff_bytes", 0) for _, s in phased)
+            lines.append(
+                f"KV handoff: {len(handoffs)} migrations, latency "
+                f"p50 {_pct(handoffs, 50):.3f} ms / "
+                f"p99 {_pct(handoffs, 99):.3f} ms / "
+                f"max {max(handoffs):.3f} ms; "
+                f"{blocks} blocks, {nbytes} wire bytes"
+            )
+        out.append("\n".join(lines))
+
     # per-tenant latency table — only when any record names a tenant
     by_tenant: Dict[str, Dict] = {}
     for f in finished:

@@ -1096,6 +1096,135 @@ def _serve_disagg_ab(on_tpu: bool) -> dict:
     }
 
 
+def _serve_paged_attn_ab(on_tpu: bool) -> dict:
+    """Paged-attention A/B (ISSUE 14 acceptance, docs/PERF.md "Paged
+    decode attention"): the SAME model serves the SAME workload through
+    two engines — the dense-gather decode path vs the fused Pallas
+    paged-attention kernel — and the facts gated are (1) every
+    request's token stream is bit-identical across arms and (2) the
+    decode program's peak live temp bytes (XLA's
+    ``memory_analysis()``, the same source the measured-memory search
+    tier reads) are strictly LOWER with the kernel
+    (``serve_paged_attn_peak_mb``, lower-is-better).
+
+    The pool is deliberately undersized relative to the compiled
+    position range (few live blocks, long virtual length): the dense
+    path materializes its per-layer gather at the FULL virtual length
+    ``SV = MB * BS`` regardless of how many blocks are live — exactly
+    the waste the block-table-native kernel removes.  Off-TPU the
+    kernel runs in interpreter mode (tok/s is reported but ungated —
+    interpret emulation speed is not kernel speed; the real-chip
+    numbers ride tools/chip_recovery.sh)."""
+    import time as _time
+
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.transformer import gpt_decoder
+    from flexflow_tpu.ops.pallas import paged_attention as pa
+    from flexflow_tpu.serve import Request, ServeEngine
+
+    slots = 6
+    seq = 1024 if on_tpu else 512
+    shape = (
+        dict(hidden=512, heads=8, ff_dim=2048, num_layers=6)
+        if on_tpu
+        else dict(hidden=64, heads=4, ff_dim=128, num_layers=2)
+    )
+    vocab = 32000 if on_tpu else 256
+    block_size = 16 if on_tpu else 8
+    # live blocks ~ the workload's working set; virtual length = seq
+    num_blocks = 48 + 1
+    n_requests, max_new = 6, 8
+
+    def build():
+        cfg = FFConfig(
+            batch_size=slots,
+            compute_dtype="bfloat16" if on_tpu else "float32",
+        )
+        model = FFModel(cfg)
+        gpt_decoder(
+            model, slots, seq, vocab=vocab, use_flash=False, **shape
+        )
+        model.compile(seed=0)
+        return model
+
+    def workload():
+        rng = np.random.default_rng(0)
+        reqs = []
+        for i in range(n_requests):
+            plen = int(rng.integers(4, 14))
+            reqs.append(Request(
+                prompt=rng.integers(0, vocab, size=(plen,)).astype(
+                    np.int32
+                ),
+                max_new_tokens=max_new, id=i,
+            ))
+        return reqs
+
+    def decode_peak_bytes(engine) -> int:
+        import jax.numpy as jnp
+
+        B, MB = engine.slots, engine.kv.max_blocks_per_seq
+        z = jnp.zeros((B,), jnp.int32)
+        bt0 = jnp.zeros((B, MB), jnp.int32)
+        compiled = engine._decode.lower(
+            engine.model.executor.params, engine.kv.cache_k,
+            engine.kv.cache_v, z, z, bt0,
+        ).compile()
+        ma = compiled.memory_analysis()
+        return int(ma.temp_size_in_bytes)
+
+    old_interpret = pa.INTERPRET
+    if not on_tpu:
+        pa.INTERPRET = True  # the only way the kernel runs off-TPU
+    try:
+        results = {}
+        for label in ("gather", "paged"):
+            engine = ServeEngine(
+                build(), slots=slots, block_size=block_size,
+                num_blocks=num_blocks, sync_every=4, attn=label,
+            )
+            t0 = _time.perf_counter()
+            rep = engine.run(workload())
+            wall = _time.perf_counter() - t0
+            streams = {
+                r.id: np.asarray(r.tokens, np.int32)
+                for r in engine.sched.finished
+            }
+            results[label] = (rep, streams, decode_peak_bytes(engine),
+                              wall)
+    finally:
+        pa.INTERPRET = old_interpret
+
+    rep_g, out_g, peak_g, wall_g = results["gather"]
+    rep_p, out_p, peak_p, wall_p = results["paged"]
+    outputs_match = (
+        set(out_g) == set(out_p) == set(range(n_requests))
+        and all(np.array_equal(out_g[i], out_p[i]) for i in out_g)
+    )
+    return {
+        "config": (
+            f"{'mid' if on_tpu else 'tiny'} gpt sv={seq} "
+            f"pool={num_blocks - 1}blk bs={block_size} "
+            f"{n_requests} reqs {'native' if on_tpu else 'interpret'}"
+        ),
+        "serve_attn": "paged",
+        "serve_paged_attn_peak_mb": round(peak_p / 1e6, 4),
+        "gather_peak_mb": round(peak_g / 1e6, 4),
+        "peak_ratio": round(peak_p / peak_g, 4) if peak_g else None,
+        "outputs_match": bool(outputs_match),
+        "serve_tok_s_paged": (
+            round(rep_p.new_tokens / wall_p, 2) if wall_p else None
+        ),
+        "serve_tok_s_gather": (
+            round(rep_g.new_tokens / wall_g, 2) if wall_g else None
+        ),
+        "windows": rep_p.windows,
+        "host_syncs": rep_p.host_syncs,
+    }
+
+
 def _recovery_ab(on_tpu: bool) -> dict:
     """Kill-and-resume A/B (ISSUE 12 acceptance): train a tiny model to
     completion (arm A), then re-run it with a deterministic injected
@@ -1208,6 +1337,7 @@ def _bench_secondary(on_tpu: bool) -> dict:
         ("serve_prefix_ab", _serve_prefix_ab),
         ("serve_spec_ab", _serve_spec_ab),
         ("serve_disagg_ab", _serve_disagg_ab),
+        ("serve_paged_attn_ab", _serve_paged_attn_ab),
         ("recovery_ab", _recovery_ab),
     ):
         try:
@@ -1436,6 +1566,13 @@ def run_bench(backend: str) -> None:
         "serve_disagg_p99_tpot_ms": None,
         "serve_handoff_ms": None,
         "serve_disagg_split": None,
+        # paged decode attention (ISSUE 14, docs/PERF.md "Paged decode
+        # attention"): the paged decode program's peak live temp bytes
+        # (LOWER-is-better gate — the gather materialization coming
+        # back shows up here first) and the decode-attention kernel as
+        # comparable metadata
+        "serve_paged_attn_peak_mb": None,
+        "serve_attn": None,
         # resilience (ISSUE 12, docs/RESILIENCE.md): checkpoint-restore
         # wall time (LOWER-is-better), the kill-and-resume bit-identity
         # bit (gated AT TRUE), and the injected fault plan (comparable
@@ -1512,6 +1649,9 @@ def run_bench(backend: str) -> None:
     record["serve_disagg_p99_tpot_ms"] = dab.get("serve_disagg_p99_tpot_ms")
     record["serve_handoff_ms"] = dab.get("serve_handoff_ms")
     record["serve_disagg_split"] = dab.get("serve_disagg_split")
+    qab = record["secondary"].get("serve_paged_attn_ab") or {}
+    record["serve_paged_attn_peak_mb"] = qab.get("serve_paged_attn_peak_mb")
+    record["serve_attn"] = qab.get("serve_attn")
     rab = record["secondary"].get("recovery_ab") or {}
     record["recovery_s"] = rab.get("recovery_s")
     record["resume_replay_exact"] = rab.get("resume_replay_exact")

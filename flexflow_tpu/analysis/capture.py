@@ -57,6 +57,7 @@ def capture_jit(
     implied: Any = None,
     expects_donation: bool = True,
     param_shardings: Any = None,
+    details: Any = None,
 ) -> ProgramArtifact:
     """Build an artifact from one jitted callable + example args.
     ``compiled`` reuses an existing AOT executable; otherwise the
@@ -91,6 +92,7 @@ def capture_jit(
         implied=implied,
         expects_donation=expects_donation,
         param_shardings=param_shardings,
+        details=details or {},
     )
 
 
@@ -286,6 +288,15 @@ def analyze_serve_engine(
             ("params", "cache_k", "cache_v", "toks", "pos0",
              "block_tables"),
         ))
+    # pool geometry + the engine's resolved attention kernel ride the
+    # artifact so the ``paged_attn`` audit can size its materialization
+    # threshold (one lane's virtual-length K/V bytes) and knows which
+    # programs CLAIM to be gather-free
+    serve_details = {
+        "serve_attn": getattr(engine, "attn_kernel", "gather"),
+        "max_blocks_per_seq": MB,
+        "block_size": kv.block_size,
+    }
     for name, jitted, args, names in programs:
         art = capture_jit(
             name,
@@ -295,6 +306,7 @@ def analyze_serve_engine(
             arg_names=names,
             mesh=ex.mesh,
             compute_dtype=dt,
+            details=serve_details,
         )
         report.add_program(art.name)
         report.extend(analyze_program(art, checks))

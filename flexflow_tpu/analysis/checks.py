@@ -222,6 +222,78 @@ def check_serve_cow(artifact: ProgramArtifact) -> List[Violation]:
     return []
 
 
+@register_check("paged_attn")
+def check_paged_attn(artifact: ProgramArtifact) -> List[Violation]:
+    """Structural proof the paged-attention fusion happened: a serve
+    program that CLAIMS the fused Pallas kernel (docs/PERF.md "Paged
+    decode attention") must lower no pool-sized gather — the dense
+    fallback's per-layer ``pool[tables]`` materializes a (B, MB, H, BS,
+    D) buffer, so any gather/take whose output is at least ONE lane's
+    virtual-length K/V bytes (``MB * BS * H * D * itemsize``) means the
+    gather is still in the program.
+
+    Total: artifacts without a ``serve_attn: "paged"`` detail (gather
+    engines, non-serve programs), without a jaxpr, or without a K/V
+    pool input all skip.  Prefill keeps the dense gather by design
+    (compute-bound, one slot at a time) and is skipped by role.  Small
+    gathers (embedding lookups, per-page dynamic slices from the
+    kernel's own lowering) sit far below the threshold and pass."""
+    det = artifact.details or {}
+    if det.get("serve_attn") != "paged":
+        return []
+    if artifact.role not in ("decode", "draft", "verify"):
+        return []
+    if artifact.jaxpr is None:
+        return []
+    # one lane's virtual-length K/V bytes from the pool operand's
+    # (L, N, H, BS, D) shape + the table geometry
+    mb = det.get("max_blocks_per_seq")
+    pool = next(
+        (
+            (shape, dtype)
+            for label, shape, dtype, _ in artifact.inputs
+            if label == "cache_k" and len(shape) == 5
+        ),
+        None,
+    )
+    if not mb or pool is None:
+        return []
+    (_, _, h, bs, d), pool_dtype = pool
+    lane_bytes = int(mb) * h * bs * d * _dtype_bytes(pool_dtype)
+    out: List[Violation] = []
+    for eqn in walk_jaxpr_eqns(artifact.jaxpr):
+        if eqn.primitive.name not in ("gather", "take"):
+            continue
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            if aval is None or not hasattr(aval, "shape"):
+                continue
+            nbytes = math.prod(aval.shape) * _dtype_bytes(
+                str(getattr(aval, "dtype", "float32"))
+            )
+            if nbytes >= lane_bytes:
+                out.append(Violation(
+                    check="paged_attn",
+                    severity="error",
+                    program=artifact.name,
+                    message=(
+                        f"paged decode program still materializes a "
+                        f"pool-sized gather: {eqn.primitive.name} -> "
+                        f"{tuple(aval.shape)} ({nbytes} bytes >= "
+                        f"{lane_bytes} = one lane's virtual-length "
+                        f"K/V) — the dense fallback's page gather "
+                        f"survived lowering"
+                    ),
+                    where=(eqn_where(eqn) or eqn.primitive.name),
+                    details={
+                        "output_shape": list(aval.shape),
+                        "nbytes": nbytes,
+                        "lane_kv_bytes": lane_bytes,
+                    },
+                ))
+    return out
+
+
 @register_check("replication")
 def check_replication(artifact: ProgramArtifact) -> List[Violation]:
     """Operands lowered fully replicated when the strategy says sharded:

@@ -188,6 +188,9 @@ class FFConfig:
     serve_sync_every: int = 4  # decode steps per flush window
     serve_slo_ms: float = 50.0  # p99 per-token latency SLO (objective)
     serve_prefix_sharing: bool = True  # CoW prefix-block sharing
+    # decode-attention kernel: "auto" = fused Pallas paged attention
+    # where it can run (TPU / interpret), dense gather otherwise
+    serve_attn: str = "auto"  # auto | gather | paged
     serve_spec_k: int = 0  # speculative draft depth (0 = off)
     serve_spec_draft_layers: int = 0  # draft slice depth (0 = half)
     serve_spec_accept: float = 0.7  # priced per-draft acceptance prob.
@@ -379,6 +382,8 @@ class FFConfig:
                 self.serve_prefix_sharing = take().lower() in (
                     "1", "true", "on", "yes",
                 )
+            elif a == "--serve-attn":
+                self.serve_attn = take()
             elif a == "--serve-spec-k":
                 self.serve_spec_k = int(take())
             elif a == "--serve-spec-draft-layers":

@@ -943,6 +943,14 @@ class FFModel:
                         ),
                         slo_p99_ms=cfg.serve_slo_ms,
                         sync_every=cfg.serve_sync_every,
+                        # price the arm the engine will run: auto
+                        # resolves to paged on the TPU deployments the
+                        # search targets, so only an explicit gather
+                        # prices the dense materialization
+                        attn=(
+                            "gather" if cfg.serve_attn == "gather"
+                            else "paged"
+                        ),
                         spec_k=cfg.serve_spec_k,
                         spec_accept=cfg.serve_spec_accept,
                         spec_draft_frac=(

@@ -1,0 +1,47 @@
+"""Pallas TPU kernels (flash attention, paged decode attention).
+
+Every kernel module in this package carries a module-level ``INTERPRET``
+flag that routes ``pl.pallas_call`` through the interpreter (the only
+way to run the kernels off-TPU).  The flag's default comes from the
+``FFTPU_PALLAS_INTERPRET`` environment variable via
+:func:`env_interpret`, so CI / tier-1 can force interpreter mode on CPU
+without monkeypatching module globals::
+
+    FFTPU_PALLAS_INTERPRET=1 python -m pytest tests/ ...
+
+Tests that flip the flags in-process (``fa.INTERPRET = True``) keep
+working — the env var only changes the *default* at import time.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["env_interpret"]
+
+_TRUTHY = ("1", "true", "on", "yes")
+_FALSY = ("0", "false", "off", "no")
+
+
+def env_interpret(default: bool = False) -> bool:
+    """Resolve the ``FFTPU_PALLAS_INTERPRET`` override.
+
+    Unset -> ``default``; truthy/falsy spellings map accordingly; an
+    unrecognized value warns once and falls back to ``default`` (never
+    raises at import time — the kernels must stay importable)."""
+    raw = os.environ.get("FFTPU_PALLAS_INTERPRET")
+    if raw is None:
+        return default
+    v = raw.strip().lower()
+    if v in _TRUTHY:
+        return True
+    if v in _FALSY:
+        return False
+    import warnings
+
+    warnings.warn(
+        f"FFTPU_PALLAS_INTERPRET={raw!r} is neither truthy {_TRUTHY} "
+        f"nor falsy {_FALSY}; ignoring (INTERPRET={default})",
+        stacklevel=2,
+    )
+    return default

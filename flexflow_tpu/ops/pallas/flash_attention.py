@@ -43,8 +43,12 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
 
-# Flip to True (tests) to run kernels in interpreter mode on CPU.
-INTERPRET = False
+# Flip to True (tests) to run kernels in interpreter mode on CPU; the
+# FFTPU_PALLAS_INTERPRET env var sets the import-time default so CI can
+# force interpreter mode without monkeypatching the global.
+from flexflow_tpu.ops.pallas import env_interpret
+
+INTERPRET = env_interpret()
 
 
 def _uniform01(seed_u32, bh_u32, q_pos, k_pos):

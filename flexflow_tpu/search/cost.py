@@ -1096,6 +1096,7 @@ def estimate_decode_step_time(
     kv_len: int,
     train_tokens: int,
     mxu_util: float = 0.5,
+    attn_kernel: str = "paged",
 ) -> Dict[str, float]:
     """Analytic ONE-token decode step time under a strategy — the
     serving analog of :func:`estimate_strategy_cost` (docs/SERVING.md,
@@ -1115,6 +1116,15 @@ def estimate_decode_step_time(
     by ``slots / train_tokens`` (the graph carries (B, S, H) tensors;
     a decode step moves one token per slot).  Pure host math —
     deterministic, golden-testable, no TPU required.
+
+    ``attn_kernel`` prices the engine's decode-attention path
+    (docs/PERF.md "Paged decode attention"): ``"paged"`` (default, the
+    fused Pallas kernel) reads each K/V page exactly once, so the
+    attention term is the bare ``2 * slots * kv_len * e`` byte stream;
+    ``"gather"`` (the dense fallback) additionally materializes the
+    per-lane page gather every layer — one extra read of the pool
+    pages plus one write of the dense virtual-length buffer before the
+    attention re-reads it, i.e. 3x the K/V bytes.
 
     Returns ``{"step_s", "mem_s", "flops_s", "coll_s"}``.
     """
@@ -1149,7 +1159,13 @@ def estimate_decode_step_time(
             if ws is not None:
                 tp = max(1, ws.total_degree(mesh))
             nb = _dtype_nbytes(layer.outputs[0].dtype)
-            lmem += 2.0 * local_slots * kv_len * e * nb / tp
+            kv_bytes = 2.0 * local_slots * kv_len * e * nb / tp
+            lmem += kv_bytes
+            if attn_kernel == "gather":
+                # dense gather materialization: pool pages read once
+                # more + the virtual-length buffer written before the
+                # attention contraction re-reads it
+                lmem += 2.0 * kv_bytes
             lflops += 2.0 * 2.0 * local_slots * kv_len * e / tp
         mem_s += lmem / m.hbm_bw
         flops_s += lflops / (m.peak_flops * mxu_util)

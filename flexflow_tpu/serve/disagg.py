@@ -107,6 +107,7 @@ class DisaggregatedCluster:
         transport_capacity: int = 16,
         prefix_sharing: bool = True,
         slo_ms: float = 50.0,
+        attn: str = "auto",
     ) -> None:
         self.machine = machine
         self.prefill = ServeEngine(
@@ -120,6 +121,7 @@ class DisaggregatedCluster:
             metrics_out=metrics_out,
             prefix_sharing=prefix_sharing,
             slo_ms=slo_ms,
+            attn=attn,
             phase="prefill",
         )
         self.decode = ServeEngine(
@@ -133,6 +135,7 @@ class DisaggregatedCluster:
             metrics_out=metrics_out,
             prefix_sharing=prefix_sharing,
             slo_ms=slo_ms,
+            attn=attn,
             phase="decode",
         )
         self.transport = (

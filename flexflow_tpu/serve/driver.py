@@ -165,6 +165,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             metrics_out=cfg.metrics_out,
             prefix_sharing=cfg.serve_prefix_sharing,
             slo_ms=cfg.serve_slo_ms,
+            attn=cfg.serve_attn,
             machine=machine,
         )
     else:
@@ -177,6 +178,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             sync_every=cfg.serve_sync_every,
             metrics_out=cfg.metrics_out,
             prefix_sharing=cfg.serve_prefix_sharing,
+            attn=cfg.serve_attn,
             spec_k=cfg.serve_spec_k,
             spec_draft_layers=cfg.serve_spec_draft_layers,
             watchdog_s=cfg.serve_watchdog_s,
@@ -227,6 +229,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         "sync_every": (
             engine.decode.sync_every if opts["disagg"]
             else engine.sync_every
+        ),
+        "attn_kernel": (
+            engine.decode.attn_kernel if opts["disagg"]
+            else engine.attn_kernel
         ),
         **report.to_dict(),
     }

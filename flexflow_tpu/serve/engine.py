@@ -185,6 +185,7 @@ class ServeEngine:
         metrics_out: Optional[str] = None,
         prefetch_depth: int = 2,
         prefix_sharing: bool = True,
+        attn: str = "auto",
         spec_k: int = 0,
         spec_draft_layers: int = 0,
         watchdog_s: float = 0.0,
@@ -223,6 +224,14 @@ class ServeEngine:
             self.spec_draft_layers = max(1, self.spec.num_layers // 2)
         if self.temperature > 0.0:
             self.spec_k = 0
+        # decode-attention kernel (docs/PERF.md "Paged decode
+        # attention"): "auto" resolves to the fused Pallas paged kernel
+        # wherever it can run (TPU, or interpreter mode forced) and
+        # declines to the dense gather otherwise — so a plain CPU run
+        # stays byte-identical to the pre-paged engine
+        from flexflow_tpu.ops.pallas import paged_attention as _pattn
+
+        self.attn_kernel = _pattn.resolve_serve_attn(attn)
         dt = model.executor.compute_dtype
         self.kv = PagedKVCache(
             self.spec.num_layers, self.spec.heads, self.spec.head_dim,
@@ -276,6 +285,18 @@ class ServeEngine:
             w = jax.nn.softmax(scores, axis=-1)
             return (w[..., None] * vals).sum(-2)
 
+        # fused paged decode attention (docs/PERF.md): the kernel walks
+        # each lane's block table in SMEM instead of materializing the
+        # (B, MB, H, BS, D) gather every layer, every step.  Same score
+        # contraction and mask rule as ``attend``; online softmax in
+        # f32 — the greedy argmax streams are bit-identical (pinned by
+        # tests/test_paged_attention.py)
+        paged = self.attn_kernel == "paged"
+        if paged:
+            from flexflow_tpu.ops.pallas.paged_attention import (
+                paged_decode_attention,
+            )
+
         def decode(params, ck, cv, tok, pos, bt):
             # tok/pos (B,) int32; bt (B, MB) int32 block tables
             params = jax.tree.map(cast, params)
@@ -301,11 +322,22 @@ class ServeEngine:
                 # scatter this position's k/v into each lane's block
                 ck = ck.at[i, blk, :, off, :].set(k)
                 cv = cv.at[i, blk, :, off, :].set(v)
-                # gather each lane's pages: (B, MB, H, BS, D) ->
-                # (B, H, SV, D) in logical position order
-                keys = ck[i][bt].transpose(0, 2, 1, 3, 4).reshape(B, H, SV, D)
-                vals = cv[i][bt].transpose(0, 2, 1, 3, 4).reshape(B, H, SV, D)
-                o = attend(q, keys, vals, mask)
+                if paged:
+                    # block-table-native reads: no dense gather exists
+                    # in the lowered program (ffcheck ``paged_attn``)
+                    o = paged_decode_attention(
+                        q[:, None], ck[i], cv[i], pos, bt, scale=scale,
+                    )[:, 0]
+                else:
+                    # gather each lane's pages: (B, MB, H, BS, D) ->
+                    # (B, H, SV, D) in logical position order
+                    keys = ck[i][bt].transpose(
+                        0, 2, 1, 3, 4
+                    ).reshape(B, H, SV, D)
+                    vals = cv[i][bt].transpose(
+                        0, 2, 1, 3, 4
+                    ).reshape(B, H, SV, D)
+                    o = attend(q, keys, vals, mask)
                 o = o.reshape(B, H * D) @ p_at["wo"]
                 if has_bias:
                     o = o + p_at["bo"]
@@ -409,9 +441,18 @@ class ServeEngine:
                 v = v.reshape(B, H, D)
                 ck = ck.at[i, blk, :, off, :].set(k)
                 cv = cv.at[i, blk, :, off, :].set(v)
-                keys = ck[i][bt].transpose(0, 2, 1, 3, 4).reshape(B, H, SV, D)
-                vals = cv[i][bt].transpose(0, 2, 1, 3, 4).reshape(B, H, SV, D)
-                o = attend(q, keys, vals, mask)
+                if paged:
+                    o = paged_decode_attention(
+                        q[:, None], ck[i], cv[i], pos, bt, scale=scale,
+                    )[:, 0]
+                else:
+                    keys = ck[i][bt].transpose(
+                        0, 2, 1, 3, 4
+                    ).reshape(B, H, SV, D)
+                    vals = cv[i][bt].transpose(
+                        0, 2, 1, 3, 4
+                    ).reshape(B, H, SV, D)
+                    o = attend(q, keys, vals, mask)
                 o = o.reshape(B, H * D) @ p_at["wo"]
                 if has_bias:
                     o = o + p_at["bo"]
@@ -462,9 +503,20 @@ class ServeEngine:
                 # prefill-chunk discipline, batched over slots)
                 ck = ck.at[i, blk, :, off, :].set(k)
                 cv = cv.at[i, blk, :, off, :].set(v)
-                keys = ck[i][bt].transpose(0, 2, 1, 3, 4).reshape(B, H, SV, D)
-                vals = cv[i][bt].transpose(0, 2, 1, 3, 4).reshape(B, H, SV, D)
-                o = attend(q, keys[:, None], vals[:, None], mask)
+                if paged:
+                    # one kernel call covers all W rows: row j's mask
+                    # reaches position pos0 + j (G = W generalization)
+                    o = paged_decode_attention(
+                        q, ck[i], cv[i], pos0, bt, scale=scale,
+                    )
+                else:
+                    keys = ck[i][bt].transpose(
+                        0, 2, 1, 3, 4
+                    ).reshape(B, H, SV, D)
+                    vals = cv[i][bt].transpose(
+                        0, 2, 1, 3, 4
+                    ).reshape(B, H, SV, D)
+                    o = attend(q, keys[:, None], vals[:, None], mask)
                 o = o.reshape(B * W, H * D) @ p_at["wo"]
                 if has_bias:
                     o = o + p_at["bo"]
@@ -1082,6 +1134,10 @@ class ServeEngine:
                 "cached_blocks": self.kv.cached_blocks,
                 "preemptions_total": self.sched.preemptions,
                 "tenants": tenants,
+                # which decode-attention kernel served this window
+                # (ADDITIVE ffmetrics/1 vocabulary — r14, old readers
+                # ignore it, old streams simply lack it)
+                "attn_kernel": self.attn_kernel,
             }
             # disaggregated-pool vocabulary (ADDITIVE — absent on
             # colocated engines, so pre-r13 streams are unchanged)

@@ -49,6 +49,11 @@ class ServeSpec:
     kv_len: int = 512  # steady-state prefix depth for the KV-read term
     slo_p99_ms: float = 50.0  # p99 per-token latency bound
     sync_every: int = 4  # engine flush cadence (observable-latency window)
+    # decode-attention kernel the engine will run (docs/PERF.md "Paged
+    # decode attention"): "paged" reads each K/V page once; "gather"
+    # pays the dense per-layer gather materialization (3x KV bytes).
+    # Default "paged" — the engine's auto resolution on TPU.
+    attn: str = "paged"  # paged | gather
     # speculative decoding arm (0 = plain decode only).  When k > 0 the
     # objective prices BOTH arms (plain vs accept-rate-weighted macro
     # steps, estimate_speculative_decode) and takes the better one, so
@@ -94,6 +99,7 @@ class ServeObjective:
             layers, strategy, self.machine,
             slots=self.spec.slots, kv_len=self.spec.kv_len,
             train_tokens=self.train_tokens,
+            attn_kernel=self.spec.attn,
         )
         step_s_raw = max(d["step_s"], 1e-12)
         step_s = step_s_raw
@@ -144,6 +150,7 @@ class ServeObjective:
             "slots": self.spec.slots,
             "kv_len": self.spec.kv_len,
             "sync_every": self.spec.sync_every,
+            "attn_kernel": self.spec.attn,
             "step_s": step_eff,
             "step_s_raw": step_s_raw,
             "calibrated": calibrated,

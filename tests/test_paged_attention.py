@@ -1,0 +1,395 @@
+"""Fused Pallas paged-attention decode tests (ISSUE 14, docs/PERF.md).
+
+Covers kernel-level parity against the dense gather reference (block
+sizes x G rows x scrambled block tables x garbage in masked pages),
+the ``--serve-attn`` knob semantics (auto declines off-TPU, explicit
+``paged`` raises truthfully, ``gather`` stays byte-identical to the
+pre-paged engine), end-to-end stream bit-identity paged-vs-gather
+across block sizes / prefix sharing / a spill-restore preemption
+mid-generation / the speculative verify program at k>=1, the ffcheck
+``paged_attn`` audit (clean on the real paged programs, fires on a
+gather program claiming to be paged), the additive ffmetrics/1
+``attn_kernel`` field + old/new stream interop, and the
+``FFTPU_PALLAS_INTERPRET`` env override.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)))
+)
+
+import jax.numpy as jnp  # noqa: E402
+
+from flexflow_tpu import FFConfig, FFModel  # noqa: E402
+from flexflow_tpu.models.gpt_decode import gpt_generate_cached  # noqa: E402
+from flexflow_tpu.models.transformer import gpt_decoder  # noqa: E402
+from flexflow_tpu.ops.pallas import env_interpret  # noqa: E402
+from flexflow_tpu.ops.pallas import paged_attention as pa  # noqa: E402
+from flexflow_tpu.serve import (  # noqa: E402
+    RequestState,
+    ServeEngine,
+    TrafficSpec,
+    synthetic_requests,
+)
+
+SLOTS, SEQ, VOCAB = 4, 48, 31
+SHAPE = dict(hidden=32, heads=4, ff_dim=64, num_layers=2, vocab=VOCAB)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = FFConfig(batch_size=SLOTS, compute_dtype="float32")
+    m = FFModel(cfg)
+    gpt_decoder(m, SLOTS, SEQ, use_flash=False, **SHAPE)
+    m.compile(seed=0)
+    return m
+
+
+@pytest.fixture()
+def interpret():
+    """Force interpreter mode for the duration of one test (the flag
+    is module-global on purpose: _paged_call is un-jitted so flipping
+    it re-traces — see paged_attention.py)."""
+    old = pa.INTERPRET
+    pa.INTERPRET = True
+    yield
+    pa.INTERPRET = old
+
+
+def _solo(model, req):
+    """Greedy solo decode on the dense session — the reference stream
+    every paged variant must match bit for bit."""
+    prompt = np.tile(np.asarray(req.prompt)[None], (SLOTS, 1))
+    out, _ = gpt_generate_cached(model, prompt, req.max_new_tokens)
+    return out[0, req.prompt_len:]
+
+
+def _streams(reqs):
+    return {r.id: list(map(int, r.tokens)) for r in reqs}
+
+
+# --------------------------------------------------------------- kernel
+def _dense_ref(q, pk, pv, pos, bt, scale):
+    """The engine's gather + mul/reduce contraction, in numpy."""
+    B, G, H, D = q.shape
+    _, _, BS, _ = pk.shape
+    MB = bt.shape[1]
+    SV = MB * BS
+    keys = pk[bt].transpose(0, 2, 1, 3, 4).reshape(B, H, SV, D)
+    vals = pv[bt].transpose(0, 2, 1, 3, 4).reshape(B, H, SV, D)
+    s = np.einsum("bghd,bhsd->bghs", q, keys).astype(np.float32) * scale
+    k_pos = np.arange(SV, dtype=np.int64)
+    row = pos[:, None].astype(np.int64) + np.arange(G)[None]
+    mask = k_pos[None, None, :] <= row[:, :, None]  # (B, G, SV)
+    s = np.where(mask[:, :, None, :], s, np.finfo(np.float32).min)
+    s -= s.max(axis=-1, keepdims=True)
+    p = np.exp(s)
+    p /= p.sum(axis=-1, keepdims=True)
+    return np.einsum("bghs,bhsd->bghd", p, vals)
+
+
+@pytest.mark.parametrize(
+    "B,G,H,D,BS,MB",
+    [
+        (3, 1, 2, 8, 4, 3),   # plain decode row
+        (2, 3, 4, 16, 8, 2),  # speculative verify rows (k=2)
+        (1, 2, 1, 4, 2, 5),   # single head, many small pages
+        (4, 1, 2, 8, 16, 2),  # wide pages
+    ],
+)
+def test_kernel_matches_dense_reference(interpret, B, G, H, D, BS, MB):
+    """Parity vs the gather reference with scrambled block tables,
+    ragged per-lane positions, and GARBAGE (huge values) in every page
+    past each lane's last live one — any DMA-clamp or mask leak would
+    blow the comparison up by orders of magnitude."""
+    rng = np.random.default_rng(17 * B + G)
+    N = B * MB + 1  # + trash block 0
+    q = rng.standard_normal((B, G, H, D)).astype(np.float32)
+    pk = rng.standard_normal((N, H, BS, D)).astype(np.float32)
+    pv = rng.standard_normal((N, H, BS, D)).astype(np.float32)
+    # each lane gets a scrambled disjoint set of physical blocks (> 0)
+    perm = rng.permutation(N - 1) + 1
+    bt = perm[: B * MB].reshape(B, MB).astype(np.int32)
+    # ragged positions: lane b's row 0 sits anywhere in its window
+    pos = rng.integers(0, MB * BS - G + 1, size=(B,)).astype(np.int32)
+    # poison all pages past each lane's last live page AND the trash
+    # block: correct clamping/masking means they never contribute
+    pk[0] = pv[0] = 1e4
+    for b in range(B):
+        last = (int(pos[b]) + G - 1) // BS
+        for i in range(last + 1, MB):
+            pk[bt[b, i]] = 1e4
+            pv[bt[b, i]] = 1e4
+    scale = 1.0 / np.sqrt(D)
+    got = np.asarray(
+        pa.paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(pk), jnp.asarray(pv),
+            jnp.asarray(pos), jnp.asarray(bt),
+        )
+    )
+    want = _dense_ref(q, pk, pv, pos, bt, scale)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-5)
+
+
+def test_kernel_bf16_io_f32_accumulate(interpret):
+    """bf16 pools and queries go through the f32 online softmax; the
+    result must sit within bf16 resolution of the f32 reference."""
+    rng = np.random.default_rng(3)
+    B, G, H, D, BS, MB = 2, 1, 2, 8, 4, 3
+    N = B * MB + 1
+    q = rng.standard_normal((B, G, H, D)).astype(np.float32)
+    pk = rng.standard_normal((N, H, BS, D)).astype(np.float32)
+    pv = rng.standard_normal((N, H, BS, D)).astype(np.float32)
+    bt = (rng.permutation(N - 1) + 1)[: B * MB].reshape(B, MB)
+    pos = np.array([5, 11], np.int32)
+    out = pa.paged_decode_attention(
+        jnp.asarray(q, jnp.bfloat16), jnp.asarray(pk, jnp.bfloat16),
+        jnp.asarray(pv, jnp.bfloat16), jnp.asarray(pos),
+        jnp.asarray(bt, np.int32),
+    )
+    assert out.dtype == jnp.bfloat16
+    want = _dense_ref(
+        np.asarray(jnp.asarray(q, jnp.bfloat16), np.float32),
+        np.asarray(jnp.asarray(pk, jnp.bfloat16), np.float32),
+        np.asarray(jnp.asarray(pv, jnp.bfloat16), np.float32),
+        pos, bt.astype(np.int32), 1.0 / np.sqrt(D),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), want, atol=3e-2, rtol=3e-2
+    )
+
+
+# ----------------------------------------------------------------- knob
+def test_resolve_serve_attn_semantics():
+    old = pa.INTERPRET
+    try:
+        pa.INTERPRET = False
+        # plain CPU: auto must decline so default runs are unchanged
+        assert pa.resolve_serve_attn("auto") == "gather"
+        assert pa.resolve_serve_attn("gather") == "gather"
+        with pytest.raises(ValueError, match="FFTPU_PALLAS_INTERPRET"):
+            pa.resolve_serve_attn("paged")
+        pa.INTERPRET = True
+        assert pa.supported()
+        assert pa.resolve_serve_attn("auto") == "paged"
+        assert pa.resolve_serve_attn("paged") == "paged"
+        assert pa.resolve_serve_attn("gather") == "gather"
+        with pytest.raises(ValueError, match="expected auto"):
+            pa.resolve_serve_attn("dense")
+    finally:
+        pa.INTERPRET = old
+
+
+def test_env_interpret_override(monkeypatch):
+    monkeypatch.delenv("FFTPU_PALLAS_INTERPRET", raising=False)
+    assert env_interpret() is False
+    assert env_interpret(default=True) is True
+    for v in ("1", "true", "ON", "Yes"):
+        monkeypatch.setenv("FFTPU_PALLAS_INTERPRET", v)
+        assert env_interpret() is True
+    for v in ("0", "false", "off", "NO"):
+        monkeypatch.setenv("FFTPU_PALLAS_INTERPRET", v)
+        assert env_interpret(default=True) is False
+    with pytest.warns(UserWarning, match="FFTPU_PALLAS_INTERPRET"):
+        monkeypatch.setenv("FFTPU_PALLAS_INTERPRET", "maybe")
+        assert env_interpret() is False
+
+
+@pytest.fixture(scope="module")
+def gather_engine(model):
+    """One shared explicit-gather engine (engines are reusable across
+    runs, test_serve.py); also the ffcheck negative-test subject."""
+    return ServeEngine(model, slots=SLOTS, block_size=8, sync_every=4,
+                       attn="gather")
+
+
+def test_gather_mode_is_the_default_engine(model, gather_engine):
+    """attn='gather' and CPU-auto resolve identically and produce the
+    exact streams of an engine that never heard of the knob."""
+    old = pa.INTERPRET
+    pa.INTERPRET = False
+    try:
+        _check_gather_default(model, gather_engine)
+    finally:
+        pa.INTERPRET = old
+
+
+def _check_gather_default(model, gather_engine):
+    reqs_a = synthetic_requests(TrafficSpec(
+        n_requests=2, seed=2, rate_rps=0.0, prompt_len=(2, 6),
+        max_new=(2, 4), vocab=VOCAB,
+    ))
+    reqs_b = synthetic_requests(TrafficSpec(
+        n_requests=2, seed=2, rate_rps=0.0, prompt_len=(2, 6),
+        max_new=(2, 4), vocab=VOCAB,
+    ))
+    auto = ServeEngine(model, slots=SLOTS, block_size=8, sync_every=4)
+    assert auto.attn_kernel == "gather"  # declined: no TPU, no interpret
+    assert gather_engine.attn_kernel == "gather"
+    auto.run(reqs_a)
+    gather_engine.run(reqs_b)
+    assert _streams(reqs_a) == _streams(reqs_b)
+
+
+# ------------------------------------------------------------ engine A/B
+@pytest.mark.parametrize("block_size", [4, 16])
+def test_paged_streams_bit_identical_across_block_sizes(
+    model, gather_engine, interpret, block_size
+):
+    """Non-default page geometries (the default block_size=8 rides the
+    prefix/preemption/speculative tests below).  Greedy streams are
+    block-size-invariant, so the shared bs=8 gather engine is the
+    reference for both; its streams equal the solo decode already
+    (test_serve.py pins), closing paged == solo."""
+    reqs_g = synthetic_requests(TrafficSpec(
+        n_requests=4, seed=4, rate_rps=0.0, prompt_len=(2, 9),
+        max_new=(2, 6), vocab=VOCAB,
+    ))
+    reqs_p = synthetic_requests(TrafficSpec(
+        n_requests=4, seed=4, rate_rps=0.0, prompt_len=(2, 9),
+        max_new=(2, 6), vocab=VOCAB,
+    ))
+    page = ServeEngine(model, slots=SLOTS, block_size=block_size,
+                       sync_every=4, attn="paged")
+    assert page.attn_kernel == "paged"
+    rg = gather_engine.run(reqs_g)
+    rp = page.run(reqs_p)
+    assert rg.requests_finished == rp.requests_finished == 4
+    assert _streams(reqs_g) == _streams(reqs_p)
+    page.kv.check_invariants()
+
+
+def test_paged_composes_with_prefix_sharing(model, interpret):
+    """CoW prefix sharing under the paged kernel: reads on shared pages
+    only, streams bit-identical to the unshared gather engine."""
+    def traffic():
+        return synthetic_requests(TrafficSpec(
+            n_requests=4, seed=3, rate_rps=0.0, prompt_len=(2, 6),
+            max_new=(2, 6), vocab=VOCAB, tenants=1, shared_prefix=16,
+        ))
+
+    page = ServeEngine(model, slots=SLOTS, block_size=8, num_blocks=13,
+                       sync_every=2, prefix_sharing=True, attn="paged")
+    gath = ServeEngine(model, slots=SLOTS, block_size=8, num_blocks=13,
+                       sync_every=2, prefix_sharing=False, attn="gather")
+    reqs_p, reqs_g = traffic(), traffic()
+    rep_p = page.run(reqs_p)
+    gath.run(reqs_g)
+    assert rep_p.prefix_hit_rate is not None and rep_p.prefix_hit_rate > 0
+    assert _streams(reqs_p) == _streams(reqs_g)
+    assert page.kv.shared_write_hazards() == []
+    page.kv.check_invariants()
+
+
+def test_paged_spill_restore_preemption_bit_identical(
+    model, interpret, tmp_path
+):
+    """An interactive request preempts a mid-flight batch decode on the
+    paged engine; the victim spills, restores, and every stream equals
+    its solo decode — the restored pages land wherever the free list
+    says, so this exercises fresh block tables mid-generation.  The
+    same run's metrics stream carries the additive ``attn_kernel``
+    field, and serve_report renders it with and without the field
+    (old/new stream interop)."""
+    out = tmp_path / "paged.jsonl"
+    eng = ServeEngine(model, slots=2, block_size=8, sync_every=2,
+                      attn="paged", metrics_out=str(out))
+    rng = np.random.default_rng(5)
+    b0 = eng.submit(rng.integers(0, VOCAB, size=(4,)).astype(np.int32), 16,
+                    tenant="acme", tier="batch")
+    b1 = eng.submit(rng.integers(0, VOCAB, size=(4,)).astype(np.int32), 16,
+                    tenant="acme", tier="batch")
+    eng.sched.admit()
+    eng._t0 = eng._now()
+    for _ in range(6):
+        eng._window()
+    assert b0.state is RequestState.DECODE
+    assert b1.state is RequestState.DECODE
+    it = eng.submit(rng.integers(0, VOCAB, size=(3,)).astype(np.int32), 6,
+                    tenant="vip", tier="interactive")
+    rep = eng.run()
+    assert rep.requests_finished == 3
+    assert eng.sched.preemptions == 1 and b1.preemptions == 1
+    for r in (b0, b1, it):
+        assert r.state is RequestState.FINISHED
+        np.testing.assert_array_equal(
+            np.asarray(r.tokens, np.int32), _solo(model, r)
+        )
+    eng.kv.check_invariants()
+
+    # metrics vocabulary: additive ffmetrics/1 attn_kernel field
+    from flexflow_tpu.obs import read_metrics
+
+    recs = read_metrics(str(out))
+    assert recs
+    assert all(
+        r["metrics"]["serve"]["attn_kernel"] == "paged" for r in recs
+    )
+    # old/new stream interop: serve_report renders a pre-r14 stream
+    # (no attn_kernel) and the new stream through the same code path
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools",
+    ))
+    import serve_report
+
+    assert serve_report.render(recs)  # new stream renders
+    old = json.loads(json.dumps(recs))
+    for r in old:
+        r["metrics"]["serve"].pop("attn_kernel")
+    assert serve_report.render(old)  # old stream still renders
+
+
+def test_paged_speculative_verify_bit_identical(model, interpret):
+    """Draft (G=1) and verify (G=k+1) both run the paged kernel; the
+    emitted streams must still be exactly the plain greedy streams.
+    (The ffcheck ``paged_attn`` CLEAN audit over paged decode / draft /
+    verify programs runs in tier-0 — tools/ffcheck.py gpt_decode +
+    disagg configs; the negative case is pinned below.)"""
+    page = ServeEngine(model, slots=SLOTS, block_size=8, sync_every=4,
+                       spec_k=2, attn="paged")
+    reqs = synthetic_requests(TrafficSpec(
+        n_requests=3, seed=8, rate_rps=0.0, prompt_len=(2, 6),
+        max_new=(3, 6), vocab=VOCAB,
+    ))
+    rep = page.run(reqs)
+    assert rep.requests_finished == 3
+    assert rep.spec_k == 2 and rep.spec_drafted > 0
+    for r in reqs:
+        np.testing.assert_array_equal(
+            np.asarray(r.tokens, np.int32), _solo(model, r)
+        )
+    page.kv.check_invariants()
+
+
+# ------------------------------------------------------------- ffcheck
+def test_ffcheck_paged_attn_fires_on_gather_program(gather_engine):
+    """A gather program claiming ``serve_attn: paged`` must trip the
+    audit: the decode jaxpr materializes a pool-virtual-length gather
+    that the paged kernel exists to delete."""
+    from flexflow_tpu.analysis import analyze_serve_engine
+
+    eng = gather_engine
+    # honest gather engines are out of scope: the check skips
+    rep = analyze_serve_engine(eng, checks=["paged_attn"])
+    assert not [v for v in rep.violations if v.check == "paged_attn"]
+    eng.attn_kernel = "paged"  # the lie
+    try:
+        rep = analyze_serve_engine(eng, checks=["paged_attn"])
+    finally:
+        eng.attn_kernel = "gather"
+    hits = [v for v in rep.violations if v.check == "paged_attn"]
+    assert hits and not rep.ok
+    assert hits[0].severity == "error"
+    assert "gather" in hits[0].message
+    assert hits[0].details["nbytes"] >= hits[0].details["lane_kv_bytes"]
+
+

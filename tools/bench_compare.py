@@ -91,6 +91,13 @@ COMPARABLE_METADATA = (
     # reasons, so the gate surfaces the change and still compares
     "serve_handoff_ms",
     "serve_disagg_split",
+    # serve_attn (r14, docs/PERF.md "Paged decode attention"): which
+    # decode-attention kernel the paged A/B's paged arm resolved to —
+    # runs measured under different kernels are still the same
+    # experiment (the bit-identity fact rides the A/B itself), but the
+    # gate surfaces the change because the kernel shifts peak bytes
+    # and tok/s for configuration (not regression) reasons
+    "serve_attn",
 )
 
 # (label, path into the record, higher_is_better) — the gated metrics.
@@ -126,6 +133,14 @@ GATED = (
     # split-pool topology exists to protect; it growing means prefill
     # work leaked back into decode windows or the handoff got slower
     ("serve_disagg_p99_tpot_ms", ("serve_disagg_p99_tpot_ms",), False),
+    # serve_paged_attn_peak_mb (r14, docs/PERF.md "Paged decode
+    # attention") gates LOWER-is-better: the paged decode program's
+    # peak live temp bytes from XLA's memory_analysis() — the number
+    # the block-table-native kernel exists to shrink; it growing means
+    # a pool-sized gather/materialization crept back into the decode
+    # step (the ffcheck ``paged_attn`` audit is the structural twin of
+    # this measured gate)
+    ("serve_paged_attn_peak_mb", ("serve_paged_attn_peak_mb",), False),
     ("dlrm", ("secondary", "dlrm", "samples_per_sec"), True),
     ("bert_large", ("secondary", "bert_large", "samples_per_sec"), True),
     ("gpt_decode_cached", ("secondary", "gpt_decode", "cached_tok_per_s"), True),

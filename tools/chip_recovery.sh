@@ -32,6 +32,15 @@ BENCH_IMPLS=flash FFTPU_ONEPASS_MAX_SK=2048 timeout 900 \
   python tools/bench_attention.py 0 0 2048 2>&1 \
   | grep -v WARNING | tee .bench_logs/attn_onepass2048.jsonl
 
+echo "== serve paged-attention A/B (r14: native Pallas kernel — CPU had interpret-mode numbers only) =="
+timeout 900 python - <<'PY' 2>&1 | grep -v WARNING | tee .bench_logs/serve_paged_attn_ab.json
+import importlib.util, json
+spec = importlib.util.spec_from_file_location("bench", "bench.py")
+b = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(b)
+print(json.dumps(b._serve_paged_attn_ab(True)))
+PY
+
 echo "== bench.py (headline + attn_core extras) =="
 timeout 2700 python bench.py | tee .bench_logs/bench_b16.json
 

@@ -169,7 +169,15 @@ def analyze_config(name: str, checks=None):
         gpt_decoder(gm, slots, seq, use_flash=False, hidden=32, heads=4,
                     ff_dim=64, num_layers=2, vocab=vocab)
         gm.compile(seed=0)
-        eng = ServeEngine(gm, slots=slots, block_size=8, sync_every=4)
+        # the reference serve config audits the PAGED decode programs
+        # (the production arm): interpreter mode lets the Pallas kernel
+        # trace on the CPU harness, and the ``paged_attn`` check then
+        # proves no pool-sized gather survived lowering
+        from flexflow_tpu.ops.pallas import paged_attention as _pa
+
+        _pa.INTERPRET = True
+        eng = ServeEngine(gm, slots=slots, block_size=8, sync_every=4,
+                          attn="paged")
         report = analyze_serve_engine(eng, checks=checks)
     elif name == "disagg":
         from flexflow_tpu import FFConfig, FFModel
@@ -190,10 +198,15 @@ def analyze_config(name: str, checks=None):
         machine = load_machine_model(os.path.join(
             REPO, "examples", "machine_configs", "v5p_2slice.json"
         ))
+        # paged decode programs in the disagg pools too (interpret on
+        # the CPU harness) — the paged_attn audit covers both pools
+        from flexflow_tpu.ops.pallas import paged_attention as _pa
+
+        _pa.INTERPRET = True
         cluster = DisaggregatedCluster(
             gm, prefill_slots=slots, decode_slots=slots,
             prefill_block_size=8, decode_block_size=16,
-            sync_every=4, machine=machine,
+            sync_every=4, machine=machine, attn="paged",
         )
         # run a small workload so the handoff audit has real frames
         # (migrations, digests, both pools' allocators exercised)

@@ -337,6 +337,182 @@ def _pipeline_1f1b_ab(on_tpu: bool) -> dict:
     }
 
 
+def _fit_overlap_smoke() -> dict:
+    """The in-process half of :func:`_fit_overlap_ab`: the depth-24
+    smoke transformer stepped twice — ``--grad-overlap off`` vs a
+    forced ``ring`` — on a (n, 1) data×model mesh over every visible
+    device.  Runs in a forced-8-device subprocess on a 1-device CPU
+    host (the ring needs data extent > 1 to engage)."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from flexflow_tpu import (
+        AdamOptimizer, FFConfig, FFModel, LossType, MachineMesh,
+    )
+    from flexflow_tpu.models.transformer import transformer_encoder
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch, seq, hidden, layers = (
+        (8, 128, 256, 24) if on_tpu else (4, 64, 128, 24)
+    )
+    n = len(jax.devices())
+    if batch % n:  # the data axis must divide the global batch
+        batch = n * ((batch + n - 1) // n)
+
+    def arm(go: str) -> dict:
+        cfg = FFConfig(
+            batch_size=batch, stack_blocks="auto", grad_overlap=go,
+        )
+        m = FFModel(cfg)
+        transformer_encoder(
+            m, batch=batch, seq=seq, hidden=hidden, heads=8,
+            ff_dim=2 * hidden, num_layers=layers, vocab=1000,
+            num_classes=16, use_flash=False, raw_input=True,
+        )
+        m.compile(
+            optimizer=AdamOptimizer(alpha=1e-4),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY, seed=0,
+            mesh=MachineMesh((n, 1), ("data", "model")),
+        )
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(batch, seq, hidden)).astype(np.float32)
+        y = rng.integers(0, 16, size=(batch, 1)).astype(np.int32)
+        ex = m.executor
+        syncs0 = ex.host_syncs
+        ex._step_jit = ex._build_step()
+        inputs, labels = ex.place_batch([x, y])
+        args = (ex.params, ex.state, ex.opt_state, inputs, labels, 0)
+        t0 = _time.perf_counter()
+        compiled = ex._step_jit.lower(*args).compile()
+        compile_s = _time.perf_counter() - t0
+        out = jax.block_until_ready(compiled(*args))
+        losses = [float(out[3])]
+        steps = 5
+        t0 = _time.perf_counter()
+        for i in range(steps):
+            out = compiled(out[0], out[1], out[2], inputs, labels, i + 1)
+            losses.append(float(out[3]))
+        jax.block_until_ready(out)
+        return {
+            "grad_overlap": go,
+            "ring_engaged": bool(ex._grad_ring),
+            "jit_compile_s": round(compile_s, 3),
+            "step_time_ms": round(
+                (_time.perf_counter() - t0) / steps * 1e3, 2
+            ),
+            "extra_host_syncs": ex.host_syncs - syncs0,
+            "losses": [round(v, 6) for v in losses],
+        }
+
+    off = arm("off")
+    ring = arm("ring")
+    return {
+        "config": f"b={batch} s={seq} h={hidden} depth={layers} dp={n}"
+        + ("" if on_tpu else " (cpu smoke)"),
+        "fused": off,
+        "ring": ring,
+        "loss_parity_max_abs": round(
+            max(abs(a - b)
+                for a, b in zip(off["losses"], ring["losses"])), 6
+        ),
+        "step_time_ratio": round(
+            ring["step_time_ms"] / off["step_time_ms"], 3
+        ) if off["step_time_ms"] else None,
+    }
+
+
+def _fit_overlap_ab(on_tpu: bool) -> dict:
+    """Overlapped-gradient-sync A/B (--grad-overlap, docs/PERF.md
+    "Overlapped gradient sync"): (1) the depth-24 smoke transformer
+    stepped off-vs-ring at equal global batch — losses must agree at
+    parity tolerances and the ring must add ZERO host syncs; (2) the
+    BERT-Large priced estimate — ``estimate_strategy_cost`` off vs the
+    overlap model's adjustment on a dp=8 placement, recording
+    ``exposed_comm_frac`` = exposed ring time / fused sync time (the
+    share of the fused tail sync the ring could NOT hide; LOWER is
+    better, gated by tools/bench_compare.py)."""
+    import jax
+
+    if on_tpu or len(jax.devices()) > 1:
+        smoke = _fit_overlap_smoke()
+    else:
+        # 1-device CPU host: the ring declines at data extent 1, so the
+        # smoke runs in a subprocess with 8 forced host devices (the
+        # same virtual topology the tier-1 tests pin)
+        code = (
+            "import importlib.util, json, os; "
+            "spec = importlib.util.spec_from_file_location"
+            f"('bench', {os.path.abspath(__file__)!r}); "
+            "b = importlib.util.module_from_spec(spec); "
+            "spec.loader.exec_module(b); "
+            "print(json.dumps(b._fit_overlap_smoke()))"
+        )
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            timeout=900, env=env, text=True,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"overlap smoke child failed: {r.stderr[-300:]}"
+            )
+        smoke = json.loads(r.stdout.strip().splitlines()[-1])
+
+    # BERT-Large priced estimate (pure pricing — no devices): dp=8 over
+    # ICI, the overlap model's whole-step adjustment vs the fused sync
+    from flexflow_tpu import FFConfig, FFModel, MachineMesh
+    from flexflow_tpu.models.transformer import BERT_LARGE, transformer_encoder
+    from flexflow_tpu.parallel.machine import PhysicalTopology
+    from flexflow_tpu.parallel.strategy import data_parallel_strategy
+    from flexflow_tpu.search.cost import (
+        TPUMachineModel,
+        estimate_strategy_cost,
+        grad_overlap_adjustment,
+    )
+
+    model = FFModel(FFConfig(batch_size=8))
+    transformer_encoder(
+        model, batch=8, seq=512, num_classes=16, vocab=32000,
+        use_flash=False, **BERT_LARGE,
+    )
+    mesh = MachineMesh((8, 1), ("data", "model"))
+    mach = TPUMachineModel(
+        topology=PhysicalTopology((2, 2, 2), wrap=(True, True, True))
+    )
+    st = data_parallel_strategy(model.layers, mesh)
+    fused_step_s = estimate_strategy_cost(model.layers, st, mach)
+    delta, price = grad_overlap_adjustment(
+        model.layers, st, mach, mode="auto"
+    )
+    priced = {
+        "config": "bert-large dp=8 (priced estimate)",
+        "fused_step_s": round(fused_step_s, 6),
+        "ring_step_s": round(fused_step_s - delta, 6),
+        "saved_s": round(delta, 6),
+    }
+    frac = None
+    if price is not None and price.get("fused_s"):
+        frac = price["exposed_s"] / price["fused_s"]
+        priced.update(
+            fused_sync_s=round(price["fused_s"], 6),
+            exposed_s=round(price["exposed_s"], 6),
+            overlap_frac=price["overlap_frac"],
+            chains=price["chains"],
+        )
+    return {
+        "smoke": smoke,
+        "priced": priced,
+        "exposed_comm_frac": round(frac, 4) if frac is not None else None,
+    }
+
+
 def _bench_dlrm(on_tpu: bool) -> dict:
     """Embedding-bound DLRM single-chip step (VERDICT r3 #4 / BASELINE.json
     north star; shapes from reference examples/cpp/DLRM/dlrm.cc:114-241 —
@@ -1636,6 +1812,17 @@ def run_bench(backend: str) -> None:
         record["pipeline_bubble_frac"] = ab["pipelined"]["bubble_frac"]
     except Exception as e:  # noqa: BLE001
         record["pipeline_1f1b_ab"] = {"error": str(e)[:200]}
+    # overlapped-gradient-sync A/B (ISSUE 15 acceptance): contained like
+    # the pipeline A/B — an overlap failure must not sink the headline
+    try:
+        oab = _fit_overlap_ab(on_tpu)
+        record["fit_overlap_ab"] = oab
+        record["exposed_comm_frac"] = oab["exposed_comm_frac"]
+        record["grad_overlap"] = (
+            "ring" if oab["smoke"]["ring"]["ring_engaged"] else "off"
+        )
+    except Exception as e:  # noqa: BLE001
+        record["fit_overlap_ab"] = {"error": str(e)[:200]}
     record["secondary"] = _bench_secondary(on_tpu)
     sab = record["secondary"].get("serve_continuous_ab") or {}
     record["serve_tok_s"] = sab.get("serve_tok_s")

@@ -109,6 +109,10 @@ def _executor_implied(ex, forward_only: bool):
         ex.strategy,
         forward_only=forward_only,
         extra_axes=("data",) if ex.zero1 else (),
+        # the executor's EXACT ring plan (not the search's estimate):
+        # layers whose weight-grad sync runs as the in-scan ring get
+        # optional reduce-scatter/collective-permute companions
+        grad_ring_layers=getattr(ex, "_grad_ring_layers", frozenset()),
     )
     if ex.pipeline is None:
         # the executor declined the strategy's pipeline (or none was
@@ -126,6 +130,44 @@ def _param_shardings(compiled) -> Optional[dict]:
         return tree if isinstance(tree, dict) else None
     except Exception:
         return None
+
+
+def _grad_ring_details(ex) -> dict:
+    """The executor's ring claim, for the ``overlap`` check
+    (analysis/checks.py): per ringed chain, the data extent (ring
+    degree), hop count, and ``bucket_bytes`` — the LARGEST ringed
+    leaf's full stacked bytes (depth x weight bytes), i.e. the size of
+    the fused tail all-reduce the ring must have eliminated from the
+    lowered program.  (The fused path syncs each stacked leaf as its
+    own all-reduce, so the largest leaf — not the bucket sum — is what
+    a surviving tail sync lowers at.)"""
+    plans = getattr(ex, "_grad_ring", None)
+    out = {"grad_overlap": getattr(ex, "grad_overlap", "off"), "chains": []}
+    if not plans:
+        return out
+    import numpy as np
+
+    from flexflow_tpu.ops.base import _dtype_bytes
+
+    n = ex.strategy.mesh.axis_size("data")
+    for c in ex._block_chains:
+        plan = plans.get(c.start)
+        if not plan:
+            continue
+        bucket_bytes = c.depth * max(
+            int(np.prod(w.shape)) * _dtype_bytes(w.dtype)
+            for tl in c.template
+            for w in ex._wspecs[int(tl.layer_guid)]
+            if w.name in plan.get(tl.name, {})
+        )
+        out["chains"].append({
+            "start": int(c.start),
+            "depth": int(c.depth),
+            "ring_degree": int(n),
+            "hops": int(n - 1),
+            "bucket_bytes": int(bucket_bytes),
+        })
+    return out
 
 
 def artifact_from_executor_step(
@@ -146,6 +188,7 @@ def artifact_from_executor_step(
         compute_dtype=str(ex.compute_dtype),
         implied=_executor_implied(ex, forward_only=False),
         param_shardings=_param_shardings(compiled) if compiled is not None else None,
+        details={"grad_ring": _grad_ring_details(ex)},
     )
 
 

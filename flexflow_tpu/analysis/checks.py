@@ -352,3 +352,88 @@ def _fmt_pspec(pspec: Any) -> str:
         "+".join(e) if isinstance(e, tuple) else (str(e) if e else "None")
         for e in pspec
     ) + ")"
+
+
+def _result_bytes(text: str, opcode: str) -> int:
+    """Largest result-buffer size parsed from the ``dtype[dims]`` shapes
+    on an HLO instruction line, restricted to the text BEFORE the opcode
+    token (the result side of ``=``) so operand shapes never count."""
+    import re
+
+    head = text.split(f" {opcode}", 1)[0]
+    best = 0
+    for dt, dims in re.findall(r"\b([a-z]+\d+)\[([\d,]*)\]", head):
+        elems = math.prod(int(x) for x in dims.split(",") if x) if dims else 1
+        best = max(best, elems * _dtype_bytes(dt))
+    return best
+
+
+@register_check("overlap")
+def check_overlap(artifact: ProgramArtifact) -> List[Violation]:
+    """Structural proof the overlapped gradient sync happened: a fit
+    program that CLAIMS the in-scan ring (docs/PERF.md "Overlapped
+    gradient sync") must lower the ring's (n−1)-hop ``collective-permute``
+    chain per ringed bucket, and must NOT still carry a fused tail
+    ``all-reduce`` at the full stacked bucket bytes — either one means
+    the ring was claimed (and priced) but the fused sync survived
+    lowering.
+
+    Total: artifacts without a ``grad_ring`` detail claiming
+    ``"ring"`` with at least one chain, or without compiled HLO, skip.
+    Forward/serve programs never carry the detail.  Small all-reduces
+    (loss/metric scalars, per-slice reductions inside the scan body —
+    at most ``bucket_bytes / depth``) sit below the threshold and
+    pass."""
+    det = (artifact.details or {}).get("grad_ring") or {}
+    chains = det.get("chains") or []
+    if det.get("grad_overlap") != "ring" or not chains or not artifact.hlo:
+        return []
+    from flexflow_tpu.analysis.collectives import extract_collectives
+
+    summary = extract_collectives(artifact.hlo, artifact.mesh)
+    out: List[Violation] = []
+    # (a) the ring's permute chain must be in the program: at least
+    # hops = n−1 collective-permutes attributed to the data axis
+    # (unattributed ops — no mesh on the artifact — count permissively)
+    need_hops = max(c["hops"] for c in chains)
+    n_perm = sum(
+        1 for op in summary.ops
+        if op.kind == "collective-permute"
+        and (op.axes is None or "data" in op.axes)
+    )
+    if n_perm < need_hops:
+        out.append(Violation(
+            check="overlap",
+            severity="error",
+            program=artifact.name,
+            message=(
+                f"grad-overlap ring claimed but the lowered program has "
+                f"{n_perm} data-axis collective-permute(s) — the ring "
+                f"all-gather needs at least {need_hops} hops; the fused "
+                f"path was priced away but never replaced"
+            ),
+            details={"permutes": n_perm, "need_hops": need_hops},
+        ))
+    # (b) no fused tail sync may survive at full stacked bucket bytes:
+    # the ring moved the reduction INTO the scan body at per-slice size
+    floor = min(c["bucket_bytes"] for c in chains)
+    for op in summary.ops:
+        if op.kind != "all-reduce":
+            continue
+        nbytes = _result_bytes(op.text, "all-reduce")
+        if nbytes >= floor:
+            out.append(Violation(
+                check="overlap",
+                severity="error",
+                program=artifact.name,
+                message=(
+                    f"grad-overlap ring claimed but a fused all-reduce "
+                    f"at {nbytes} bytes survived (HLO line {op.line_no}) "
+                    f">= the smallest ringed bucket ({floor} bytes) — "
+                    f"the tail sync the ring was priced to eliminate is "
+                    f"still in the program"
+                ),
+                details={"nbytes": nbytes, "bucket_bytes_floor": floor,
+                         "line_no": op.line_no},
+            ))
+    return out

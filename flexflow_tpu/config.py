@@ -160,6 +160,16 @@ class FFConfig:
     # microbatches per 1F1B step (0 = auto: the search sweeps divisors
     # of the global batch; non-search strategies default to min(4, B))
     microbatches: int = 0
+    # overlapped gradient sync (docs/PERF.md "Overlapped gradient sync"):
+    # ring the scan-stacked chains' weight-grad sync into the backward
+    # scan body (reduce-scatter + ppermute all-gather over the data axis)
+    # so block i's grad traffic overlaps block i-1's backward compute.
+    # "auto" rings a chain when the overlap pricing says the exposed time
+    # beats the fused tail all-reduce; "ring" forces it on every eligible
+    # chain; "off" is byte-identical to today's fused path.  Non-chain
+    # weights always keep the fused path; pipelined chains and data-axis
+    # extent 1 decline.
+    grad_overlap: str = "off"  # off | auto | ring
     # JAX persistent compilation cache directory (--compile-cache-dir):
     # compiled step programs are written to / served from disk, so
     # repeated bench/search runs skip recompiles entirely; a compile
@@ -286,6 +296,8 @@ class FFConfig:
                 self.pipeline = take()
             elif a == "--microbatches":
                 self.microbatches = int(take())
+            elif a == "--grad-overlap":
+                self.grad_overlap = take()
             elif a == "--compile-cache-dir":
                 self.compile_cache_dir = take()
             elif a == "--verify-compiled":

@@ -997,6 +997,10 @@ class FFModel:
                     # off|auto|S, with M pinned by --microbatches
                     pipeline=cfg.pipeline,
                     microbatches=cfg.microbatches or None,
+                    # overlapped-gradient-sync axis (docs/PERF.md): the
+                    # search prices every mesh candidate with the ring
+                    # adjustment, so an overlappable placement can win
+                    grad_overlap=cfg.grad_overlap,
                 )
                 searched = True
             else:
@@ -1023,6 +1027,46 @@ class FFModel:
             )
             if reason is not None and jax.process_index() == 0:
                 print(f"[pipeline] declined: {reason}")
+        # --grad-overlap resolution (docs/PERF.md "Overlapped gradient
+        # sync"): a searched winner already carries the decision
+        # (strategy.grad_overlap, priced by the search's overlap
+        # adjustment); imported / hand-built / data-parallel strategies
+        # resolve here — "auto" rings only when the overlap pricing
+        # beats the fused tail sync, "ring" forces the decomposition.
+        # Either way the aggregated pricing is attached so
+        # exposed_comm_s lands in last_step_stats / ffmetrics.
+        assert cfg.grad_overlap in ("off", "auto", "ring"), (
+            f"unknown --grad-overlap value {cfg.grad_overlap!r}"
+        )
+        grad_overlap_resolved = "off"
+        if cfg.grad_overlap != "off":
+            if strategy.grad_overlap != "ring":
+                try:
+                    from flexflow_tpu.search.cost import (
+                        grad_overlap_adjustment,
+                    )
+
+                    lyrs = strategy.rewritten_layers or self.layers
+                    delta, price = grad_overlap_adjustment(
+                        lyrs, strategy, machine, mode=cfg.grad_overlap
+                    )
+                    if price is not None and (
+                        cfg.grad_overlap == "ring" or delta > 0.0
+                    ):
+                        strategy.grad_overlap = "ring"
+                        strategy.grad_overlap_price = price
+                        if strategy.predicted_step_s is not None and delta:
+                            strategy.predicted_step_s = max(
+                                0.0, strategy.predicted_step_s - delta
+                            )
+                except Exception:  # noqa: BLE001 — pricing must never block a run
+                    pass
+            grad_overlap_resolved = (
+                "ring"
+                if (strategy.grad_overlap == "ring"
+                    or cfg.grad_overlap == "ring")
+                else "off"
+            )
         self.strategy = strategy
         # calibration loop: an instrumented run (--metrics-out / --health
         # / --drift) pairs every step record with the strategy's priced
@@ -1066,7 +1110,13 @@ class FFModel:
                             pred = price["step_s"]
                             strategy.pipeline_price = price
                 if pred is None:
-                    pred = estimate_strategy_cost(lyrs, strategy, machine)
+                    pred = estimate_strategy_cost(
+                        lyrs, strategy, machine,
+                        grad_overlap=(
+                            "ring" if strategy.grad_overlap == "ring"
+                            else "off"
+                        ),
+                    )
                 if calibration is not None:
                     pred = calibration.correct_step("fit", pred)
                 strategy.predicted_step_s = pred
@@ -1111,6 +1161,7 @@ class FFModel:
             profiling=cfg.profiling,
             stack_blocks=cfg.stack_blocks,
             verify_compiled=cfg.verify_compiled,
+            grad_overlap=grad_overlap_resolved,
         )
         with get_tracer().span("init_params", cat="compile"):
             self.executor.init_params()

@@ -291,6 +291,9 @@ class HealthMonitor:
             microbatches=stats.get("microbatches"),
             bubble_frac=stats.get("bubble_frac"),
             analysis_violations=stats.get("analysis_violations"),
+            # overlapped gradient sync (nullable, docs/PERF.md) —
+            # carried on last_step_stats when the ring is active
+            exposed_comm_s=stats.get("exposed_comm_s"),
             counters=self.counter_deltas(dict(tracer.counters)),
             metrics=metrics,
         )

@@ -81,6 +81,12 @@ RECORD_FIELDS = (
     # ADDING this keeps the schema at ffmetrics/1 (same interop rule as
     # the prediction/pipeline keys above).
     "analysis_violations",
+    # overlapped gradient sync (nullable — docs/PERF.md "Overlapped
+    # gradient sync"): the overlap model's priced EXPOSED communication
+    # per step (ring time minus the backward compute it hides under)
+    # when the step ran with --grad-overlap ring.  None = fused sync.
+    # ADDING keeps the schema at ffmetrics/1 (same interop rule).
+    "exposed_comm_s",
 )
 
 
@@ -134,6 +140,7 @@ def step_record(
     microbatches: Optional[int] = None,
     bubble_frac: Optional[float] = None,
     analysis_violations: Optional[int] = None,
+    exposed_comm_s: Optional[float] = None,
     counters: Optional[Dict[str, float]] = None,
     metrics: Optional[Dict[str, float]] = None,
 ) -> Dict[str, Any]:
@@ -158,6 +165,7 @@ def step_record(
         ("predicted_step_s", predicted_step_s),
         ("predicted_tok_s", predicted_tok_s),
         ("bubble_frac", bubble_frac),
+        ("exposed_comm_s", exposed_comm_s),
     ):
         if v is not None:
             rec[k] = float(v)

@@ -374,6 +374,16 @@ class NetworkedMachineModel(_networked_base()):
             t += lat + nbytes * (m - 1) / (n * bw)
         return t
 
+    def overlap_fraction(self, axis: Optional[str] = None) -> float:
+        """Link-class-aware overlappability for --grad-overlap pricing:
+        an axis whose binding carries a slice-crossing factor rides DCN
+        and is barely overlappable; a purely intra-slice axis rides ICI
+        and hides well under backward compute (docs/PERF.md)."""
+        b = self._axis_bind.get(axis)
+        if b is not None:
+            return self.OVERLAP_DCN if b.slices > 1 else self.OVERLAP_ICI
+        return super().overlap_fraction(axis)
+
     # --- observability ------------------------------------------------------
     def flush_decisions(self) -> Dict[str, int]:
         """Push ring/hierarchical decision deltas to the process tracer

@@ -234,6 +234,15 @@ class Strategy:
         # (docs/ANALYSIS.md).  Derived, not serialized: rebuilt from the
         # assignments whenever needed.
         self.implied_collectives: Optional[List] = None
+        # overlapped gradient sync (--grad-overlap, docs/PERF.md): the
+        # RESOLVED mode this placement was priced under — "ring" when the
+        # search/compile decided the chains' weight-grad sync rings into
+        # the backward scan, else "off".  Serialized so an exported winner
+        # carries the choice; grad_overlap_price holds the aggregated
+        # overlap pricing terms (fused_s/ring_s/exposed_s/overlap_frac —
+        # observability only, feeds exposed_comm_s in last_step_stats).
+        self.grad_overlap: str = "off"
+        self.grad_overlap_price: Optional[Dict] = None
 
     def op_sharding(self, layer: Layer) -> Optional[OpSharding]:
         return self.ops.get(int(layer.layer_guid))
@@ -275,6 +284,13 @@ class Strategy:
                     if self.pipeline is not None
                     else {}
                 ),
+                **(
+                    {"grad_overlap": self.grad_overlap,
+                     **({"grad_overlap_price": self.grad_overlap_price}
+                        if self.grad_overlap_price is not None else {})}
+                    if self.grad_overlap != "off"
+                    else {}
+                ),
                 "structural_rewrites": [
                     {"rule": r, "layers": list(ls)}
                     for r, ls in self.applied_detail
@@ -303,6 +319,8 @@ class Strategy:
             from flexflow_tpu.parallel.pipeline import PipelineSpec
 
             st.pipeline = PipelineSpec.from_dict(d["pipeline"])
+        st.grad_overlap = d.get("grad_overlap", "off")
+        st.grad_overlap_price = d.get("grad_overlap_price")
         rw = d.get("structural_rewrites") or []
         if rw and isinstance(rw[0], dict):
             st.applied_detail = tuple(

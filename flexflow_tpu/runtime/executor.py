@@ -73,6 +73,7 @@ class Executor:
         profiling: bool = False,
         stack_blocks: str = "off",
         verify_compiled: str = "off",
+        grad_overlap: str = "off",
     ) -> None:
         self.layers = layers
         self.graph_inputs = graph_inputs
@@ -171,6 +172,32 @@ class Executor:
             self._segments: List[Any] = segs
         else:
             self._segments = list(layers)
+
+        # --- overlapped gradient sync (--grad-overlap, docs/PERF.md
+        # "Overlapped gradient sync"): ring each eligible scan-stacked
+        # chain's weight-grad sync INTO the backward scan body — a
+        # sharding-constraint-forced reduce-scatter over the data axis
+        # plus an explicit (n−1)-hop ppermute ring all-gather
+        # (_ring_all_gather, the PR-8 shard_map idiom) — so block i's
+        # grad traffic overlaps block i−1's backward compute instead of
+        # queueing in the fused tail sync.  "off" leaves the trace
+        # byte-identical; "auto" arrives here already resolved by
+        # FFModel.compile's overlap pricing (an explicit auto on a bare
+        # Executor rings every eligible chain, like "ring").  Non-chain
+        # weights always keep the fused path; declines mirror
+        # docs/PERF.md (data axis extent 1, pipelined chains, weights
+        # already data-sharded or with no n-divisible unsharded dim).
+        assert grad_overlap in ("off", "auto", "ring"), (
+            f"unknown --grad-overlap value {grad_overlap!r}"
+        )
+        self.grad_overlap = grad_overlap
+        # chain start -> {bucket name -> {weight name -> (scatter dim,
+        # per-layer base spec)}}; member layer names feed the analyzer's
+        # :grad-sync-ring implied entries (analysis/capture.py)
+        self._grad_ring: Dict[int, Dict[str, Dict[str, Tuple[int, Tuple]]]] = {}
+        self._grad_ring_layers: frozenset = frozenset()
+        if grad_overlap != "off":
+            self._setup_grad_ring(grad_overlap)
 
         self._step_jit = None
         self._fwd_jit = None
@@ -604,14 +631,45 @@ class Executor:
         }
         carry0 = values[chain.carry_in_guid]
         out_sh_box: Dict[int, TensorSharding] = {}
+        ring_plan = (
+            self._grad_ring.get(chain.start) if training else None
+        )
+        grad_sync = None
+        if ring_plan:
+            n = self.strategy.mesh.axis_size("data")
+            grad_sync = self._make_chain_grad_sync(ring_plan, n)
         body = self._chain_scan_body(
-            chain, values, shardings, training, rng, seq_length, out_sh_box
+            chain, values, shardings, training, rng, seq_length, out_sh_box,
+            grad_sync=grad_sync,
         )
 
         with get_tracer().span(
             "block_scan", cat="step", level="op", depth=depth, layers=L,
         ):
-            carry, _ = jax.lax.scan(body, carry0, (crcs, xs_params))
+            if grad_sync is not None:
+                # ring traffic per bucket: full stacked bytes, (n-1) hops
+                # per leaf; exposed_ms from the compile-time overlap
+                # pricing when one was attached (observability only)
+                from flexflow_tpu.ops.base import _dtype_bytes
+
+                ring_bytes = depth * sum(
+                    int(np.prod(w.shape)) * _dtype_bytes(w.dtype)
+                    for tl in tmpl
+                    for w in self._wspecs[int(tl.layer_guid)]
+                    if w.name in ring_plan.get(tl.name, {})
+                )
+                price = getattr(self.strategy, "grad_overlap_price", None)
+                span_kw = dict(
+                    depth=depth, hops=n - 1, bytes=int(ring_bytes),
+                )
+                if price and price.get("exposed_s") is not None:
+                    span_kw["exposed_ms"] = float(price["exposed_s"]) * 1e3
+                with get_tracer().span(
+                    "grad_ring", cat="step", level="op", **span_kw
+                ):
+                    carry, _ = jax.lax.scan(body, carry0, (crcs, xs_params))
+            else:
+                carry, _ = jax.lax.scan(body, carry0, (crcs, xs_params))
         values[chain.out_guid] = carry
         out_t = chain.layers[-1][-1].outputs[0]
         shardings[chain.out_guid] = out_sh_box.get(
@@ -627,17 +685,26 @@ class Executor:
         rng: Optional[jax.Array],
         seq_length: Optional[int],
         out_sh_box: Dict[int, TensorSharding],
+        grad_sync=None,
     ):
         """The ONE-block scan body shared by ``_trace_block_scan`` and the
         pipelined ``_trace_pipeline_scan``: trace the TEMPLATE block over
         ``(carry, (crc_row, per-depth params))``, with shared operands
         closure-captured from ``values`` and per-depth dropout keys
         derived from the member-name crc32 xs (bit-parity with the
-        unrolled per-layer ``fold_in``)."""
+        unrolled per-layer ``fold_in``).
+
+        ``grad_sync`` (an identity with a ring-sync VJP from
+        ``_make_chain_grad_sync``) wraps each depth slice's params so the
+        weight-grad sync runs INSIDE the backward scan body
+        (--grad-overlap); ``None`` — always the case on the pipeline
+        path — leaves the body byte-identical to today's."""
         tmpl = chain.template
 
         def body(carry, x):
             crc_row, p_d = x
+            if grad_sync is not None:
+                p_d = grad_sync(p_d)
             vals: Dict[int, jax.Array] = {chain.carry_in_guid: carry}
             shs: Dict[int, TensorSharding] = {}
             if chain.carry_in_guid in shardings:
@@ -660,6 +727,178 @@ class Executor:
             return vals[chain.template_out_guid], None
 
         return body
+
+    # --- overlapped gradient sync (--grad-overlap, docs/PERF.md) -----------
+    def _setup_grad_ring(self, mode: str) -> None:
+        """Build the per-chain ring plans: which stacked buckets' weight
+        grads leave the fused tail sync and ring inside the backward scan
+        body instead.  Eligibility mirrors the search side
+        (``search/cost.py grad_ring_chain_layers``): scan-stacked chains
+        whose grads are partial over the data axis, on a data axis of
+        extent > 1, with no pipeline; per weight, the ring needs an
+        unsharded dim divisible by the data extent to chunk over."""
+        from flexflow_tpu.search.cost import (
+            default_op_sharding, node_grad_sync_rows,
+        )
+
+        mm = self.strategy.mesh
+        n = mm.axis_size("data")
+
+        def decline(reason: str) -> None:
+            if mode == "ring" and jax.process_index() == 0:
+                print(f"[grad-overlap] declined at executor: {reason}")
+
+        if n <= 1:
+            decline("data axis extent 1")
+            return
+        if self.pipeline is not None:
+            decline(
+                "pipelined chain "
+                f'(stage_axis=="{self.pipeline.stage_axis}")'
+            )
+            return
+        members: set = set()
+        for c in self._block_chains:
+            plan: Dict[str, Dict[str, Tuple[int, Tuple]]] = {}
+            for tl in c.template:
+                os_ = self.strategy.op_sharding(tl) or default_op_sharding(tl)
+                synced = {
+                    wn for wn, _b, _n, _a in node_grad_sync_rows(tl, os_, mm)
+                }
+                if not synced:
+                    continue
+                lplan: Dict[str, Tuple[int, Tuple]] = {}
+                for w in self._wspecs[int(tl.layer_guid)]:
+                    if not w.trainable or w.name not in synced:
+                        continue
+                    ps = tuple(
+                        self.strategy.weight_pspec(tl, w.name, len(w.shape))
+                    )
+                    base = list(ps) + [None] * (len(w.shape) - len(ps))
+                    for d in range(len(w.shape)):
+                        if base[d] is None and w.shape[d] % n == 0:
+                            lplan[w.name] = (d, tuple(base))
+                            break
+                if lplan:
+                    plan[tl.name] = lplan
+            if plan:
+                self._grad_ring[c.start] = plan
+                for blk in c.layers:
+                    for l in blk:
+                        members.add(l.name)
+        if not self._grad_ring:
+            decline(
+                "no eligible scan-stacked chain (non-chain weights keep "
+                "the fused path)"
+            )
+        self._grad_ring_layers = frozenset(members)
+
+    def _ring_all_gather(self, g, scat_spec, base_spec, dim: int, n: int):
+        """Explicit ring all-gather of ``g`` (sharded ``scat_spec``, with
+        the data axis chunking ``dim``) back to ``base_spec`` via (n−1)
+        ``ppermute`` hops inside ``shard_map`` — the PR-8 handoff idiom
+        (``_trace_pipeline_scan._shift``): each hop forwards the chunk
+        around the data ring while the receiving device writes it into
+        place, so XLA can schedule hop h beside the surrounding backward
+        compute instead of fusing one monolithic tail collective."""
+        from flexflow_tpu._compat import shard_map
+
+        def local(gl):
+            shard = gl.shape[dim]
+            idx = jax.lax.axis_index("data")
+            full = jnp.zeros(
+                gl.shape[:dim] + (shard * n,) + gl.shape[dim + 1:], gl.dtype
+            )
+            full = jax.lax.dynamic_update_slice_in_dim(
+                full, gl, idx * shard, dim
+            )
+            cur = gl
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            for h in range(1, n):
+                cur = jax.lax.ppermute(cur, "data", perm)
+                full = jax.lax.dynamic_update_slice_in_dim(
+                    full, cur, ((idx - h) % n) * shard, dim
+                )
+            return full
+
+        return shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(PartitionSpec(*scat_spec),),
+            out_specs=PartitionSpec(*base_spec),
+            check_vma=False,
+        )(g)
+
+    def _make_chain_grad_sync(self, plan, n: int):
+        """The identity "grad-sync point" wrapped around each depth
+        slice's params inside the backward scan body: forward is the
+        identity; the custom VJP replaces GSPMD's deferred fused tail
+        all-reduce with, per planned leaf, (a) a sharding constraint that
+        scatters the cotangent over the data axis — forcing the pending
+        partial-sum resolution to materialize HERE, inside the scan
+        body, as a reduce-scatter — and (b) the explicit ppermute ring
+        all-gather back to the weight's own layout.  Net effect: a ring
+        all-reduce decomposition of the exact same reduction, placed
+        where block i−1's backward compute can hide it.  Unplanned
+        leaves pass through untouched (fused path)."""
+
+        def ring_leaf(g, dim, base_spec):
+            scat = list(base_spec)
+            scat[dim] = "data"
+            g = self._constrain(g, PartitionSpec(*scat))
+            return self._ring_all_gather(g, tuple(scat), base_spec, dim, n)
+
+        @jax.custom_vjp
+        def sync(tree):
+            return tree
+
+        def fwd(tree):
+            return tree, None
+
+        def bwd(_, ct):
+            out = {}
+            for lname, leaves in ct.items():
+                lplan = plan.get(lname, {})
+                out[lname] = {
+                    wn: (
+                        ring_leaf(g, *lplan[wn]) if wn in lplan else g
+                    )
+                    for wn, g in leaves.items()
+                }
+            return (out,)
+
+        sync.defvjp(fwd, bwd)
+        return sync
+
+    def _zero1_ring_gather(self, new_params):
+        """ZeRO-1 param unshard, ring-pipelined against the optimizer
+        update (--grad-overlap): scatter-constrain each ring bucket's
+        updated stack over the data axis — GSPMD then computes that
+        bucket's update on 1/n of the weights, free to overlap with the
+        other buckets' updates — and reassemble with the explicit
+        ppermute ring instead of one fused tail all-gather.  Math
+        identity; non-ring buckets keep GSPMD's fused delta gather."""
+        n = self.strategy.mesh.axis_size("data")
+        out = dict(new_params)
+        for plan in self._grad_ring.values():
+            for lname, lplan in plan.items():
+                ws = out.get(lname)
+                if not ws:
+                    continue
+                ws = dict(ws)
+                for wn, (dim, base) in lplan.items():
+                    if wn not in ws:
+                        continue
+                    # stacked storage carries a leading depth dim
+                    sbase = (None,) + tuple(base)
+                    scat = list(sbase)
+                    scat[dim + 1] = "data"
+                    g = self._constrain(ws[wn], PartitionSpec(*scat))
+                    ws[wn] = self._ring_all_gather(
+                        g, tuple(scat), sbase, dim + 1, n
+                    )
+                out[lname] = ws
+        return out
 
     def _trace_pipeline_scan(
         self,
@@ -1200,6 +1439,11 @@ class Executor:
                 new_opt = jax.tree.map(
                     self._zero1_constrain, new_opt, self._zero1_specs
                 )
+                if self._grad_ring:
+                    # --grad-overlap: ring the ZeRO-1 param unshard of the
+                    # ring buckets per bucket, pipelined against the other
+                    # buckets' optimizer updates (math identity)
+                    new_params = self._zero1_ring_gather(new_params)
             m = metrics.compute(logits, labels) if metrics else {}
             if diagnostics:
                 m = dict(m)
@@ -1455,6 +1699,17 @@ class Executor:
             )
             if tracer.enabled:
                 tracer.counter("pipeline.bubble_s", device_s * bf)
+        if self._grad_ring:
+            # overlapped gradient sync (--grad-overlap): the compile-time
+            # overlap pricing's predicted exposed comm per step — an
+            # ffmetrics/1 nullable additive field, like bubble_frac; None
+            # when no pricing was attached (bare Executor)
+            price = getattr(self.strategy, "grad_overlap_price", None)
+            self.last_step_stats["exposed_comm_s"] = (
+                float(price["exposed_s"])
+                if price and price.get("exposed_s") is not None
+                else None
+            )
         # run-health monitor: feed the flight recorder / detectors.  The
         # float() fetches are the monitor's documented per-step cost (the
         # block_until_ready above already synced, so they are host copies

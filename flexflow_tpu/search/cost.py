@@ -208,6 +208,22 @@ class TPUMachineModel:
             return 0.0
         return self._lat(axis) + nbytes * (n - 1) / (n * self._bw(axis))
 
+    # fraction of a grad-sync ring's time the backward compute stream can
+    # hide when the sync is software-pipelined into the backward scan
+    # (--grad-overlap, docs/PERF.md "Overlapped gradient sync").  ICI
+    # collectives overlap well — the DMA engines run them beside the MXU;
+    # DCN collectives barely do — the host-mediated uplink path
+    # serializes against the step.
+    OVERLAP_ICI = 0.9
+    OVERLAP_DCN = 0.15
+
+    def overlap_fraction(self, axis: Optional[str] = None) -> float:
+        """How much of a collective over ``axis`` can hide under
+        concurrent backward compute (0 = fully exposed, 1 = free)."""
+        if axis in self.dcn_axes:
+            return self.OVERLAP_DCN
+        return self.OVERLAP_ICI
+
 
 # Zero-flop ops XLA compiles to views or fuses into their consumers'
 # loads (a slice feeds each consumer directly; reshape/flat are bitcasts):
@@ -493,6 +509,152 @@ def node_cost(
     return t
 
 
+def node_grad_sync_rows(layer, sharding, mesh, machine=None):
+    """The layer's weight-grad sync terms as ``(weight_name,
+    bytes_per_device, degree, dcn_axis_or_None)`` rows — EXACTLY the loop
+    :func:`node_cost` prices with ``m.all_reduce`` (same DCN-participant
+    selection), exposed so the overlap model (:func:`chain_grad_overlap`)
+    and the executor's ring eligibility can re-derive the same traffic
+    without drifting apart."""
+    dcn = machine.dcn_axes if machine is not None else ()
+    opdef = get_op_def(layer.op_type)
+    out0 = sharding.output[0] if sharding.output else None
+    data_axes = set()
+    if out0 is not None:
+        for i in range(len(out0.spec)):
+            data_axes.update(out0.axes_of(i))
+        data_axes -= set(out0.partial_axes)
+    rows = []
+    for w in opdef.weights(layer):
+        if not w.trainable:
+            continue
+        wb = math.prod(w.shape) * _dtype_nbytes(w.dtype)
+        ws = sharding.weights.get(w.name)
+        wd = ws.total_degree(mesh) if ws is not None else 1
+        waxes = set(ws.used_axes()) if ws is not None else set()
+        sync = 1
+        sync_axis = None
+        for a in data_axes - waxes:
+            sync *= mesh.axis_size(a)
+            if a in dcn:
+                sync_axis = a  # DCN participant dominates the ring
+        if sync > 1:
+            rows.append((w.name, wb / wd, sync, sync_axis))
+    return rows
+
+
+def chain_grad_overlap(chain, strategy, mesh, machine, block_cost):
+    """Overlap pricing for one collapsed chain's weight-grad sync
+    (--grad-overlap, docs/PERF.md): the fused tail all-reduce vs the same
+    traffic as a ring reduce-scatter + all-gather software-pipelined into
+    the backward scan, where block *i*'s ring hides under block *i−1*'s
+    backward compute.  Per-block exposed comm is
+    ``max(0, ring_time − overlap_frac × backward_compute)`` with
+    ``overlap_frac`` from the machine model's link classes
+    (:meth:`TPUMachineModel.overlap_fraction` — DCN axes barely overlap).
+    Returns ``None`` when the chain carries no data-axis grad sync;
+    otherwise a dict with ``fused_s``/``ring_s``/``exposed_s``/
+    ``overlap_frac``/``saved_s``/``sync_bytes``/``ring_degree``."""
+    fused = ring = 0.0
+    frac = None
+    degree = 1
+    sync_bytes = 0.0
+    for l in chain.template:
+        os_ = strategy.op_sharding(l)
+        if os_ is None:
+            os_ = default_op_sharding(l)
+        for _wn, b, nsync, ax in node_grad_sync_rows(l, os_, mesh, machine):
+            fused += machine.all_reduce(b, nsync, axis=ax)
+            ring += (
+                machine.reduce_scatter(b, nsync, axis=ax)
+                + machine.all_gather(b, nsync, axis=ax)
+            )
+            f = machine.overlap_fraction(ax)
+            frac = f if frac is None else min(frac, f)
+            degree = max(degree, nsync)
+            sync_bytes += b
+    if fused <= 0.0 or frac is None:
+        return None
+    # backward share of the block's compute the ring can hide under:
+    # bwd ≈ 2× fwd flops (op_compute_time's 3× factor), so 2/3 of the
+    # block cost net of the fused sync itself
+    bwd = max(0.0, (2.0 / 3.0) * (block_cost - fused))
+    exposed = max(0.0, ring - frac * bwd)
+    return {
+        "fused_s": fused,
+        "ring_s": ring,
+        "exposed_s": exposed,
+        "overlap_frac": frac,
+        "saved_s": fused - exposed,
+        "sync_bytes": sync_bytes,
+        "ring_degree": degree,
+    }
+
+
+def grad_ring_chain_layers(layers, strategy) -> frozenset:
+    """Names of the layers whose weight-grad sync lowers as the explicit
+    ring under ``--grad-overlap ring`` — the search-side mirror of the
+    executor's eligibility (uniform collapsed chains with data-axis grad
+    sync; pipelined strategies decline entirely).  Drives the
+    ``:grad-sync-ring`` entries :func:`implied_collectives` emits for a
+    winner that carries the choice."""
+    from flexflow_tpu.blocks import detect_block_chains
+
+    if strategy.pipeline is not None:
+        return frozenset()
+    mesh = strategy.mesh
+    names = set()
+    for ch in detect_block_chains(layers, min_depth=4):
+        if not _chain_assignment_uniform(ch, strategy):
+            continue
+        has_sync = False
+        for l in ch.template:
+            os_ = strategy.op_sharding(l) or default_op_sharding(l)
+            if node_grad_sync_rows(l, os_, mesh):
+                has_sync = True
+                break
+        if has_sync:
+            for blk in ch.layers:
+                for l in blk:
+                    names.add(l.name)
+    return frozenset(names)
+
+
+def grad_overlap_adjustment(layers, strategy, machine, mode: str = "auto"):
+    """Whole-strategy overlap pricing: ``(delta_s, price)`` where
+    ``delta_s`` is the step-time reduction from ringing every eligible
+    chain's grad sync (``auto`` only rings chains it helps; ``ring``
+    forces the decomposition and prices it honestly, even when worse)
+    and ``price`` aggregates the per-chain terms for
+    ``Strategy.grad_overlap_price``.  ``(0.0, None)`` when nothing rings."""
+    if mode not in ("auto", "ring") or strategy.pipeline is not None:
+        return 0.0, None
+    _, parts = estimate_strategy_parts(
+        layers, strategy, machine, collapse_blocks=True,
+        grad_overlap=mode,
+    )
+    delta = 0.0
+    agg = {"fused_s": 0.0, "ring_s": 0.0, "exposed_s": 0.0,
+           "sync_bytes": 0.0, "chains": 0}
+    frac = None
+    for entry in parts.values():
+        ov = entry.get("grad_overlap")
+        if ov is None:
+            continue
+        depth = entry["chain"].depth
+        delta += depth * ov["saved_s"]
+        for k in ("fused_s", "ring_s", "exposed_s"):
+            agg[k] += depth * ov[k]
+        agg["sync_bytes"] += depth * ov["sync_bytes"]
+        agg["chains"] += 1
+        f = ov["overlap_frac"]
+        frac = f if frac is None else min(frac, f)
+    if agg["chains"] == 0:
+        return 0.0, None
+    agg["overlap_frac"] = frac
+    return delta, agg
+
+
 def estimate_strategy_cost(
     layers: List[Layer],
     strategy: Strategy,
@@ -502,6 +664,7 @@ def estimate_strategy_cost(
     cost_cache: Optional[Dict] = None,
     collapse_blocks: bool = True,
     forward_only: bool = False,
+    grad_overlap: str = "off",
 ) -> float:
     """Per-step time estimate for a whole strategy: node costs (compute +
     weight-grad sync) + per-edge reshard collectives.  Pure function of the
@@ -523,6 +686,7 @@ def estimate_strategy_cost(
         layers, strategy, machine, lambda_mem=lambda_mem,
         node_time_fn=node_time_fn, cost_cache=cost_cache,
         collapse_blocks=collapse_blocks, forward_only=forward_only,
+        grad_overlap=grad_overlap,
     )
     return total
 
@@ -536,6 +700,7 @@ def estimate_strategy_parts(
     cost_cache: Optional[Dict] = None,
     collapse_blocks: bool = True,
     forward_only: bool = False,
+    grad_overlap: str = "off",
 ) -> Tuple[float, Dict[int, Dict]]:
     """:func:`estimate_strategy_cost` with the collapsed-chain pricing
     exposed: returns ``(total, parts)`` where ``parts`` maps each
@@ -545,7 +710,15 @@ def estimate_strategy_parts(
     (``estimate_pipeline_step_time``) reads these so stage enumeration
     re-prices NOTHING per (stage count x microbatch count) — the whole
     (S x M) sweep is arithmetic over one collapsed walk
-    (docs/PIPELINE.md, "Pricing")."""
+    (docs/PIPELINE.md, "Pricing").
+
+    ``grad_overlap`` (off|auto|ring) re-prices each chain's weight-grad
+    sync as a ring pipelined into the backward scan
+    (:func:`chain_grad_overlap`): ``auto`` rings a chain only when the
+    exposed time beats the fused sync, ``ring`` forces it.  The per-chain
+    terms land in ``parts[start]["grad_overlap"]``; ``first``/``steady``
+    stay at fused pricing (the pipeline tier, which reads them, never
+    combines with the ring — the executor declines pipelined chains)."""
     from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
     from flexflow_tpu.parallel.spec import TensorSharding
 
@@ -670,6 +843,13 @@ def estimate_strategy_parts(
         parts[chain.start] = {
             "chain": chain, "first": first, "steady": steady,
         }
+        if grad_overlap in ("auto", "ring") and not forward_only:
+            ov = chain_grad_overlap(chain, strategy, mesh, m, steady)
+            if ov is not None and (
+                grad_overlap == "ring" or ov["exposed_s"] < ov["fused_s"]
+            ):
+                total -= chain.depth * ov["saved_s"]
+                parts[chain.start]["grad_overlap"] = ov
         if chain.layers[-1][-1].op_type.is_parallel_op:
             # downstream consumers resolve the chain output through
             # pop_out exactly as they would after the unrolled walk;
@@ -751,6 +931,7 @@ def implied_collectives(
     strategy: Strategy,
     forward_only: bool = False,
     extra_axes: Tuple[str, ...] = (),
+    grad_ring_layers=(),
 ) -> List["ImpliedCollective"]:
     """The multiset of collectives ``strategy`` implies for the compiled
     program — the reconciliation source for the analyzer's collective
@@ -766,7 +947,17 @@ def implied_collectives(
 
     ``extra_axes`` admits optional all-gather/reduce-scatter over axes
     the runtime adds outside the strategy walk (the executor's ZeRO-1
-    moment sharding gathers the param delta over its shard axes)."""
+    moment sharding gathers the param delta over its shard axes).
+
+    ``grad_ring_layers`` names layers whose weight-grad sync lowers as
+    the explicit ring decomposition under ``--grad-overlap`` (the
+    executor's actual ring set, or :func:`grad_ring_chain_layers` for a
+    search winner): their grad-sync entries gain ``:grad-sync-ring``
+    reduce-scatter + collective-permute companions so the audit tolerates
+    the (n−1)-hop ppermute chain the ring all-gather lowers to.  The
+    fused ``:grad-sync`` all-reduce entry stays required — the ring's
+    scatter leg satisfies it through ALLOWED_LOWERINGS; the ring's own
+    presence is pinned by the ffcheck ``overlap`` check, not here."""
     from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
     from flexflow_tpu.parallel.spec import TensorSharding
 
@@ -861,6 +1052,13 @@ def implied_collectives(
                     "all-reduce", sync_axes,
                     f"{layer.name}.{w.name}:grad-sync", required=True,
                 ))
+                if layer.name in grad_ring_layers:
+                    out.append(ImpliedCollective(
+                        "reduce-scatter", sync_axes,
+                        f"{layer.name}.{w.name}:grad-sync-ring"))
+                    out.append(ImpliedCollective(
+                        "collective-permute", sync_axes,
+                        f"{layer.name}.{w.name}:grad-sync-ring"))
         if waxes_all and not forward_only:
             in_axes = set()
             for ts in os_.inputs:

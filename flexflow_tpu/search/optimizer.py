@@ -336,6 +336,7 @@ def unity_search(
     calibration=None,
     pipeline: str = "off",
     microbatches: Optional[int] = None,
+    grad_overlap: str = "off",
 ) -> Strategy:
     """Pick the cheapest (mesh factorization, per-op sharding) pair.
 
@@ -403,6 +404,18 @@ def unity_search(
     point-to-point handoff.  ``microbatches`` pins M (None sweeps the
     divisors of the global batch).  Winners carry
     ``Strategy.pipeline``/``pipeline_price`` and per-op ``stage`` tags.
+
+    ``grad_overlap``: the overlapped-gradient-sync axis (docs/PERF.md
+    "Overlapped gradient sync").  ``"off"`` (default) prices every
+    candidate's weight-grad sync as the fused tail all-reduce.
+    ``"auto"``/``"ring"`` re-price each non-pipelined candidate's
+    scan-stacked chains with the ring decomposition's EXPOSED time —
+    ``max(0, ring_time − overlap_frac × backward_compute)`` per block,
+    link-class-aware (DCN axes barely overlap) — so a placement whose
+    grad traffic hides under backward compute can beat one the serial
+    pricing preferred.  Winners that ring carry
+    ``Strategy.grad_overlap``/``grad_overlap_price`` and
+    ``:grad-sync-ring`` implied collectives.
     """
     from flexflow_tpu.obs import get_tracer
     from flexflow_tpu.search.candidates import SearchOptions, search_options
@@ -421,7 +434,7 @@ def unity_search(
             layers, mesh, graph_inputs, budget, alpha, machine,
             mem_budget_bytes, explore_meshes, beam, profiler, mem_search_iters,
             extra_xfers, struct_xfers, inference, objective, serve,
-            calibration, pipeline, microbatches,
+            calibration, pipeline, microbatches, grad_overlap,
         )
 
 
@@ -429,7 +442,7 @@ def _unity_search_impl(
     layers, mesh, graph_inputs, budget, alpha, machine,
     mem_budget_bytes, explore_meshes, beam, profiler, mem_search_iters,
     extra_xfers, struct_xfers, inference, objective="train", serve=None,
-    calibration=None, pipeline="off", microbatches=None,
+    calibration=None, pipeline="off", microbatches=None, grad_overlap="off",
 ) -> Strategy:
     assert objective in ("train", "serve"), objective
     pipeline = str(pipeline)
@@ -590,6 +603,31 @@ def _unity_search_impl(
                     best = pst
         cost = res.cost
         price = None
+        # --- overlapped-gradient-sync tier (docs/PERF.md): re-price this
+        # mesh's winner with the ring decomposition's exposed time; the
+        # adjustment competes in the same cost comparison, so "auto" can
+        # flip the mesh choice toward an overlappable placement the
+        # serial pricing rejected.  Training-only (a serve search has no
+        # grad sync); pipelined variants never combine with the ring.
+        ov_price = None
+        if grad_overlap in ("auto", "ring") and serve_obj is None:
+            from flexflow_tpu.search.cost import grad_overlap_adjustment
+
+            st_ov = Strategy(mv)
+            st_ov.ops = res.assign
+            try:
+                ov_delta, ov_price = grad_overlap_adjustment(
+                    res.layers if res.layers is not layers else layers,
+                    st_ov, machine, mode=grad_overlap,
+                )
+            except Exception:  # noqa: BLE001 — pricing must never block a search
+                ov_delta, ov_price = 0.0, None
+            if ov_price is not None and (
+                grad_overlap == "ring" or ov_delta > 0.0
+            ):
+                cost = cost - ov_delta
+            else:
+                ov_price = None
         if serve_obj is not None:
             # mesh selection under the SERVING objective: steady-state
             # decode tokens/s subject to the p99 per-token SLO — a mesh
@@ -622,10 +660,13 @@ def _unity_search_impl(
                 # λ=0), step-corrected when a calibration store is
                 # active.  Correction is monotone, so applying it only
                 # to the winner cannot change which mesh won.
-                pred = res.cost
+                pred = cost if ov_price is not None else res.cost
                 if calibration is not None:
                     pred = calibration.correct_step("fit", pred)
                 st.predicted_step_s = pred
+            if ov_price is not None:
+                st.grad_overlap = "ring"
+                st.grad_overlap_price = ov_price
             best = st
     if forced_best is not None:
         best = forced_best[1]
@@ -654,12 +695,21 @@ def _unity_search_impl(
     # the golden tests and --verify-compiled reconcile the lowered
     # program against exactly what this placement priced
     try:
-        from flexflow_tpu.search.cost import implied_collectives
+        from flexflow_tpu.search.cost import (
+            grad_ring_chain_layers,
+            implied_collectives,
+        )
 
+        ring_layers = ()
+        if best.grad_overlap == "ring":
+            ring_layers = grad_ring_chain_layers(
+                best.rewritten_layers or layers, best
+            )
         best.implied_collectives = implied_collectives(
             best.rewritten_layers or layers,
             best,
             forward_only=(objective == "serve"),
+            grad_ring_layers=ring_layers,
         )
     except Exception:  # noqa: BLE001 — analysis must never block a search
         best.implied_collectives = None

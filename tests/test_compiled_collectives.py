@@ -70,3 +70,58 @@ def test_transformer_dp_tp_sp_step_compiles_without_full_remat(capfd):
         np.zeros((4, 1), np.int32),
     )
     assert np.isfinite(float(loss))
+
+
+def test_grad_overlap_off_is_byte_identical():
+    """--grad-overlap off must leave the compiled step BYTE-IDENTICAL
+    (modulo source-line metadata) to a build where the knob was never
+    set, with zero collective-permutes — the ring decomposition must
+    not leak into the fused path.  (The r15 budgets above — 17 AG / 82
+    AR at pin time — ride the same guarantee: the dp×tp×sp test runs
+    with the knob absent, i.e. off.)"""
+    import re
+
+    import jax
+
+    from flexflow_tpu import (
+        AdamOptimizer, FFConfig, FFModel, LossType, MachineMesh,
+    )
+    from flexflow_tpu.analysis import extract_collectives
+    from flexflow_tpu.fftype import MetricsType
+    from flexflow_tpu.models.transformer import transformer_encoder
+
+    if len(jax.devices()) < 8:
+        import pytest
+
+        pytest.skip("needs the 8 virtual CPU devices")
+
+    def _hlo(**cfg_kw):
+        cfg = FFConfig(batch_size=8, stack_blocks="on", **cfg_kw)
+        m = FFModel(cfg)
+        transformer_encoder(
+            m, batch=8, seq=16, hidden=32, heads=4, ff_dim=64,
+            num_layers=4, vocab=100, num_classes=8, use_flash=False,
+            raw_input=True,
+        )
+        m.compile(
+            optimizer=AdamOptimizer(alpha=1e-3),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=[MetricsType.ACCURACY], seed=0,
+            mesh=MachineMesh((8, 1), ("data", "model")),
+        )
+        ex = m.executor
+        x = np.zeros((8, 16, 32), np.float32)
+        y = np.zeros((8, 1), np.int32)
+        xs = [ex._place(x, ex._input_pspec(t), t.shape[0])
+              for t in ex.graph_inputs]
+        ys = ex._place(y, ex._label_pspec(), 8)
+        step = ex._build_step()
+        txt = step.lower(
+            ex.params, ex.state, ex.opt_state, xs, ys, 0
+        ).compile().as_text()
+        return re.sub(r", metadata=\{[^}]*\}", "", txt)
+
+    default = _hlo()
+    off = _hlo(grad_overlap="off")
+    assert off == default
+    assert extract_collectives(off)["collective-permute"] == 0

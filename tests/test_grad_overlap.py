@@ -1,0 +1,518 @@
+"""Overlapped gradient sync as a search axis (ISSUE 15, docs/PERF.md
+"Overlapped gradient sync").
+
+Covers: --grad-overlap config parse + strategy JSON round-trip, ring
+fit-loss parity vs the fused path over 5 steps (fp32 + bf16 + ZeRO-1)
+with ZERO additional host syncs on the ledger, the ring's (n−1)-hop
+collective-permute chain in the compiled HLO, executor
+decline-and-fallback (data extent 1, pipelined chains), the overlap
+pricing (``chain_grad_overlap`` / ``overlap_fraction`` /
+``grad_overlap_adjustment``), the 2-slice search golden (single-slice
+``auto`` flips a placement serial pricing rejects and carries
+``:grad-sync-ring`` implied entries; the DCN machine declines), the
+``overlap`` ffcheck (clean on the shipped ring, fires on a seeded
+regression, catches a surviving full-bucket tail all-reduce), the
+``exposed_comm_s`` ffmetrics field, the ``grad_ring`` tracer rollup,
+and the bench_compare ``exposed_comm_frac`` gate.  (The off-is-byte-
+identical HLO pin lives in tests/test_compiled_collectives.py.)
+"""
+
+import dataclasses
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    AdamOptimizer,
+    FFConfig,
+    FFModel,
+    LossType,
+    MachineMesh,
+)
+from flexflow_tpu.fftype import MetricsType
+from flexflow_tpu.models.transformer import transformer_encoder
+from flexflow_tpu.parallel.strategy import Strategy, data_parallel_strategy
+
+BS, SEQ, HID = 8, 16, 32
+
+
+def _need8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8 virtual CPU devices")
+
+
+def _model(go="off", dtype="float32", layers=4, seed=0, mesh=None,
+           strategy=None, **cfg_kw):
+    cfg = FFConfig(
+        batch_size=BS, stack_blocks="on", grad_overlap=go,
+        compute_dtype=dtype, **cfg_kw
+    )
+    m = FFModel(cfg)
+    transformer_encoder(
+        m, batch=BS, seq=SEQ, hidden=HID, heads=4, ff_dim=2 * HID,
+        num_layers=layers, vocab=100, num_classes=8, use_flash=False,
+        raw_input=True,
+    )
+    m.compile(
+        optimizer=AdamOptimizer(alpha=1e-3),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+        seed=seed,
+        mesh=mesh or MachineMesh((8, 1), ("data", "model")),
+        strategy=strategy,
+    )
+    return m
+
+
+def _data(steps=5, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(steps * BS, SEQ, HID)).astype(np.float32)
+    y = rng.integers(0, 8, size=(steps * BS, 1)).astype(np.int32)
+    return x, y
+
+
+def _step_losses(m, x, y, steps=5):
+    out = []
+    for s in range(steps):
+        inputs, labels = m.executor.place_batch(
+            [x[s * BS:(s + 1) * BS], y[s * BS:(s + 1) * BS]]
+        )
+        loss, _ = m.executor.train_step(inputs, labels)
+        out.append(float(loss))
+    return out
+
+
+def _dense_chain(batch=16, seq=512, hidden=1024, depth=6):
+    """The depth-uniform dense chain the search golden prices: compute
+    scales b·s·h² per block while the grad-sync bytes stay h² — the
+    regime where hiding the sync under backward compute pays."""
+    m = FFModel(FFConfig(batch_size=batch))
+    t = m.create_tensor((batch, seq, hidden), name="x")
+    for i in range(depth):
+        t = m.dense(t, hidden, name=f"h{i}")
+    m.dense(t, 8, name="head")
+    return m
+
+
+# ------------------------------------------------------ config + strategy
+def test_config_parse_grad_overlap():
+    cfg = FFConfig()
+    assert cfg.grad_overlap == "off"  # the default never changes a run
+    rest = cfg.parse_args(["--grad-overlap", "ring", "--other"])
+    assert cfg.grad_overlap == "ring"
+    assert rest == ["--other"]
+    assert FFConfig(grad_overlap="auto").grad_overlap == "auto"
+
+
+def test_strategy_json_roundtrip_carries_grad_overlap():
+    mesh = MachineMesh((8, 1), ("data", "model"))
+    st = Strategy(mesh)
+    st.grad_overlap = "ring"
+    st.grad_overlap_price = {
+        "fused_s": 1e-3, "ring_s": 9e-4, "exposed_s": 1e-4,
+        "sync_bytes": 4096.0, "chains": 1, "overlap_frac": 0.9,
+    }
+    st2 = Strategy.from_json(st.to_json())
+    assert st2.grad_overlap == "ring"
+    assert st2.grad_overlap_price == st.grad_overlap_price
+    # an off strategy serializes WITHOUT the keys — old JSON stays valid
+    off = Strategy(mesh)
+    assert "grad_overlap" not in off.to_json()
+    assert Strategy.from_json(off.to_json()).grad_overlap == "off"
+
+
+# ----------------------------------------------------------- ring parity
+_BASE = {}
+
+
+def _base_losses():
+    if "l" not in _BASE:
+        x, y = _data()
+        _BASE["l"] = _step_losses(_model("off"), x, y)
+    return _BASE["l"]
+
+
+def test_ring_fit_parity_fp32_and_zero_extra_syncs():
+    """Acceptance: the in-scan ring grad sync matches the fused loss
+    trajectory over 5 steps at fp32 tolerances, and the fit loop's
+    host-sync ledger shows ZERO additional syncs."""
+    _need8()
+    x, y = _data()
+    m = _model("ring")
+    assert m.executor._grad_ring, "ring did not engage"
+    l1 = _step_losses(m, x, y)
+    np.testing.assert_allclose(_base_losses(), l1, rtol=5e-5, atol=5e-6)
+    # one async epoch over 5 batches = exactly ONE metric-flush sync —
+    # the fused-path count (PR 4) — so the ring added zero
+    m.executor.host_syncs = 0
+    m.fit(x, y, epochs=1, verbose=False)
+    assert m.executor.host_syncs == 1
+
+
+def test_ring_fit_parity_bf16():
+    _need8()
+    x, y = _data()
+    base = _model("off", dtype="bfloat16")
+    rm = _model("ring", dtype="bfloat16")
+    assert rm.executor._grad_ring
+    np.testing.assert_allclose(
+        _step_losses(base, x, y), _step_losses(rm, x, y),
+        rtol=3e-2, atol=3e-2,
+    )
+
+
+def test_ring_zero1_parity():
+    """ZeRO-1 + ring: the param all-gather pipelines against the
+    optimizer update without changing the trajectory."""
+    _need8()
+    x, y = _data()
+    base = _model("off", enable_zero1=True)
+    rm = _model("ring", enable_zero1=True)
+    assert rm.executor._grad_ring
+    np.testing.assert_allclose(
+        _step_losses(base, x, y), _step_losses(rm, x, y),
+        rtol=5e-5, atol=5e-6,
+    )
+
+
+def test_ring_hlo_carries_permute_chain():
+    """The compiled ring step lowers at least the (n−1) data-axis
+    collective-permute hops of one ring all-gather (the fused path — see
+    the byte-identical pin in test_compiled_collectives — has zero)."""
+    _need8()
+    from flexflow_tpu.analysis import extract_collectives
+
+    m = _model("ring")
+    ex = m.executor
+    x = np.zeros((BS, SEQ, HID), np.float32)
+    y = np.zeros((BS, 1), np.int32)
+    xs = [ex._place(x, ex._input_pspec(t), t.shape[0])
+          for t in ex.graph_inputs]
+    ys = ex._place(y, ex._label_pspec(), BS)
+    step = ex._build_step()
+    txt = step.lower(
+        ex.params, ex.state, ex.opt_state, xs, ys, 0
+    ).compile().as_text()
+    n = len(jax.devices())
+    assert extract_collectives(txt)["collective-permute"] >= n - 1
+
+
+# ----------------------------------------------------- executor declines
+def test_executor_declines_data_extent_1():
+    m = _model("ring", mesh=MachineMesh((1, 1), ("data", "model")))
+    assert not m.executor._grad_ring
+    assert m.executor._grad_ring_layers == frozenset()
+    x, y = _data(steps=1)
+    assert np.isfinite(_step_losses(m, x, y, steps=1)).all()
+
+
+def test_executor_declines_pipelined_chain():
+    """A pipelined chain keeps its fused sync regardless of stage_axis:
+    the 1F1B schedule already owns the scan body."""
+    _need8()
+    m = _model("ring", pipeline="2", microbatches=2,
+               mesh=MachineMesh((8, 1), ("data", "model")))
+    assert m.executor.pipeline is not None
+    assert not m.executor._grad_ring
+    x, y = _data(steps=1)
+    assert np.isfinite(_step_losses(m, x, y, steps=1)).all()
+
+
+# ----------------------------------------------------------- the pricing
+def test_overlap_fraction_link_classes():
+    from flexflow_tpu.search.cost import TPUMachineModel
+
+    mach = TPUMachineModel()
+    assert mach.overlap_fraction("data") == mach.OVERLAP_ICI == 0.9
+    dcn = TPUMachineModel(dcn_axes=("data",))
+    assert dcn.overlap_fraction("data") == dcn.OVERLAP_DCN == 0.15
+    assert dcn.overlap_fraction("model") == 0.9
+
+
+def test_chain_grad_overlap_prices_one_chain():
+    from flexflow_tpu.blocks import detect_block_chains
+    from flexflow_tpu.search.cost import TPUMachineModel, chain_grad_overlap
+
+    m = _dense_chain(batch=8, seq=4, hidden=64, depth=4)
+    mesh = MachineMesh((8, 1), ("data", "model"))
+    st = data_parallel_strategy(m.layers, mesh)
+    chain = max(
+        detect_block_chains(m.layers, min_depth=4),
+        key=lambda c: c.depth,
+    )
+    mach = TPUMachineModel()
+    # compute-rich block: the ring hides entirely → saved == fused
+    ov = chain_grad_overlap(chain, st, mesh, mach, block_cost=1.0)
+    assert ov is not None
+    assert ov["overlap_frac"] == 0.9
+    assert ov["ring_degree"] == 8
+    assert ov["sync_bytes"] > 0
+    assert ov["exposed_s"] == 0.0
+    assert ov["saved_s"] == pytest.approx(ov["fused_s"])
+    # compute-starved block: nothing to hide under → exposed == ring,
+    # and forcing the ring would LOSE time (saved < 0 is honest pricing)
+    ov0 = chain_grad_overlap(chain, st, mesh, mach, block_cost=0.0)
+    assert ov0["exposed_s"] == pytest.approx(ov0["ring_s"])
+    assert ov0["saved_s"] == pytest.approx(ov0["fused_s"] - ov0["ring_s"])
+
+
+def test_grad_overlap_adjustment_modes():
+    from flexflow_tpu.search.cost import (
+        TPUMachineModel, grad_overlap_adjustment,
+    )
+
+    m = _dense_chain()
+    mesh = MachineMesh((16, 1), ("data", "model"))
+    st = data_parallel_strategy(m.layers, mesh)
+    mach = TPUMachineModel()
+    delta, price = grad_overlap_adjustment(m.layers, st, mach, mode="auto")
+    assert price is not None and delta > 0.0
+    assert price["chains"] == 1
+    assert 0.0 <= price["exposed_s"] < price["fused_s"]
+    assert price["overlap_frac"] == 0.9
+    assert price["sync_bytes"] > 0
+    # off never prices; a pipelined strategy declines entirely
+    assert grad_overlap_adjustment(m.layers, st, mach, mode="off") == (
+        0.0, None,
+    )
+    from flexflow_tpu.parallel.pipeline import PipelineSpec
+
+    st.pipeline = PipelineSpec(stages=2, microbatches=4)
+    assert grad_overlap_adjustment(m.layers, st, mach, mode="ring") == (
+        0.0, None,
+    )
+
+
+# ------------------------------------------------------------- the search
+def test_search_golden_auto_flips_single_slice_declines_dcn():
+    """Acceptance golden: on a single-slice 4×4 torus the dense chain's
+    ``auto`` winner moves to a placement serial pricing rejects —
+    {data:8, model:2} instead of pure-DP {data:16} — because ringing the
+    grad sync under backward compute discounts the DP arm's dominant
+    cost.  The winner carries ``grad_overlap="ring"``, the aggregated
+    price, and ``:grad-sync-ring`` implied entries.  On the 2-slice DCN
+    machine the same search keeps the fused path (DCN barely overlaps:
+    overlap_frac 0.15 leaves the ring exposed)."""
+    from flexflow_tpu.parallel.machine import PhysicalTopology
+    from flexflow_tpu.parallel.network import (
+        LinkClass,
+        NetworkedMachineModel,
+        SliceTopology,
+    )
+    from flexflow_tpu.search import unity_search
+    from flexflow_tpu.search.cost import TPUMachineModel
+
+    m = _dense_chain()
+    mesh = MachineMesh((16, 1), ("data", "model"))
+    single = TPUMachineModel(
+        topology=PhysicalTopology((4, 4), wrap=(True, True))
+    )
+    kw = dict(graph_inputs=m.graph_inputs, budget=6, machine=single)
+    st_off = unity_search(m.layers, mesh, grad_overlap="off", **kw)
+    st_auto = unity_search(m.layers, mesh, grad_overlap="auto", **kw)
+    assert st_off.grad_overlap == "off"
+    assert st_off.grad_overlap_price is None
+    assert st_off.mesh.axis_size("model") == 1  # serial pricing: pure DP
+    assert st_auto.grad_overlap == "ring", "auto did not flip"
+    assert st_auto.mesh.axis_size("model") == 2
+    assert st_auto.predicted_step_s < st_off.predicted_step_s
+    price = st_auto.grad_overlap_price
+    assert price is not None
+    assert 0.0 <= price["exposed_s"] < price["fused_s"]
+    ring_entries = [
+        e for e in st_auto.implied_collectives
+        if e.reason.endswith(":grad-sync-ring")
+    ]
+    assert ring_entries
+    assert {e.kind for e in ring_entries} == {
+        "reduce-scatter", "collective-permute",
+    }
+    assert all(set(e.axes) == {"data"} for e in ring_entries)
+    # the choice survives serialization (implied stays derived)
+    st2 = Strategy.from_json(st_auto.to_json(layers=m.layers))
+    assert st2.grad_overlap == "ring"
+    assert st2.grad_overlap_price == price
+
+    two = NetworkedMachineModel(
+        SliceTopology(
+            (4, 2), wrap=(True, False),
+            links=(LinkClass(9e10), LinkClass(9e10)),
+        ),
+        num_slices=2,
+        hosts_per_slice=2,
+        dcn_bw_per_uplink=6.25e9,
+        dcn_uplinks_per_host=4,
+        dcn_axes=("data",),
+    )
+    kw2 = dict(graph_inputs=m.graph_inputs, budget=6, machine=two)
+    st2_off = unity_search(m.layers, mesh, grad_overlap="off", **kw2)
+    st2_auto = unity_search(m.layers, mesh, grad_overlap="auto", **kw2)
+    assert st2_auto.grad_overlap == "off", "DCN ring should not pay"
+    assert st2_auto.grad_overlap_price is None
+    assert st2_auto.mesh.shape == st2_off.mesh.shape
+
+
+# ------------------------------------------------------------ the ffcheck
+def test_overlap_check_clean_on_ring_and_fires_on_seeded():
+    """The ``overlap`` check passes the shipped ring program, skips the
+    fused one, and fires when the ring CLAIM is grafted onto the fused
+    HLO — the seeded regression: priced away but never replaced."""
+    _need8()
+    from flexflow_tpu.analysis import analyze_program
+    from flexflow_tpu.analysis.capture import analyze_executor
+
+    x, y = _data(steps=1)
+    rm = _model("ring")
+    _step_losses(rm, x, y, steps=1)
+    rep = analyze_executor(rm.executor, programs=("fit",),
+                           checks=["overlap"])
+    assert rep.ok, rep.violations
+
+    off = _model("off")
+    _step_losses(off, x, y, steps=1)
+    rep_off = analyze_executor(off.executor, programs=("fit",),
+                               checks=["overlap"])
+    assert rep_off.ok  # no claim → skip
+
+    # seed the regression: the ring's claim with the fused program's HLO
+    from flexflow_tpu.analysis.capture import (
+        _grad_ring_details,
+        artifact_from_executor_step,
+    )
+
+    ex = off.executor
+    args = (ex.params, ex.state, ex.opt_state,
+            *ex.place_batch([x[:BS], y[:BS]]), 0)
+    if ex._step_jit is None:
+        ex._step_jit = ex._build_step()
+    compiled = ex._step_jit.lower(*args).compile()
+    art = artifact_from_executor_step(ex, args, compiled)
+    seeded = dataclasses.replace(
+        art, details={"grad_ring": _grad_ring_details(rm.executor)},
+    )
+    v = analyze_program(seeded, checks=["overlap"])
+    assert v, "seeded regression not caught"
+    assert any("collective-permute" in x.message for x in v)
+
+
+def test_overlap_check_catches_surviving_full_bucket_allreduce():
+    """Arm (b) on a synthetic program: the permute chain is present but
+    a fused tail all-reduce at full stacked-bucket bytes survived — the
+    hoisted-accumulation regression."""
+    from flexflow_tpu.analysis import analyze_program
+    from flexflow_tpu.analysis.core import ProgramArtifact
+
+    hops = 7
+    hlo = "\n".join(
+        [
+            f"  %cp.{i} = f32[16]{{0}} collective-permute(%g.{i}), "
+            "source_target_pairs={{0,1},{1,2}}"
+            for i in range(hops)
+        ]
+        + [
+            "  %ar.0 = f32[4,64,64]{2,1,0} all-reduce(%acc), "
+            "replica_groups={}"
+        ]
+    )
+    det = {"grad_overlap": "ring", "chains": [{
+        "start": 0, "depth": 4, "ring_degree": 8, "hops": hops,
+        "bucket_bytes": 4 * 64 * 64 * 4,
+    }]}
+    art = ProgramArtifact(name="seeded", role="fit", hlo=hlo,
+                          details={"grad_ring": det})
+    v = analyze_program(art, checks=["overlap"])
+    assert len(v) == 1
+    assert "all-reduce" in v[0].message
+    # shrink the surviving sync below the stacked bucket (a per-slice
+    # in-scan reduction) and the program is clean
+    small = hlo.replace("f32[4,64,64]", "f32[64,64]")
+    art2 = ProgramArtifact(name="ok", role="fit", hlo=small,
+                           details={"grad_ring": det})
+    assert analyze_program(art2, checks=["overlap"]) == []
+
+
+# ---------------------------------------------------------- observability
+def test_metrics_and_trace_carry_overlap_observability(tmp_path):
+    """ONE instrumented ring run feeds both satellites: the ffmetrics/1
+    records carry the nullable ``exposed_comm_s`` field, the tracer
+    emits ``grad_ring`` spans, and trace_report rolls them up."""
+    _need8()
+    from flexflow_tpu.obs import get_tracer, read_metrics, set_tracer
+    from flexflow_tpu.obs.health import (
+        HealthMonitor,
+        configure_monitor,
+        set_monitor,
+    )
+    from flexflow_tpu.obs.trace import Tracer
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    import trace_report
+
+    path = str(tmp_path / "ring_metrics.jsonl")
+    out = str(tmp_path / "trace.json")
+    mon = configure_monitor(policy="warn", metrics_out=path)
+    set_tracer(Tracer(level="op", out_path=out))
+    try:
+        m = _model("ring")
+        x, y = _data(steps=2)
+        _step_losses(m, x, y, steps=2)
+        stats = m.executor.last_step_stats
+        mon.flush()
+        get_tracer().save()
+    finally:
+        set_monitor(HealthMonitor(policy="off"))
+        set_tracer(Tracer())
+    assert "exposed_comm_s" in stats
+    assert m.strategy.grad_overlap_price is not None
+    assert stats["exposed_comm_s"] == pytest.approx(
+        m.strategy.grad_overlap_price["exposed_s"]
+    )
+    recs = read_metrics(path)
+    assert recs, "no records written"
+    r = recs[-1]
+    assert r["exposed_comm_s"] == pytest.approx(stats["exposed_comm_s"])
+    assert r["schema"] == "ffmetrics/1"  # schema version unchanged
+    doc = json.load(open(out))
+    text = trace_report.render(doc)
+    assert "grad_ring rollup" in text
+    # a pre-overlap stream (no key) still reads: field surfaces as None
+    p = tmp_path / "old.jsonl"
+    p.write_text(json.dumps({
+        "schema": "ffmetrics/1", "step": 0, "t": 0.0, "loss": 1.0,
+        "step_wall_s": 0.01, "counters": {}, "metrics": {},
+    }) + "\n")
+    assert read_metrics(str(p))[0].get("exposed_comm_s") is None
+
+
+def test_bench_compare_exposed_comm_gate(tmp_path, capsys):
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    import bench_compare
+
+    base = {"metric": "m", "value": 100.0, "backend": "cpu",
+            "exposed_comm_frac": 0.2, "grad_overlap": "off"}
+    cur = dict(base, exposed_comm_frac=0.5, grad_overlap="ring")
+    bp, cp = tmp_path / "base.json", tmp_path / "cur.json"
+    bp.write_text(json.dumps(base))
+    cp.write_text(json.dumps(cur))
+    rc = bench_compare.main([str(cp), "--baseline", str(bp)])
+    out = capsys.readouterr().out
+    assert rc == 1, out  # exposed comm growing 2.5x regresses
+    assert "exposed_comm_frac" in out and "REGRESSED" in out
+    assert "grad_overlap differs" in out  # metadata note, not a refusal
+    # a SHRINKING exposure passes; legacy records gate on what they share
+    ok = dict(base, exposed_comm_frac=0.1)
+    op_ = tmp_path / "ok.json"
+    op_.write_text(json.dumps(ok))
+    assert bench_compare.main([str(op_), "--baseline", str(bp)]) == 0
+    old = {"metric": "m", "value": 100.0, "backend": "cpu"}
+    lp = tmp_path / "old.json"
+    lp.write_text(json.dumps(old))
+    assert bench_compare.main([str(cp), "--baseline", str(lp)]) == 0

@@ -98,6 +98,12 @@ COMPARABLE_METADATA = (
     # gate surfaces the change because the kernel shifts peak bytes
     # and tok/s for configuration (not regression) reasons
     "serve_attn",
+    # grad_overlap (r15, docs/PERF.md "Overlapped gradient sync"):
+    # whether the overlap A/B's ring arm actually engaged (a 1-device
+    # host declines at data extent 1) — runs with and without the ring
+    # are the same experiment, but the gate surfaces the change because
+    # exposed_comm_frac only moves when the ring engages
+    "grad_overlap",
 )
 
 # (label, path into the record, higher_is_better) — the gated metrics.
@@ -141,6 +147,14 @@ GATED = (
     # step (the ffcheck ``paged_attn`` audit is the structural twin of
     # this measured gate)
     ("serve_paged_attn_peak_mb", ("serve_paged_attn_peak_mb",), False),
+    # exposed_comm_frac (r15, docs/PERF.md "Overlapped gradient sync")
+    # gates LOWER-is-better: the share of the fused grad sync the ring
+    # decomposition could NOT hide under backward compute on the priced
+    # BERT-Large dp=8 placement — it growing means the overlap model
+    # lost hiding capacity (a link-class regression or an overlap-
+    # fraction drift), the search-quality regression the ring axis
+    # exists to prevent
+    ("exposed_comm_frac", ("exposed_comm_frac",), False),
     ("dlrm", ("secondary", "dlrm", "samples_per_sec"), True),
     ("bert_large", ("secondary", "bert_large", "samples_per_sec"), True),
     ("gpt_decode_cached", ("secondary", "gpt_decode", "cached_tok_per_s"), True),

@@ -41,6 +41,15 @@ spec.loader.exec_module(b)
 print(json.dumps(b._serve_paged_attn_ab(True)))
 PY
 
+echo "== fit overlap A/B (r15: grad-sync ring on real ICI — CPU had virtual-device numbers only) =="
+timeout 900 python - <<'PY' 2>&1 | grep -v WARNING | tee .bench_logs/fit_overlap_ab.json
+import importlib.util, json
+spec = importlib.util.spec_from_file_location("bench", "bench.py")
+b = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(b)
+print(json.dumps(b._fit_overlap_ab(True)))
+PY
+
 echo "== bench.py (headline + attn_core extras) =="
 timeout 2700 python bench.py | tee .bench_logs/bench_b16.json
 

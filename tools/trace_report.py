@@ -162,6 +162,46 @@ def render(doc: Dict, by: str = "both", top: int = 40) -> str:
             )
         )
 
+    # overlapped gradient sync (--grad-overlap, docs/PERF.md): one
+    # grad_ring span nested inside each ringed chain's block_scan,
+    # carrying the ring geometry (hops = data extent − 1), the full
+    # stacked grad bytes the ring moves, and — when the compile-time
+    # overlap pricing was attached — the priced exposed ms per step.
+    # Roll up per chain shape beside the block_scan rollup above.
+    gr = [
+        e for e in events
+        if e.get("ph") == "X" and e.get("name") == "grad_ring"
+    ]
+    if gr:
+        agg3: Dict[str, List[float]] = {}
+        for e in gr:
+            a = e.get("args") or {}
+            key = (f"depth={a.get('depth', '?')} x "
+                   f"{a.get('hops', '?')} hops")
+            row = agg3.setdefault(key, [0, 0.0, 0.0, 0.0])
+            row[0] += 1
+            row[1] += float(e.get("dur", 0.0))
+            row[2] += float(a.get("bytes", 0) or 0)
+            row[3] += float(a.get("exposed_ms", 0.0) or 0.0)
+        rows = [
+            [k, int(n), f"{tot / 1e3:.2f}", f"{mb / 1e6:.2f}",
+             f"{ex_ms:.3f}" if ex_ms else "-",
+             f"{100.0 * tot / wall_us:.1f}%" if wall_us > 0 else "-"]
+            for k, (n, tot, mb, ex_ms) in sorted(
+                agg3.items(), key=lambda kv: -kv[1][1]
+            )
+        ]
+        out.append(
+            "grad_ring rollup (in-scan ring grad sync per chain; "
+            "exposed_ms = priced comm not hidden under backward "
+            "compute):\n"
+            + _table(
+                ["ring", "spans", "total_ms", "grad_MB", "exposed_ms",
+                 "% wall"],
+                rows,
+            )
+        )
+
     counters = summary.get("counters")
     if counters is None:  # fall back to final 'C' events
         counters = {}

@@ -200,27 +200,45 @@ def rank_correlation_gate(
                 st.ops[int(layer.layer_guid)] = best
         return st
 
-    # four genuinely different placements of the same graph: a tiny-MLP
+    # five genuinely different placements of the same graph: a tiny-MLP
     # SEARCH would pick replication everywhere (grad-sync latency beats
     # smoke-scale compute), which ties every prediction — the gate needs
-    # spread, so the placements are fixed by construction
+    # spread, so the placements are fixed by construction.  The body is
+    # a depth-4 UNIFORM dense chain (h0..h3, hidden->hidden) so the
+    # scan-stacked collapse and the grad-overlap ring (both keyed on
+    # chains of >= 4 identical blocks) are exercisable by the fifth arm.
     arms = [
-        ("replicated 8x1", (n_dev, 1), lambda ls, m: Strategy(m)),
-        ("data-parallel 8x1", (n_dev, 1), data_parallel_strategy),
-        ("tensor-parallel 1x8", (1, n_dev), tensor_parallel_strategy),
-        ("hybrid 2x4", (2, n_dev // 2), tensor_parallel_strategy),
+        ("replicated 8x1", (n_dev, 1), lambda ls, m: Strategy(m), {}),
+        ("data-parallel 8x1", (n_dev, 1), data_parallel_strategy, {}),
+        ("tensor-parallel 1x8", (1, n_dev), tensor_parallel_strategy, {}),
+        ("hybrid 2x4", (2, n_dev // 2), tensor_parallel_strategy, {}),
+        # dp + ring overlap (docs/PERF.md "Overlapped gradient sync"):
+        # same placement as the dp arm, but the chain's grad sync rings
+        # inside the backward scan — predicted with the overlap model's
+        # adjustment, measured with --grad-overlap ring on the
+        # scan-stacked executor
+        ("dp 8x1 + ring overlap", (n_dev, 1), data_parallel_strategy,
+         {"stack_blocks": "on", "grad_overlap": "ring"}),
     ]
     rows = []
-    for name, shape, make in arms:
-        cfg = FFConfig(batch_size=batch)
+    for name, shape, make, cfg_kw in arms:
+        cfg = FFConfig(batch_size=batch, **cfg_kw)
         model = FFModel(cfg)
         t = model.create_tensor((batch, hidden), name="x")
-        t = model.dense(t, 2 * hidden, name="d0")
-        t = model.dense(t, 2 * hidden, name="d1")
-        model.dense(t, 8, name="d2")
+        for i in range(4):
+            t = model.dense(t, hidden, name=f"h{i}")
+        model.dense(t, 8, name="head")
         mesh = MachineMesh(shape, ("data", "model"))
         st = make(model.layers, mesh)
         predicted = estimate_strategy_cost(model.layers, st, machine)
+        if cfg_kw.get("grad_overlap") == "ring":
+            from flexflow_tpu.search.cost import grad_overlap_adjustment
+
+            delta, price = grad_overlap_adjustment(
+                model.layers, st, machine, mode="ring"
+            )
+            if price is not None:
+                predicted = max(0.0, predicted - delta)
         model.compile(
             optimizer=SGDOptimizer(lr=0.01),
             loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,

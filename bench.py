@@ -1199,6 +1199,7 @@ def _serve_disagg_ab(on_tpu: bool) -> dict:
     with tempfile.TemporaryDirectory() as td:
         col_path = os.path.join(td, "colocated.jsonl")
         dis_path = os.path.join(td, "disagg.jsonl")
+        spans_path = os.path.join(td, "disagg_spans.jsonl")
 
         engine = ServeEngine(
             model, slots=slots, block_size=16 if on_tpu else 8,
@@ -1210,11 +1211,16 @@ def _serve_disagg_ab(on_tpu: bool) -> dict:
             for r in engine.sched.finished
         }
 
+        # the disagg arm runs TRACED (--serve-spans-out equivalent):
+        # tracing is pinned zero-added-sync and bit-identical, and the
+        # span stream yields the queue-wait + measured-transit facts
+        # the record surfaces (ffspan/1, docs/OBSERVABILITY.md)
         cluster = DisaggregatedCluster(
             model, prefill_slots=slots, decode_slots=slots,
             prefill_block_size=16 if on_tpu else 8,
             decode_block_size=32 if on_tpu else 16,
             sync_every=4, machine=machine, metrics_out=dis_path,
+            spans_out=spans_path,
         )
         rep_d = cluster.run(synthetic_requests(spec))
         dis = {}
@@ -1224,6 +1230,21 @@ def _serve_disagg_ab(on_tpu: bool) -> dict:
 
         tpot_c = _decode_window_tpot_ms(col_path)
         tpot_d = _decode_window_tpot_ms(dis_path)
+
+        from flexflow_tpu.obs.spans import read_spans
+
+        span_recs = read_spans(spans_path)
+        # prefill-pool admission waits (the TTFT queue leg) + measured
+        # send->deliver transit beside the priced estimate
+        queue_ms = [
+            (s["t1"] - s["t0"]) * 1e3 for s in span_recs
+            if s["name"] == "queue" and s.get("pool") == "prefill"
+        ]
+        observed_ms = [
+            s["attrs"]["observed_ms"] for s in span_recs
+            if s["name"] == "handoff_transit"
+            and s["attrs"].get("observed_ms") is not None
+        ]
 
     outputs_match = set(col) == set(dis) and all(
         np.array_equal(col[i], dis[i]) for i in col
@@ -1250,6 +1271,12 @@ def _serve_disagg_ab(on_tpu: bool) -> dict:
         "serve_handoff_ms": (
             round(rep_d.handoff_p99_ms, 4)
             if rep_d.handoff_p99_ms is not None else None
+        ),
+        "serve_ttft_queue_ms_p99": (
+            round(_pctl(queue_ms, 99), 4) if queue_ms else None
+        ),
+        "serve_handoff_observed_ms": (
+            round(_pctl(observed_ms, 99), 4) if observed_ms else None
         ),
         "handoff_p50_ms": (
             round(rep_d.handoff_p50_ms, 4)
@@ -1742,6 +1769,14 @@ def run_bench(backend: str) -> None:
         "serve_disagg_p99_tpot_ms": None,
         "serve_handoff_ms": None,
         "serve_disagg_split": None,
+        # per-request tracing (ISSUE 16, docs/OBSERVABILITY.md): the
+        # disagg arm runs traced, and the ffspan/1 stream yields the
+        # prefill-pool admission-wait p99 (the TTFT queue leg) and the
+        # MEASURED handoff transit p99 beside the priced estimate
+        # above — comparable metadata, not gated (wall-clock waits are
+        # load-shaped, not regressions)
+        "serve_ttft_queue_ms_p99": None,
+        "serve_handoff_observed_ms": None,
         # paged decode attention (ISSUE 14, docs/PERF.md "Paged decode
         # attention"): the paged decode program's peak live temp bytes
         # (LOWER-is-better gate — the gather materialization coming
@@ -1836,6 +1871,10 @@ def run_bench(backend: str) -> None:
     record["serve_disagg_p99_tpot_ms"] = dab.get("serve_disagg_p99_tpot_ms")
     record["serve_handoff_ms"] = dab.get("serve_handoff_ms")
     record["serve_disagg_split"] = dab.get("serve_disagg_split")
+    record["serve_ttft_queue_ms_p99"] = dab.get("serve_ttft_queue_ms_p99")
+    record["serve_handoff_observed_ms"] = dab.get(
+        "serve_handoff_observed_ms"
+    )
     qab = record["secondary"].get("serve_paged_attn_ab") or {}
     record["serve_paged_attn_peak_mb"] = qab.get("serve_paged_attn_peak_mb")
     record["serve_attn"] = qab.get("serve_attn")

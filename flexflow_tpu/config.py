@@ -73,6 +73,14 @@ class FFConfig:
     # per-step JSONL metrics stream (loss/grad-norm/throughput/counter
     # deltas, one schema-versioned record per step)
     metrics_out: Optional[str] = None
+    # ffspan/1 per-request span stream for serve runs (--serve-spans-out,
+    # docs/OBSERVABILITY.md "Request timelines"); None = tracing off,
+    # which keeps metrics streams byte-identical to untraced builds
+    serve_spans_out: Optional[str] = None
+    # size-based rotation for JSONL streams (metrics + spans): when a
+    # stream file crosses this many MB it is rotated to .1, .2, ... and
+    # read_metrics reads the rotated set back in order.  0 = unbounded.
+    metrics_max_mb: float = 0.0
     # anomaly policy: non-finite loss/grad + EMA loss-spike detectors.
     # "dump"/"raise" write a debug bundle (config, strategy, last-N step
     # records, Chrome trace, memory snapshot) on the first anomaly.
@@ -318,6 +326,10 @@ class FFConfig:
                 self.trace_level = take()
             elif a == "--metrics-out":
                 self.metrics_out = take()
+            elif a == "--serve-spans-out":
+                self.serve_spans_out = take()
+            elif a == "--metrics-max-mb":
+                self.metrics_max_mb = float(take())
             elif a == "--health":
                 self.health = take()
             elif a == "--health-dir":

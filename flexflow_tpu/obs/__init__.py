@@ -22,11 +22,27 @@ from flexflow_tpu.obs.health import (
     get_monitor,
     set_monitor,
 )
+from flexflow_tpu.obs.aggregate import (
+    AGG_SCHEMA,
+    MetricsAggregator,
+    QuantileSketch,
+    aggregate_streams,
+)
 from flexflow_tpu.obs.metrics import (
     METRICS_SCHEMA,
     MetricsStream,
+    metrics_file_set,
     read_metrics,
     step_record,
+)
+from flexflow_tpu.obs.schemas import SCHEMAS
+from flexflow_tpu.obs.spans import (
+    SPAN_KINDS,
+    SPAN_SCHEMA,
+    SpanRecorder,
+    read_spans,
+    span_record,
+    spans_by_trace,
 )
 from flexflow_tpu.obs.trace import (
     CORE_COUNTERS,
@@ -58,6 +74,18 @@ __all__ = [
     "configure_monitor_from_config",
     "MetricsStream",
     "METRICS_SCHEMA",
+    "metrics_file_set",
     "read_metrics",
     "step_record",
+    "SpanRecorder",
+    "SPAN_SCHEMA",
+    "SPAN_KINDS",
+    "read_spans",
+    "span_record",
+    "spans_by_trace",
+    "MetricsAggregator",
+    "QuantileSketch",
+    "AGG_SCHEMA",
+    "aggregate_streams",
+    "SCHEMAS",
 ]

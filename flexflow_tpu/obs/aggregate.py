@@ -1,0 +1,308 @@
+"""Fleet metrics aggregation: N ``ffmetrics/1`` streams → one rollup.
+
+PR 13 made a serve deployment plural — a disaggregated cluster writes
+one metrics stream per pool, and ROADMAP #2's fleet router/autoscaler
+scales replica counts by "watching the ``ffmetrics/1`` window stream".
+This module is that watcher's input signal, landed before the fleet
+tier so it can be built against a tested interface:
+
+  * :class:`QuantileSketch` — a mergeable DDSketch-style quantile sketch
+    (log-spaced buckets, relative-error guarantee ``alpha``) so p50/p99
+    TTFT/TPOT aggregate across pools WITHOUT retaining every sample —
+    sketches from independent engines merge exactly.
+  * :class:`MetricsAggregator` — consumes per-pool/per-engine record
+    streams (``ingest`` one record, ``ingest_stream`` a whole file) into
+    rolling-window rollups: queue depth, occupancy, prefix hit rate,
+    tok/s, finished-request latency sketches.
+  * ``aggregate_report()`` — the rollup dict (per-source + fleet), and
+    ``snapshot()`` — a versioned ``ffagg/1`` record that round-trips
+    through :meth:`MetricsAggregator.from_snapshot`, so an autoscaler
+    can persist/merge its view across restarts.
+
+Pure stdlib — importable without jax (the fleet controller will not run
+on an accelerator host).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Any, Dict, Optional
+
+from flexflow_tpu.obs.metrics import json_safe, read_metrics
+
+# bump when a field changes meaning; ADDING fields keeps the version
+# (consumers ignore unknown keys — same interop rule as ffmetrics/1)
+AGG_SCHEMA = "ffagg/1"
+
+
+class QuantileSketch:
+    """Mergeable quantile sketch with bounded relative error.
+
+    DDSketch-style: value ``v`` > 0 lands in bucket ``ceil(log_gamma v)``
+    with ``gamma = (1+alpha)/(1-alpha)``; any returned quantile is within
+    relative error ``alpha`` of an actual sample at that rank.  Merging
+    two sketches (same alpha) is bucket-wise addition — the merged sketch
+    equals the sketch of the concatenated samples, which is what lets
+    per-pool sketches roll up into a fleet percentile without shipping
+    samples."""
+
+    def __init__(self, alpha: float = 0.01):
+        if not (0.0 < alpha < 1.0):
+            raise ValueError(f"alpha must be in (0, 1), got {alpha}")
+        self.alpha = float(alpha)
+        self.gamma = (1.0 + self.alpha) / (1.0 - self.alpha)
+        self._log_gamma = math.log(self.gamma)
+        self.buckets: Dict[int, int] = {}
+        self.zeros = 0  # values <= 0 (latencies: degenerate but legal)
+        self.count = 0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+
+    def add(self, v: float) -> None:
+        v = float(v)
+        if not math.isfinite(v):
+            return  # non-finite samples carry no rank information
+        self.count += 1
+        self.vmin = min(self.vmin, v)
+        self.vmax = max(self.vmax, v)
+        if v <= 0.0:
+            self.zeros += 1
+            return
+        idx = math.ceil(math.log(v) / self._log_gamma)
+        self.buckets[idx] = self.buckets.get(idx, 0) + 1
+
+    def quantile(self, q: float) -> float:
+        """Value at percentile ``q`` in [0, 100] (nearest-rank over the
+        bucket midpoints); NaN on an empty sketch."""
+        if self.count == 0:
+            return float("nan")
+        rank = q / 100.0 * (self.count - 1)
+        seen = self.zeros
+        if rank < seen:
+            return 0.0
+        for idx in sorted(self.buckets):
+            seen += self.buckets[idx]
+            if rank < seen:
+                # bucket (gamma^(i-1), gamma^i]; midpoint 2g^i/(g+1) is
+                # within alpha relative error of every value in it
+                return 2.0 * self.gamma ** idx / (self.gamma + 1.0)
+        return self.vmax
+
+    def merge(self, other: "QuantileSketch") -> None:
+        if abs(other.alpha - self.alpha) > 1e-12:
+            raise ValueError(
+                f"cannot merge sketches with alpha {self.alpha} != "
+                f"{other.alpha}"
+            )
+        for idx, n in other.buckets.items():
+            self.buckets[idx] = self.buckets.get(idx, 0) + n
+        self.zeros += other.zeros
+        self.count += other.count
+        self.vmin = min(self.vmin, other.vmin)
+        self.vmax = max(self.vmax, other.vmax)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "alpha": self.alpha,
+            "count": self.count,
+            "zeros": self.zeros,
+            "min": self.vmin if self.count else None,
+            "max": self.vmax if self.count else None,
+            "buckets": {str(k): v for k, v in sorted(self.buckets.items())},
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "QuantileSketch":
+        sk = cls(alpha=float(d["alpha"]))
+        sk.count = int(d.get("count", 0))
+        sk.zeros = int(d.get("zeros", 0))
+        if d.get("min") is not None:
+            sk.vmin = float(d["min"])
+        if d.get("max") is not None:
+            sk.vmax = float(d["max"])
+        sk.buckets = {int(k): int(v) for k, v in (d.get("buckets") or {}).items()}
+        return sk
+
+
+# the latency channels the aggregator sketches, sourced from each
+# finished-request entry in the serve vocabulary (metrics.serve.finished)
+_LATENCY_KEYS = ("ttft_ms", "tpot_ms")
+
+
+class MetricsAggregator:
+    """Roll N per-pool ``ffmetrics/1`` streams into one fleet view.
+
+    ``window`` bounds the rolling per-source state (a deque of the last
+    N records' gauges) — the sketches are cumulative and mergeable, so
+    nothing retains full samples.  Sources are named by the caller
+    (pool phase, replica id, hostname — the aggregator is agnostic)."""
+
+    def __init__(self, window: int = 64, alpha: float = 0.01):
+        self.window = int(window)
+        self.alpha = float(alpha)
+        self.sketches: Dict[str, QuantileSketch] = {
+            k: QuantileSketch(alpha) for k in _LATENCY_KEYS
+        }
+        self._src: Dict[str, Dict[str, Any]] = {}
+        self.records_ingested = 0
+        self.requests_finished = 0
+
+    def _source(self, name: str) -> Dict[str, Any]:
+        return self._src.setdefault(
+            name,
+            {
+                "windows": 0,
+                "recent": deque(maxlen=self.window),
+                "phase": None,
+                "queue_depth": None,
+                "occupancy": None,
+                "prefix_hit_rate": None,
+                "finished": 0,
+                "new_tokens": 0,
+            },
+        )
+
+    def ingest(self, source: str, record: Dict[str, Any]) -> None:
+        """Fold one ``ffmetrics/1`` record from ``source`` into the
+        rollup.  Records without a ``metrics.serve`` dict (training
+        streams, warmup windows) are counted but contribute no serve
+        gauges — the aggregator shares the reader, not the writer."""
+        st = self._source(source)
+        st["windows"] += 1
+        self.records_ingested += 1
+        m = record.get("metrics")
+        serve = m.get("serve") if isinstance(m, dict) else None
+        if not isinstance(serve, dict):
+            return
+        tokens = 0
+        wall = record.get("step_wall_s") or 0.0
+        tps = record.get("tokens_per_s") or 0.0
+        if wall and tps:
+            tokens = int(round(tps * wall))
+        st["recent"].append(
+            {
+                "queue_depth": serve.get("queue_depth"),
+                "occupancy": serve.get("occupancy"),
+                "tokens": tokens,
+                "wall_s": wall,
+            }
+        )
+        st["phase"] = serve.get("phase", st["phase"])
+        if serve.get("queue_depth") is not None:
+            st["queue_depth"] = serve["queue_depth"]
+        if serve.get("occupancy") is not None:
+            st["occupancy"] = serve["occupancy"]
+        if serve.get("prefix_hit_rate") is not None:
+            st["prefix_hit_rate"] = serve["prefix_hit_rate"]
+        st["new_tokens"] += tokens
+        for f in serve.get("finished", ()):
+            st["finished"] += 1
+            self.requests_finished += 1
+            for k in _LATENCY_KEYS:
+                v = f.get(k)
+                if v is not None:
+                    self.sketches[k].add(float(v))
+
+    def ingest_stream(self, source: str, path: str) -> int:
+        """Read a whole (possibly rotated) stream file into the rollup;
+        returns the record count."""
+        records = read_metrics(path)
+        for r in records:
+            self.ingest(source, r)
+        return len(records)
+
+    # --- rollups ------------------------------------------------------
+    def aggregate_report(self) -> Dict[str, Any]:
+        """The fleet rollup: per-source gauges over the rolling window
+        plus fleet-wide sums/means and sketch percentiles — the signal
+        ROADMAP #2's autoscaler scales replica counts on."""
+        sources: Dict[str, Any] = {}
+        for name, st in sorted(self._src.items()):
+            recent = [r for r in st["recent"]]
+            occ = [r["occupancy"] for r in recent if r["occupancy"] is not None]
+            qd = [r["queue_depth"] for r in recent
+                  if r["queue_depth"] is not None]
+            w_tok = sum(r["tokens"] for r in recent)
+            w_wall = sum(r["wall_s"] for r in recent)
+            sources[name] = {
+                "windows": st["windows"],
+                "phase": st["phase"],
+                "queue_depth": st["queue_depth"],
+                "queue_depth_mean_w": sum(qd) / len(qd) if qd else None,
+                "occupancy": st["occupancy"],
+                "occupancy_mean_w": sum(occ) / len(occ) if occ else None,
+                "prefix_hit_rate": st["prefix_hit_rate"],
+                "finished": st["finished"],
+                "new_tokens": st["new_tokens"],
+                "tok_s_w": w_tok / w_wall if w_wall > 0 else None,
+            }
+        live = [s for s in sources.values() if s["queue_depth"] is not None]
+        occ_live = [s["occupancy"] for s in sources.values()
+                    if s["occupancy"] is not None]
+        fleet: Dict[str, Any] = {
+            "sources": len(sources),
+            "queue_depth": sum(s["queue_depth"] for s in live) if live else None,
+            "occupancy_mean": (
+                sum(occ_live) / len(occ_live) if occ_live else None
+            ),
+            "requests_finished": self.requests_finished,
+            "new_tokens": sum(s["new_tokens"] for s in sources.values()),
+        }
+        for k in _LATENCY_KEYS:
+            sk = self.sketches[k]
+            base = k[:-3]  # "ttft_ms" -> "ttft"
+            fleet[f"{base}_p50_ms"] = sk.quantile(50.0) if sk.count else None
+            fleet[f"{base}_p99_ms"] = sk.quantile(99.0) if sk.count else None
+        return {"sources": sources, "fleet": fleet}
+
+    # --- ffagg/1 snapshot ---------------------------------------------
+    def snapshot(self, t: Optional[float] = None) -> Dict[str, Any]:
+        """One versioned ``ffagg/1`` record: the report plus the raw
+        sketches, strict-JSON safe (non-finite floats string-encoded by
+        the shared ``json_safe`` policy on write).  Restorable by
+        :meth:`from_snapshot` and mergeable across restarts."""
+        if t is None:
+            import time
+
+            t = time.time()
+        return json_safe({
+            "schema": AGG_SCHEMA,
+            "t": float(t),
+            "window": self.window,
+            "alpha": self.alpha,
+            "records_ingested": self.records_ingested,
+            "report": self.aggregate_report(),
+            "sketches": {k: sk.to_dict() for k, sk in self.sketches.items()},
+        })
+
+    @classmethod
+    def from_snapshot(cls, snap: Dict[str, Any]) -> "MetricsAggregator":
+        """Restore the mergeable state (sketches + fleet counters) from
+        an ``ffagg/1`` record.  Per-source rolling windows are NOT in the
+        snapshot — they are ephemeral by design; the report's per-source
+        section is carried for display but a restored aggregator starts
+        its windows fresh."""
+        if snap.get("schema") != AGG_SCHEMA:
+            raise ValueError(
+                f"snapshot schema {snap.get('schema')!r} != {AGG_SCHEMA!r}"
+            )
+        agg = cls(window=int(snap.get("window", 64)),
+                  alpha=float(snap.get("alpha", 0.01)))
+        agg.records_ingested = int(snap.get("records_ingested", 0))
+        for k, d in (snap.get("sketches") or {}).items():
+            if k in agg.sketches:
+                agg.sketches[k] = QuantileSketch.from_dict(d)
+        rep = (snap.get("report") or {}).get("fleet") or {}
+        agg.requests_finished = int(rep.get("requests_finished", 0))
+        return agg
+
+
+def aggregate_streams(
+    paths: Dict[str, str], window: int = 64, alpha: float = 0.01
+) -> Dict[str, Any]:
+    """Convenience: roll ``{source: path}`` streams into one report."""
+    agg = MetricsAggregator(window=window, alpha=alpha)
+    for name, path in paths.items():
+        agg.ingest_stream(name, path)
+    return agg.aggregate_report()

@@ -212,13 +212,23 @@ class MetricsStream:
     Opened lazily on the first append (a configured-but-never-stepped
     run leaves no file) and flushed per record — the stream is a flight
     recorder, so its whole point is surviving the crash that ends the
-    run."""
+    run.
 
-    def __init__(self, path: Optional[str]):
+    ``max_mb`` > 0 caps the live file: when an append pushes it past the
+    threshold the stream rotates (``path`` → ``path.1``, shifting any
+    older ``path.N`` to ``path.N+1``) and keeps writing to a fresh
+    ``path``, so a long serve run's stream stays bounded per file while
+    :func:`read_metrics` still returns the whole set in order.  Rotated
+    files end on a record boundary — only the live tail can be torn."""
+
+    def __init__(self, path: Optional[str], max_mb: float = 0.0):
         self.path = path
         self.enabled = bool(path)
         self.records_written = 0
+        self.rotations = 0
+        self.max_bytes = int(max_mb * 1e6) if max_mb and max_mb > 0 else 0
         self._f = None
+        self._bytes = 0
 
     def append(self, record: Dict[str, Any]) -> None:
         if not self.enabled:
@@ -228,10 +238,32 @@ class MetricsStream:
             if d:
                 os.makedirs(d, exist_ok=True)
             self._f = open(self.path, "a")
-        json.dump(json_safe(record), self._f)
-        self._f.write("\n")
+            try:
+                self._bytes = os.path.getsize(self.path)
+            except OSError:
+                self._bytes = 0
+        line = json.dumps(json_safe(record)) + "\n"
+        self._f.write(line)
         self._f.flush()
+        self._bytes += len(line)
         self.records_written += 1
+        if self.max_bytes and self._bytes >= self.max_bytes:
+            self._rotate()
+
+    def _rotate(self) -> None:
+        """Shift ``path.N`` → ``path.N+1`` (highest first), move the live
+        file to ``path.1``, reopen fresh.  Rename-based, so the rotated
+        files are complete — no record is ever split across files."""
+        self._f.close()
+        self._f = None
+        n = 1
+        while os.path.exists(f"{self.path}.{n}"):
+            n += 1
+        for i in range(n, 1, -1):
+            os.replace(f"{self.path}.{i - 1}", f"{self.path}.{i}")
+        os.replace(self.path, f"{self.path}.1")
+        self._bytes = 0
+        self.rotations += 1
 
     def close(self) -> None:
         if self._f is not None:
@@ -239,19 +271,37 @@ class MetricsStream:
             self._f = None
 
 
+def metrics_file_set(path: str) -> List[str]:
+    """The rotated set for ``path``, oldest first: ``path.N`` … ``path.1``
+    then the live ``path`` — i.e. chronological record order.  Files that
+    do not exist are omitted; a never-rotated stream is just ``[path]``."""
+    rotated = []
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        rotated.append(f"{path}.{n}")
+        n += 1
+    out = list(reversed(rotated))
+    if os.path.exists(path) or not out:
+        out.append(path)
+    return out
+
+
 def read_metrics(path: str) -> List[Dict[str, Any]]:
     """Parse a metrics JSONL file back into records (non-finite floats
     restored).  A trailing partial line — the signature of a hard crash
-    mid-write — is skipped, everything before it is returned."""
+    mid-write — is skipped, everything before it is returned.  When the
+    stream rotated (``MetricsStream(max_mb=...)``) the whole rotated set
+    is read transparently, oldest file first."""
     out: List[Dict[str, Any]] = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue  # torn tail line
-            out.append({k: _unclean(v) for k, v in rec.items()})
+    for p in metrics_file_set(path):
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail line
+                out.append({k: _unclean(v) for k, v in rec.items()})
     return out

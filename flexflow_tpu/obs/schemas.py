@@ -1,0 +1,91 @@
+"""Registry of every versioned wire/file schema the repo emits.
+
+Each persistent artifact carries a ``schema`` tag (``ff<name>/<ver>``)
+so readers can refuse stale or foreign files; this module is the single
+place those tags are enumerated.  The tier-0 lint gate (``tools/lint.sh``
+→ ``tools/lint_schemas.py``) greps every ``ff[a-z]+/[0-9]+`` literal in
+the source tree and fails on any string not registered here — a new
+schema (or a typo'd version bump) cannot land silently.
+
+The shared interop rule, stated once: ADDING fields to a record keeps
+its version (consumers MUST ignore unknown keys); a version bumps only
+when an existing field changes meaning.  Every schema below has a
+round-trip test in tests/test_schemas.py — registering a tag without
+one fails that suite's completeness check.
+
+Deliberately pure stdlib with no package-relative imports: the lint
+runner loads this file by path (no jax, no flexflow_tpu import).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+# tag -> (owning module, one-line description)
+SCHEMAS: Dict[str, Tuple[str, str]] = {
+    "ffmetrics/1": (
+        "flexflow_tpu/obs/metrics.py",
+        "per-step/per-window metrics JSONL (--metrics-out)",
+    ),
+    "ffspan/1": (
+        "flexflow_tpu/obs/spans.py",
+        "per-request lifecycle span JSONL (--serve-spans-out)",
+    ),
+    "ffagg/1": (
+        "flexflow_tpu/obs/aggregate.py",
+        "fleet metrics aggregation snapshot (MetricsAggregator)",
+    ),
+    "ffcal/1": (
+        "flexflow_tpu/search/calibration.py",
+        "cost-model calibration store JSON (--calibration-out)",
+    ),
+    "ffckpt/2": (
+        "flexflow_tpu/model.py",
+        "atomic npz checkpoint with manifest (save_checkpoint)",
+    ),
+    "ffckpt/1": (
+        "flexflow_tpu/model.py",
+        "legacy manifest-less checkpoint (read-only back-compat)",
+    ),
+    "ffkv/1": (
+        "flexflow_tpu/serve/wire.py",
+        "digest-stamped KV handoff wire frame (encode_handoff)",
+    ),
+    "ffdrain/1": (
+        "flexflow_tpu/serve/engine.py",
+        "serve drain/restore payload (--serve-drain-file)",
+    ),
+    "ffcheck/1": (
+        "flexflow_tpu/analysis/core.py",
+        "compiled-program static-analysis report (--verify-compiled)",
+    ),
+}
+
+# matches a schema tag wherever it appears in source — string literal,
+# docstring, or comment; intentionally broad so drift cannot hide
+SCHEMA_RE = re.compile(r"\bff[a-z]+/[0-9]+\b")
+
+
+def known(tag: str) -> bool:
+    return tag in SCHEMAS
+
+
+def assert_known(tag: str) -> str:
+    if tag not in SCHEMAS:
+        raise ValueError(
+            f"unregistered schema tag {tag!r} — add it to "
+            f"flexflow_tpu/obs/schemas.py (and a round-trip test) first"
+        )
+    return tag
+
+
+def scan_text(text: str, path: str = "<text>") -> List[Tuple[str, int, str]]:
+    """All unregistered ``ff*/N`` literals in ``text`` as
+    ``(path, line_number, literal)``."""
+    out: List[Tuple[str, int, str]] = []
+    for i, line in enumerate(text.splitlines(), 1):
+        for m in SCHEMA_RE.finditer(line):
+            if m.group(0) not in SCHEMAS:
+                out.append((path, i, m.group(0)))
+    return out

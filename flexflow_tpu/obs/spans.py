@@ -1,0 +1,260 @@
+"""Per-request distributed tracing: the ``ffspan/1`` lifecycle stream.
+
+The Chrome tracer (obs/trace.py) answers "what did this ENGINE do when";
+the ``ffmetrics/1`` stream answers "is this run healthy per window".
+Neither follows ONE request end to end — and PR 13 split serving across
+prefill/decode pools joined by a :class:`~flexflow_tpu.serve.transport.
+Transport`, so a single request's life now spans two engines.  This
+module adds the request axis: every request carries a trace context
+(``trace_id`` + a parent span id) from submission through queue-wait,
+admission, per-chunk prefill, handoff frame encode / transit / restore,
+decode windows, preemption spill/restore, speculative accept runs, and
+finish / reject / expiry.  The context crosses the ``ffkv/1`` wire frame
+(``serve/wire.py``), so the decode pool's spans parent correctly under
+the prefill pool's — the same plumbing a future gRPC transport and
+replica→replica migration (ROADMAP #2) will reuse.
+
+Record schema (``SPAN_SCHEMA``; vocabulary table in
+docs/OBSERVABILITY.md):
+
+  * ``schema`` — version tag (``ffspan/1``)
+  * ``trace_id`` — one id per request per run (deterministic:
+    ``t<request-id>``), shared by every span of that request on every
+    pool
+  * ``span`` — this span's id (unique within the stream), ``parent`` —
+    the id it nests under (``None`` for the root ``request`` span)
+  * ``name`` — one of :data:`SPAN_KINDS`
+  * ``req`` — the request id (int), ``pool`` — emitting pool phase
+    (``"prefill"`` / ``"decode"`` / ``None`` colocated)
+  * ``t0`` / ``t1`` — run-relative seconds (both pools of a disagg
+    cluster share one clock base, so cross-pool chains are monotone)
+  * ``attrs`` — span-kind-specific facts (bytes, priced vs observed
+    handoff ms, chunk offsets, token counts, ...)
+
+Emission is OFF the sync path by construction: every timestamp is a
+host-side clock read of work the engine already measured, spans are
+buffered in memory and flushed in one batch per window AFTER the
+window's single host sync (``ServeEngine._window`` phase 3) — zero
+added host syncs, pinned by tests/test_spans.py against the tracer's
+``host_syncs`` ledger.  With no ``--serve-spans-out`` the recorder is
+simply absent and every serve stream is byte-identical to a build
+without this module.
+
+Storage is append-only JSONL via :class:`MetricsStream` — same strict
+JSON NaN policy, same torn-tail tolerance, same ``--metrics-max-mb``
+rotation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from flexflow_tpu.obs.metrics import MetricsStream, read_metrics
+
+# bump when a field changes meaning; ADDING fields/kinds is compatible
+# and does not bump (consumers must ignore unknown keys and kinds)
+SPAN_SCHEMA = "ffspan/1"
+
+# the span-name vocabulary (docs/OBSERVABILITY.md has the table):
+#   request         root span, submission → terminal (attrs: outcome)
+#   queue           waiting for a batch slot (one per admission wait)
+#   prefill         one prefill chunk's host dispatch (attrs: lo, n)
+#   first_token     instant: first token flushed to the host
+#   decode_window   one flush window's decode participation
+#   spec            speculative accept run inside a window (attrs: k,
+#                   drafted, accepted)
+#   spill           preemption: KV spilled to host, slot freed
+#   restore         spilled KV restored into a slot on (re)admission
+#   handoff_encode  disagg: spill + ffkv/1 frame encode on prefill pool
+#   handoff_transit disagg: frame in flight on the Transport (attrs:
+#                   priced_ms — estimate_kv_handoff_time — beside
+#                   observed_ms, the measured send→deliver wall)
+#   handoff_restore disagg: frame decode + requeue on the decode pool
+#   finish          instant: request finished (attrs: reason)
+#   reject          instant: admission refused (attrs: reason)
+#   expire          instant: deadline exceeded in queue
+SPAN_KINDS = (
+    "request",
+    "queue",
+    "prefill",
+    "first_token",
+    "decode_window",
+    "spec",
+    "spill",
+    "restore",
+    "handoff_encode",
+    "handoff_transit",
+    "handoff_restore",
+    "finish",
+    "reject",
+    "expire",
+)
+
+
+def span_record(
+    name: str,
+    trace_id: str,
+    span_id: str,
+    t0: float,
+    t1: float,
+    parent: Optional[str] = None,
+    req: Optional[int] = None,
+    pool: Optional[str] = None,
+    attrs: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Build one schema-conformant span record (the ONE place the field
+    set lives — emitters and tests share it)."""
+    return {
+        "schema": SPAN_SCHEMA,
+        "trace_id": str(trace_id),
+        "span": str(span_id),
+        "parent": None if parent is None else str(parent),
+        "name": str(name),
+        "req": None if req is None else int(req),
+        "pool": None if pool is None else str(pool),
+        "t0": float(t0),
+        "t1": float(t1),
+        "attrs": dict(attrs) if attrs else {},
+    }
+
+
+class SpanRecorder:
+    """Window-batched ``ffspan/1`` writer shared by scheduler, engine and
+    disagg router.
+
+    One recorder per serve run; a disaggregated cluster passes the SAME
+    recorder to both pool engines so span ids stay unique and both pools
+    share one clock base (``set_base``).  ``span()`` only appends to an
+    in-memory buffer — file I/O happens in ``flush()``, which the engine
+    calls once per window after its single host sync, keeping emission
+    entirely off the sync path."""
+
+    def __init__(self, path: Optional[str], max_mb: float = 0.0):
+        self.stream = MetricsStream(path, max_mb=max_mb)
+        self.enabled = self.stream.enabled
+        self.base: float = 0.0
+        self.spans_emitted = 0
+        self._buf: List[Dict[str, Any]] = []
+        self._next = 0
+
+    # --- clocks -------------------------------------------------------
+    def set_base(self, t0: float) -> None:
+        """Pin the run's absolute clock origin (``time.perf_counter()``
+        at run start).  All span times are relative to it."""
+        self.base = float(t0)
+
+    def now(self) -> float:
+        import time
+
+        return time.perf_counter() - self.base
+
+    def rel(self, t_abs: float) -> float:
+        """Convert an absolute ``perf_counter`` stamp (e.g. the
+        scheduler's ``t_first_token``) to run-relative seconds."""
+        return float(t_abs) - self.base
+
+    # --- ids ----------------------------------------------------------
+    def next_id(self) -> str:
+        """Allocate a span id without emitting yet — used when the id
+        must be embedded in a wire frame BEFORE the span's end time is
+        known (``handoff_encode``)."""
+        sid = f"s{self._next}"
+        self._next += 1
+        return sid
+
+    def begin_trace(self, req) -> None:
+        """Attach a trace context to a request (idempotent — a request
+        restored from an ``ffkv/1`` frame already carries one).  The
+        trace id is deterministic per request id, so both pools and the
+        report agree without coordination."""
+        if getattr(req, "trace_id", None) is None:
+            req.trace_id = f"t{req.id}"
+            req.span_parent = f"t{req.id}/root"
+
+    # --- emission -----------------------------------------------------
+    def span(
+        self,
+        name: str,
+        req,
+        t0: float,
+        t1: float,
+        parent: Optional[str] = None,
+        pool: Optional[str] = None,
+        span_id: Optional[str] = None,
+        **attrs,
+    ) -> str:
+        """Buffer one span for the request (no I/O).  ``parent`` defaults
+        to the request's root span; returns the span id so children can
+        nest under it."""
+        if not self.enabled or getattr(req, "trace_id", None) is None:
+            return ""
+        sid = span_id if span_id is not None else self.next_id()
+        if parent is None:
+            parent = getattr(req, "span_parent", None)
+        self._buf.append(
+            span_record(
+                name,
+                req.trace_id,
+                sid,
+                t0,
+                t1,
+                parent=parent,
+                req=req.id,
+                pool=pool,
+                attrs=attrs or None,
+            )
+        )
+        return sid
+
+    def root(self, req, t0: float, t1: float, outcome: str,
+             pool: Optional[str] = None, **attrs) -> None:
+        """Emit the request's root span at its terminal event.  The root
+        id is derived from the trace id (``<trace>/root``), so children
+        emitted earlier — possibly on another pool — already point at
+        it."""
+        if not self.enabled or getattr(req, "trace_id", None) is None:
+            return
+        self._buf.append(
+            span_record(
+                "request",
+                req.trace_id,
+                f"{req.trace_id}/root",
+                t0,
+                t1,
+                parent=None,
+                req=req.id,
+                pool=pool,
+                attrs={"outcome": outcome, **attrs},
+            )
+        )
+
+    def flush(self) -> int:
+        """Write the buffered spans (one JSONL record each) — called
+        once per window, after the engine's single host sync."""
+        if not self._buf:
+            return 0
+        n = len(self._buf)
+        for rec in self._buf:
+            self.stream.append(rec)
+        self._buf.clear()
+        self.spans_emitted += n
+        return n
+
+    def close(self) -> None:
+        self.flush()
+        self.stream.close()
+
+
+def read_spans(path: str) -> List[Dict[str, Any]]:
+    """Parse an ``ffspan/1`` JSONL stream (rotation-aware, torn-tail
+    tolerant — same reader contract as :func:`read_metrics`)."""
+    return [r for r in read_metrics(path) if r.get("schema") == SPAN_SCHEMA]
+
+
+def spans_by_trace(records: List[Dict[str, Any]]) -> Dict[str, List[Dict]]:
+    """Group span records per trace id, each list in emission order —
+    the shape ``serve_report --timeline`` and the chain tests consume."""
+    out: Dict[str, List[Dict]] = {}
+    for r in records:
+        out.setdefault(r["trace_id"], []).append(r)
+    return out

@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from flexflow_tpu.obs import SpanRecorder
 from flexflow_tpu.serve.engine import ServeEngine, ServeReport, _pct
 from flexflow_tpu.serve.scheduler import Request, RequestState
 from flexflow_tpu.serve.transport import InProcessTransport, Transport
@@ -67,6 +68,11 @@ class DisaggReport(ServeReport):
     migrated_kv_bytes: int = 0  # dense payload bytes across the wire
     handoff_p50_ms: Optional[float] = None
     handoff_p99_ms: Optional[float] = None
+    # MEASURED send→deliver transit (PR 16) beside the priced values
+    # above — populated only on traced runs (--serve-spans-out), so an
+    # untraced cluster's report is unchanged
+    handoff_observed_p50_ms: Optional[float] = None
+    handoff_observed_p99_ms: Optional[float] = None
     transport_backpressure: int = 0  # bounded-queue send rejects
     prefill_windows: int = 0
     decode_windows: int = 0
@@ -108,8 +114,19 @@ class DisaggregatedCluster:
         prefix_sharing: bool = True,
         slo_ms: float = 50.0,
         attn: str = "auto",
+        spans_out: Optional[str] = None,
+        metrics_max_mb: float = 0.0,
     ) -> None:
         self.machine = machine
+        # ONE shared ffspan/1 recorder for both pools (obs/spans.py):
+        # same clock base, one span-id space, one stream — the decode
+        # pool's spans parent under the prefill pool's via the trace
+        # context the ffkv/1 frame carries.  None = tracing off; the
+        # router then adds no work and no fields anywhere (pinned).
+        self.spans = (
+            SpanRecorder(spans_out, max_mb=metrics_max_mb)
+            if spans_out else None
+        )
         self.prefill = ServeEngine(
             model,
             slots=prefill_slots,
@@ -123,6 +140,8 @@ class DisaggregatedCluster:
             slo_ms=slo_ms,
             attn=attn,
             phase="prefill",
+            span_recorder=self.spans,
+            metrics_max_mb=metrics_max_mb,
         )
         self.decode = ServeEngine(
             decode_model if decode_model is not None else model,
@@ -137,6 +156,8 @@ class DisaggregatedCluster:
             slo_ms=slo_ms,
             attn=attn,
             phase="decode",
+            span_recorder=self.spans,
+            metrics_max_mb=metrics_max_mb,
         )
         self.transport = (
             transport if transport is not None
@@ -151,6 +172,11 @@ class DisaggregatedCluster:
         self.migrated = 0
         self.migrated_kv_bytes = 0
         self.handoff_ms: List[float] = []
+        # traced runs only: send-time stamps (req id -> (t_send_rel,
+        # priced_delay_s)) and the measured send->deliver transits that
+        # land beside the priced estimates in the report
+        self._sent: Dict[int, Tuple[float, float]] = {}
+        self.handoff_observed_ms: List[float] = []
 
     def _now(self) -> float:
         return time.perf_counter()
@@ -169,6 +195,7 @@ class DisaggregatedCluster:
             # live KV positions: the full prompt (the first generated
             # token is the decode pool's first step input — no KV yet);
             # same arithmetic as drain()/preemption
+            t_e0 = self.spans.now() if self.spans is not None else 0.0
             live = req.prompt_len + max(0, req.done_tokens - 1)
             kv = self.prefill.kv.spill(slot, live)
             del sched.active[slot]
@@ -192,8 +219,22 @@ class DisaggregatedCluster:
                 "t_admitted": req.t_admitted,
                 "t_first_token": req.t_first_token,
             }
+            if self.spans is not None and req.trace_id is not None:
+                # pre-allocate the encode span's id so the wire frame
+                # can name it as the decode pool's parent — the span
+                # itself is emitted below once the encode time is known
+                enc_id = self.spans.next_id()
+                d["trace"] = {
+                    "trace_id": req.trace_id, "parent": enc_id,
+                }
             frame = encode_handoff(d)
             self.migrated_kv_bytes += kv_payload_nbytes(kv)
+            if self.spans is not None and req.trace_id is not None:
+                self.spans.span(
+                    "handoff_encode", req, t_e0, self.spans.now(),
+                    pool="prefill", span_id=enc_id,
+                    bytes=len(frame), kv_bytes=kv_payload_nbytes(kv),
+                )
             self._outbox.append((d, frame, now_rel))
 
     def _pump(self, now_rel: float) -> None:
@@ -209,6 +250,9 @@ class DisaggregatedCluster:
                 frame, now=now_rel, delay_s=delay,
             ):
                 still.append((d, frame, t_spill))  # backpressure: retry
+                continue
+            if self.spans is not None and d.get("trace") is not None:
+                self._sent[int(d["id"])] = (self.spans.now(), delay)
         self._outbox = still
         for frame in self.transport.recv_ready(now_rel):
             self._deliver(frame)
@@ -216,6 +260,7 @@ class DisaggregatedCluster:
     def _deliver(self, frame: bytes) -> None:
         from flexflow_tpu.search.cost import estimate_kv_handoff_time
 
+        t_d0 = self.spans.now() if self.spans is not None else 0.0
         delay_ms = estimate_kv_handoff_time(len(frame), self.machine) * 1e3
         entry: Dict[str, Any] = {
             "bytes": len(frame), "delay_ms": delay_ms,
@@ -248,15 +293,51 @@ class DisaggregatedCluster:
         req.t_first_token = d.get("t_first_token")
         req.kv_spill = d["kv_spill"]
         req.state = RequestState.PREEMPTED
+        # wire-propagated trace context: adopt the prefill pool's trace
+        # id BEFORE the fits check so a delivery-time reject still lands
+        # in the request's timeline; the transit span parents under the
+        # encode span the frame names, and measured transit sits beside
+        # the priced estimate in its attrs
+        tr = d.get("trace")
+        sent = self._sent.pop(int(d["id"]), None)
+        obs_ms: Optional[float] = None
+        if self.spans is not None and tr is not None:
+            req.trace_id = tr["trace_id"]
+            req.span_parent = tr.get("parent")
+            if sent is not None:
+                obs_ms = (t_d0 - sent[0]) * 1e3
+                self.handoff_observed_ms.append(obs_ms)
+                transit_id = self.spans.span(
+                    "handoff_transit", req, sent[0], t_d0,
+                    parent=tr.get("parent"), pool="decode",
+                    bytes=len(frame), priced_ms=delay_ms,
+                    observed_ms=obs_ms,
+                )
+                if transit_id:
+                    req.span_parent = transit_id
         # the decode pool's geometry differs from the prefill pool's —
         # re-check admissibility truthfully instead of assuming
         if not sched.kv.fits_with_sharing(req.max_len, req.prompt):
-            sched._reject(req, self._now())
+            sched._reject(
+                req,
+                self.spans.now() if self.spans is not None
+                else self._now(),
+            )
             return
         # bypass submit(): the request is mid-stream (PREEMPTED with a
         # payload), exactly the drain-resume convention
         sched._queues[req.tier].append(req)
         sched._next_id = max(sched._next_id, req.id) + 1
+        if self.spans is not None and req.trace_id is not None:
+            restore_id = self.spans.span(
+                "handoff_restore", req, t_d0, self.spans.now(),
+                pool="decode", bytes=len(frame),
+            )
+            if restore_id:
+                req.span_parent = restore_id
+            # decode-side queue wait starts at delivery, not at the
+            # original submit — the queue span measures this admission
+            req.t_enqueued = self.spans.now()
         entry["admitted"] = True
         self.migrated += 1
         self.handoff_ms.append(delay_ms)
@@ -264,6 +345,7 @@ class DisaggregatedCluster:
             delay_ms,
             self.decode.kv.blocks_for(req.kv_spill["length"]),
             len(frame),
+            observed_ms=obs_ms,
         )
 
     def handoff_audit(self) -> List[Dict[str, Any]]:
@@ -338,6 +420,10 @@ class DisaggregatedCluster:
         never crosses the wire)."""
         pending = sorted(requests or (), key=lambda r: (r.arrival_s, r.id))
         t0 = self._now()
+        if self.spans is not None:
+            # the cluster owns the shared recorder's clock base — both
+            # pools stamp spans on ONE run-relative timeline
+            self.spans.set_base(t0)
         for eng in (self.prefill, self.decode):
             eng._t0 = t0
             eng.windows = eng.decode_steps = eng.prefill_chunks = 0
@@ -356,6 +442,8 @@ class DisaggregatedCluster:
         self.migrated = 0
         self.migrated_kv_bytes = 0
         self.handoff_ms = []
+        self.handoff_observed_ms = []
+        self._sent = {}
         bp0 = getattr(self.transport, "send_rejects", 0)
         n_sub = 0
         while True:
@@ -416,6 +504,8 @@ class DisaggregatedCluster:
         )
         self.prefill.metrics.close()
         self.decode.metrics.close()
+        if self.spans is not None:
+            self.spans.close()
         return rep
 
     def _report(
@@ -478,6 +568,8 @@ class DisaggregatedCluster:
             migrated_kv_bytes=self.migrated_kv_bytes,
             handoff_p50_ms=_pct(self.handoff_ms, 50),
             handoff_p99_ms=_pct(self.handoff_ms, 99),
+            handoff_observed_p50_ms=_pct(self.handoff_observed_ms, 50),
+            handoff_observed_p99_ms=_pct(self.handoff_observed_ms, 99),
             prefill_windows=pw,
             decode_windows=dw,
             prefill_occupancy_mean=(

@@ -6,7 +6,10 @@ it (Unity-searched when ``--search-budget`` is set — with
 the continuous-batching :class:`~flexflow_tpu.serve.engine.ServeEngine`,
 replays a seeded synthetic open-loop workload against it, and prints
 ONE JSON summary line (plus the ``--metrics-out`` ffmetrics/1 stream
-that ``tools/serve_report.py`` renders).
+that ``tools/serve_report.py`` renders).  ``--serve-spans-out F`` adds
+the per-request ffspan/1 timeline stream (``tools/serve_report.py
+--timeline F`` decomposes TTFT from it); ``--metrics-max-mb M`` rotates
+both JSONL streams at M megabytes (docs/OBSERVABILITY.md).
 
 Defaults are CPU-smoke sized; pass model flags for anything real.
 
@@ -167,6 +170,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             slo_ms=cfg.serve_slo_ms,
             attn=cfg.serve_attn,
             machine=machine,
+            spans_out=cfg.serve_spans_out,
+            metrics_max_mb=cfg.metrics_max_mb,
         )
     else:
         engine = ServeEngine(
@@ -185,6 +190,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             shed_after_windows=cfg.serve_shed_windows,
             slo_ms=cfg.serve_slo_ms,
             drain_path=cfg.serve_drain_file,
+            spans_out=cfg.serve_spans_out,
+            metrics_max_mb=cfg.metrics_max_mb,
         )
         if opts["resume_drain"]:
             from flexflow_tpu.serve.engine import load_drain

@@ -40,7 +40,12 @@ import numpy as np
 
 from flexflow_tpu.dataloader import DevicePrefetcher
 from flexflow_tpu.models.gpt_decode import GPTSpec, layer_norm, make_cast
-from flexflow_tpu.obs import MetricsStream, get_tracer, step_record
+from flexflow_tpu.obs import (
+    MetricsStream,
+    SpanRecorder,
+    get_tracer,
+    step_record,
+)
 from flexflow_tpu.runtime.faults import get_fault_plan
 from flexflow_tpu.serve.kvcache import PagedKVCache
 from flexflow_tpu.serve.scheduler import (
@@ -193,6 +198,9 @@ class ServeEngine:
         slo_ms: float = 50.0,
         drain_path: Optional[str] = None,
         phase: Optional[str] = None,
+        spans_out: Optional[str] = None,
+        span_recorder: Optional[SpanRecorder] = None,
+        metrics_max_mb: float = 0.0,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -240,7 +248,24 @@ class ServeEngine:
             prefix_sharing=prefix_sharing,
         )
         self.sched = ContinuousBatchingScheduler(self.slots, self.kv)
-        self.metrics = MetricsStream(metrics_out)
+        self.metrics = MetricsStream(metrics_out, max_mb=metrics_max_mb)
+        # per-request distributed tracing (ffspan/1, obs/spans.py): a
+        # disagg cluster passes ONE shared recorder to both pool engines
+        # (shared clock base + unique span ids); a colocated engine owns
+        # its own when --serve-spans-out names a path.  None = off, and
+        # every emission site below is behind a None check — the serve
+        # streams and the host-sync ledger are untouched (pinned).
+        if span_recorder is not None:
+            self.spans: Optional[SpanRecorder] = span_recorder
+            self._owns_spans = False
+        elif spans_out:
+            self.spans = SpanRecorder(spans_out, max_mb=metrics_max_mb)
+            self._owns_spans = True
+        else:
+            self.spans = None
+            self._owns_spans = False
+        self.sched.spans = self.spans
+        self.sched.pool = phase
         # disaggregated-pool role (docs/SERVING.md): None = colocated
         # (the classic engine, records unchanged); "prefill"/"decode"
         # stamp every window record's serve vocabulary with the pool
@@ -248,6 +273,7 @@ class ServeEngine:
         # it and tools/serve_report.py renders a per-phase section
         self.phase = phase
         self._handoff_ms_w: List[float] = []
+        self._handoff_obs_w: List[float] = []
         self._migrated_blocks_w = 0
         self._migrated_bytes_w = 0
         self.prefetch_depth = max(1, int(prefetch_depth))
@@ -673,6 +699,9 @@ class ServeEngine:
         ex = self.model.executor
         pending = sorted(requests or (), key=lambda r: (r.arrival_s, r.id))
         t0 = self._t0 = self._now()
+        if self.spans is not None and self._owns_spans:
+            # a shared (cluster-owned) recorder is based by the cluster
+            self.spans.set_base(t0)
         syncs0 = ex.host_syncs
         # the engine is reusable across runs; counters and the report
         # are per-run (the compiled programs and the pool persist)
@@ -695,6 +724,7 @@ class ServeEngine:
             if r.arrival_abs_s is None:
                 r.arrival_abs_s = t0
                 r.t_submit = 0.0
+                r.t_enqueued = 0.0
         # SIGTERM = drain request (docs/RESILIENCE.md): the handler only
         # sets a flag; the loop drains at the next window BOUNDARY, so
         # the spill happens inside the normal sync discipline.  Restored
@@ -757,14 +787,20 @@ class ServeEngine:
         the SIGTERM handler calls; also callable directly)."""
         self._drain_requested = True
 
-    def note_handoff(self, ms: float, blocks: int, nbytes: int) -> None:
+    def note_handoff(self, ms: float, blocks: int, nbytes: int,
+                     observed_ms: Optional[float] = None) -> None:
         """Record one KV migration landing on this pool (the disagg
         router calls this at delivery).  Accumulates into the NEXT
         window record's ``handoff_ms``/``migrated_blocks``/
-        ``handoff_bytes`` serve vocabulary — additive ffmetrics/1."""
+        ``handoff_bytes`` serve vocabulary — additive ffmetrics/1.
+        ``observed_ms`` is the MEASURED send→deliver wall (PR 16) next
+        to the priced ``ms``, so predicted-vs-observed DCN error is
+        visible per window; None keeps pre-trace records byte-exact."""
         self._handoff_ms_w.append(float(ms))
         self._migrated_blocks_w += int(blocks)
         self._migrated_bytes_w += int(nbytes)
+        if observed_ms is not None:
+            self._handoff_obs_w.append(float(observed_ms))
 
     # --- drain / restore (docs/RESILIENCE.md) -------------------------------
     def drain(self) -> Dict[str, Any]:
@@ -873,6 +909,7 @@ class ServeEngine:
         jnp = self._jnp
         ex = self.model.executor
         tracer = get_tracer()
+        spans = self.spans
         t_win = self._now()
         B, MB = self.slots, self.kv.max_blocks_per_seq
         fin_before = len(self.sched.finished)
@@ -905,15 +942,24 @@ class ServeEngine:
         for req, toks_d, lo_d, n_d, row_d in DevicePrefetcher(
             chunks, place, depth=self.prefetch_depth
         ):
+            t_c0 = spans.now() if spans is not None else 0.0
             nxt, probs, ck, cv = self._prefill(
                 ex.params, self.kv.cache_k, self.kv.cache_v,
                 toks_d, lo_d, n_d, row_d,
             )
             self.kv.cache_k, self.kv.cache_v = ck, cv
             self.prefill_chunks += 1
+            lo_h = req.prefill_pos
             req.prefill_pos = min(
                 req.prefill_pos + self.prefill_chunk, req.prompt_len
             )
+            if spans is not None:
+                # host dispatch wall of this chunk (device completion is
+                # async by design — no fetch, no added sync); buffered
+                spans.span(
+                    "prefill", req, t_c0, spans.now(), pool=self.phase,
+                    slot=req.slot, lo=lo_h, n=req.prefill_pos - lo_h,
+                )
             # register the chunk's fully-written prompt blocks in the
             # prefix index NOW (not at prefill end): a request arriving
             # in the next admit round with the same system prompt
@@ -925,6 +971,16 @@ class ServeEngine:
 
         # 2) decode: chain device tokens for an adaptive window
         dec_slots = self.sched.decode_slots()
+        # span bookkeeping: request refs + token counts BEFORE the
+        # window, so per-request decode_window/spec spans can be emitted
+        # after the flush without touching the dispatch path
+        dec_reqs = (
+            [(s, self.sched.active[s]) for s in dec_slots]
+            if spans is not None else []
+        )
+        done_before = {s: r.done_tokens for s, r in dec_reqs}
+        spec_w: Dict[int, List[int]] = {}
+        t_dec0 = spans.now() if spans is not None else 0.0
         buffered: List[Any] = []  # per-step (B,) next-token device arrays
         spec_buf: List[Any] = []  # per-macro (n (B,W), acc (B,)) pairs
         probs_last = None
@@ -1037,6 +1093,10 @@ class ServeEngine:
                 a = int(acc_h[s])
                 spec_drafted_w += self.spec_k
                 spec_accepted_w += a
+                if spans is not None:
+                    e = spec_w.setdefault(s, [0, 0])
+                    e[0] += self.spec_k
+                    e[1] += a
                 for j in range(a + 1):
                     tok = int(n_h[s, j])
                     req.tokens.append(tok)
@@ -1059,7 +1119,27 @@ class ServeEngine:
             req.tokens.append(int(tok))
             flushed_tokens += 1
             req.t_first_token = self._now()
+            if spans is not None:
+                tt = spans.rel(req.t_first_token)
+                spans.span("first_token", req, tt, tt, pool=self.phase)
             self._finish_if_done(req, int(tok))
+
+        # per-request decode/spec spans for this window — emitted after
+        # the flush (post-sync), from counts the flush already computed
+        if spans is not None and dec_reqs:
+            t_dec1 = spans.now()
+            for s, r in dec_reqs:
+                spans.span(
+                    "decode_window", r, t_dec0, t_dec1, pool=self.phase,
+                    window=self.windows, steps=steps, slot=s,
+                    tokens=r.done_tokens - done_before[s],
+                )
+                sw = spec_w.get(s)
+                if sw is not None:
+                    spans.span(
+                        "spec", r, t_dec0, t_dec1, pool=self.phase,
+                        k=self.spec_k, drafted=sw[0], accepted=sw[1],
+                    )
 
         self.windows += 1
         self._occ_sum += self.sched.occupancy
@@ -1149,6 +1229,12 @@ class ServeEngine:
                 ]
                 serve_m["migrated_blocks"] = self._migrated_blocks_w
                 serve_m["handoff_bytes"] = self._migrated_bytes_w
+                # measured send→deliver transit beside the priced value
+                # (PR 16, ADDITIVE — absent unless the router measured)
+                if self._handoff_obs_w:
+                    serve_m["handoff_observed_ms"] = [
+                        round(x, 4) for x in self._handoff_obs_w
+                    ]
             if self.spec_k:
                 serve_m["spec"] = {
                     "k": self.spec_k,
@@ -1170,8 +1256,13 @@ class ServeEngine:
         # handoff accumulators are per-window whether or not a metrics
         # stream is attached
         self._handoff_ms_w = []
+        self._handoff_obs_w = []
         self._migrated_blocks_w = 0
         self._migrated_bytes_w = 0
+        # batched span flush — strictly after the window's one host
+        # sync, so tracing adds file writes but never a device wait
+        if spans is not None:
+            spans.flush()
 
     def _finish_if_done(self, req: Request, tok: int) -> None:
         if req.eos_id is not None and tok == req.eos_id:
@@ -1261,4 +1352,7 @@ class ServeEngine:
             watchdog_fires=self.watchdog_fires,
         )
         self.metrics.close()
+        if self.spans is not None and self._owns_spans:
+            # cluster-shared recorders are closed by the cluster
+            self.spans.close()
         return rep

@@ -99,6 +99,16 @@ class Request:
     t_admitted: Optional[float] = None
     t_first_token: Optional[float] = None  # TTFT clock stop
     t_done: Optional[float] = None
+    # --- distributed tracing (ffspan/1, obs/spans.py) ---
+    # trace_id is one id per request per run; span_parent is the span id
+    # this pool's child spans nest under (the root span, or the handoff
+    # restore span once the request crossed pools).  t_enqueued is the
+    # run-relative time of the LAST enqueue (submit, preemption requeue,
+    # handoff delivery) — each queue span measures one admission wait,
+    # not the request's whole life.  All None when tracing is off.
+    trace_id: Optional[str] = None
+    span_parent: Optional[str] = None
+    t_enqueued: Optional[float] = None
 
     def __post_init__(self) -> None:
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -156,6 +166,13 @@ class ContinuousBatchingScheduler:
         self.expired = 0  # deadline_ms expiries while queued
         self.shed = 0  # batch requests shed under SLO pressure
         self._next_id = 0
+        # optional ffspan/1 recorder + pool label, set by the owning
+        # engine (obs/spans.py).  Every emission below is behind a None
+        # check and only reads host-side clocks the scheduler already
+        # stamps — tracing off leaves this class's behavior byte-for-
+        # byte identical, tracing on adds zero host syncs.
+        self.spans = None
+        self.pool: Optional[str] = None
 
     @property
     def queue(self) -> List[Request]:
@@ -175,6 +192,9 @@ class ContinuousBatchingScheduler:
             req.id = self._next_id
         self._next_id = max(self._next_id, req.id) + 1
         req.t_submit = now
+        req.t_enqueued = now
+        if self.spans is not None:
+            self.spans.begin_trace(req)
         if not self.kv.fits_with_sharing(req.max_len, req.prompt):
             self._reject(req, now)
             return req
@@ -203,10 +223,25 @@ class ContinuousBatchingScheduler:
         req.finish_reason = reason
         req.t_done = now
         self.rejected.append(req)
+        if self.spans is not None:
+            self.spans.span("reject", req, now, now, pool=self.pool,
+                            reason=reason)
+            self.spans.root(req, req.t_submit if req.t_submit is not None
+                            else now, now, "rejected", pool=self.pool)
 
     # --- admission ---------------------------------------------------------
     def _place(self, req: Request, now: float) -> None:
         slot = self.free_slots.popleft()
+        resumed = req.kv_spill is not None
+        if self.spans is not None:
+            # drain- or handoff-delivered requests enter the queue
+            # without going through submit(); give them a trace late
+            self.spans.begin_trace(req)
+            t_q0 = (req.t_enqueued if req.t_enqueued is not None
+                    else (req.t_submit if req.t_submit is not None else now))
+            self.spans.span("queue", req, t_q0, now, pool=self.pool,
+                            tier=req.tier, tenant=req.tenant,
+                            resumed=resumed)
         if req.kv_spill is not None:
             # resuming a preempted request: restore the spilled K/V
             # bit-exactly and rejoin the decode pool directly (its
@@ -216,6 +251,10 @@ class ContinuousBatchingScheduler:
             req.kv_spill = None
             req.state = RequestState.DECODE
             req.prefill_pos = req.prompt_len
+            if self.spans is not None:
+                self.spans.span("restore", req, now, self.spans.now(),
+                                pool=self.pool,
+                                preemptions=req.preemptions)
         else:
             self.kv.reserve(slot, req.max_len, prompt=req.prompt)
             req.state = RequestState.PREFILL
@@ -285,6 +324,13 @@ class ContinuousBatchingScheduler:
         victim.state = RequestState.PREEMPTED
         victim.preemptions += 1
         self.preemptions += 1
+        victim.t_enqueued = now
+        if self.spans is not None:
+            self.spans.span(
+                "spill", victim, now, self.spans.now(), pool=self.pool,
+                spilled_kv=victim.kv_spill is not None,
+                preemptions=victim.preemptions,
+            )
         self._queues["batch"].appendleft(victim)  # resume first
         return True
 
@@ -312,6 +358,17 @@ class ContinuousBatchingScheduler:
                     self.rejected.append(req)
                     self.expired += 1
                     n += 1
+                    if self.spans is not None:
+                        self.spans.span(
+                            "expire", req, now, now, pool=self.pool,
+                            waited_ms=waited_ms,
+                            deadline_ms=req.deadline_ms,
+                        )
+                        self.spans.root(
+                            req,
+                            req.t_submit if req.t_submit is not None
+                            else now, now, "expired", pool=self.pool,
+                        )
                 else:
                     keep.append(req)
             q.extend(keep)
@@ -330,6 +387,13 @@ class ContinuousBatchingScheduler:
             req.finish_reason = f"rejected: shed ({reason})"
             req.t_done = now
             self.rejected.append(req)
+            if self.spans is not None:
+                self.spans.span("reject", req, now, now, pool=self.pool,
+                                reason=req.finish_reason)
+                self.spans.root(
+                    req, req.t_submit if req.t_submit is not None
+                    else now, now, "shed", pool=self.pool,
+                )
         self.shed += n
         return n
 
@@ -361,6 +425,18 @@ class ContinuousBatchingScheduler:
         req.t_done = now
         req.slot = -1
         self.finished.append(req)
+        if self.spans is not None:
+            # `now` here is the engine's absolute perf_counter clock
+            # (latency_ms pairs it with t_first_token) — span times are
+            # run-relative, so take the recorder's own clock instead
+            t = self.spans.now()
+            self.spans.span("finish", req, t, t, pool=self.pool,
+                            reason=reason, tokens=req.done_tokens)
+            self.spans.root(
+                req, req.t_submit if req.t_submit is not None else t, t,
+                "finished", pool=self.pool, reason=reason,
+                tokens=req.done_tokens, preemptions=req.preemptions,
+            )
 
     # --- introspection -----------------------------------------------------
     @property

@@ -102,6 +102,16 @@ def flatten_requests(
         for key in _TIMING_KEYS:
             if r.get(key) is not None:
                 meta[key] = float(r[key])
+        # optional trace context (PR 16, ffspan/1): an extra named array
+        # — JSON bytes — so the digest COVERS it (a tampered trace fails
+        # verify like tampered KV).  Absent when tracing is off, which
+        # keeps untraced frames byte-identical to pre-trace builds; old
+        # readers ignore the unknown array, old frames simply lack it.
+        tr = r.get("trace")
+        if tr is not None:
+            flat[f"r{i}/trace"] = np.frombuffer(
+                json.dumps(tr).encode(), dtype=np.uint8
+            )
         metas.append(meta)
     return flat, metas
 
@@ -134,6 +144,9 @@ def unflatten_requests(
         d["prompt"] = flat[f"r{i}/prompt"]
         d["tokens"] = [int(t) for t in flat[f"r{i}/tokens"]]
         d["kv_spill"] = kv
+        raw_tr = flat.get(f"r{i}/trace")
+        if raw_tr is not None:
+            d["trace"] = json.loads(np.asarray(raw_tr).tobytes().decode())
         requests.append(d)
     return requests
 

@@ -1,0 +1,203 @@
+"""Fleet metrics aggregation tests (ISSUE 16, docs/OBSERVABILITY.md
+"Fleet aggregation").
+
+Pins the :class:`QuantileSketch` relative-error guarantee against
+brute-force percentiles, exact bucket-wise mergeability, the
+:class:`MetricsAggregator` rollup over two concurrent pool streams
+(matching brute force within sketch tolerance), and the ``ffagg/1``
+snapshot round-trip — the interface ROADMAP #2's autoscaler consumes.
+
+Pure stdlib + numpy (for the brute-force reference) — no jax, no
+engines: the aggregator runs on fleet-controller hosts.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import random
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)))
+)
+
+from flexflow_tpu.obs.aggregate import (  # noqa: E402
+    AGG_SCHEMA,
+    MetricsAggregator,
+    QuantileSketch,
+    aggregate_streams,
+)
+from flexflow_tpu.obs.metrics import MetricsStream, step_record  # noqa: E402
+
+
+# ------------------------------------------------------------- sketch
+@pytest.mark.parametrize("dist", ["uniform", "lognormal", "bimodal"])
+@pytest.mark.parametrize("q", [50.0, 90.0, 99.0])
+def test_sketch_within_relative_error_of_brute_force(dist, q):
+    rng = random.Random(hash((dist, q)) & 0xFFFF)
+    if dist == "uniform":
+        vals = [rng.uniform(0.1, 500.0) for _ in range(4000)]
+    elif dist == "lognormal":
+        vals = [math.exp(rng.gauss(2.0, 1.5)) for _ in range(4000)]
+    else:
+        vals = [rng.gauss(5.0, 0.5) for _ in range(2000)] + [
+            rng.gauss(200.0, 20.0) for _ in range(2000)
+        ]
+        vals = [abs(v) for v in vals]
+    alpha = 0.01
+    sk = QuantileSketch(alpha=alpha)
+    for v in vals:
+        sk.add(v)
+    got = sk.quantile(q)
+    want = float(np.percentile(np.asarray(vals), q, method="lower"))
+    # DDSketch guarantee: within alpha relative error of a sample at
+    # that rank; nearest-rank vs interpolation slack adds a hair
+    assert got == pytest.approx(want, rel=2.5 * alpha)
+
+
+def test_sketch_merge_equals_concatenation():
+    rng = random.Random(7)
+    a_vals = [rng.uniform(0.5, 80.0) for _ in range(500)]
+    b_vals = [rng.uniform(40.0, 900.0) for _ in range(700)]
+    a, b, both = (QuantileSketch(0.02) for _ in range(3))
+    for v in a_vals:
+        a.add(v)
+        both.add(v)
+    for v in b_vals:
+        b.add(v)
+        both.add(v)
+    a.merge(b)
+    assert a.count == both.count == 1200
+    assert a.buckets == both.buckets  # bucket-wise EXACT, not approximate
+    for q in (10.0, 50.0, 99.0):
+        assert a.quantile(q) == both.quantile(q)
+
+
+def test_sketch_edge_cases():
+    sk = QuantileSketch(0.01)
+    assert math.isnan(sk.quantile(50))
+    sk.add(0.0)
+    sk.add(-1.0)  # degenerate but legal latencies land in the zeros rank
+    sk.add(float("nan"))  # no rank information: dropped
+    sk.add(5.0)
+    assert sk.count == 3 and sk.zeros == 2
+    assert sk.quantile(0) == 0.0
+    assert sk.quantile(100) == pytest.approx(5.0, rel=0.03)
+    with pytest.raises(ValueError, match="alpha"):
+        sk.merge(QuantileSketch(0.05))
+    with pytest.raises(ValueError, match="alpha"):
+        QuantileSketch(1.5)
+
+
+# --------------------------------------------------------- aggregator
+def _pool_stream(path, n, seed, phase, base_ttft):
+    """Write a synthetic serve-vocabulary ffmetrics/1 stream; returns
+    the finished-request latencies for brute-force comparison."""
+    rng = random.Random(seed)
+    s = MetricsStream(path)
+    ttfts, tpots = [], []
+    for i in range(n):
+        fin = []
+        for _ in range(rng.randrange(0, 3)):
+            ttft = base_ttft * math.exp(rng.gauss(0.0, 0.6))
+            tpot = 1.0 + rng.random()
+            ttfts.append(ttft)
+            tpots.append(tpot)
+            fin.append({"ttft_ms": ttft, "tpot_ms": tpot})
+        s.append(step_record(
+            i, float(i), step_wall_s=0.02, tokens=40,
+            metrics={"serve": {
+                "phase": phase, "queue_depth": rng.randrange(0, 5),
+                "occupancy": rng.random(), "prefix_hit_rate": 0.25,
+                "finished": fin,
+            }},
+        ))
+    s.close()
+    return ttfts, tpots
+
+
+def test_aggregator_two_pool_rollup_matches_brute_force(tmp_path):
+    p0, p1 = str(tmp_path / "p0.jsonl"), str(tmp_path / "p1.jsonl")
+    t0, d0 = _pool_stream(p0, 40, seed=1, phase="prefill", base_ttft=8.0)
+    t1, d1 = _pool_stream(p1, 40, seed=2, phase="decode", base_ttft=30.0)
+    agg = MetricsAggregator(window=16, alpha=0.01)
+    assert agg.ingest_stream("prefill", p0) == 40
+    assert agg.ingest_stream("decode", p1) == 40
+    rep = agg.aggregate_report()
+
+    assert set(rep["sources"]) == {"prefill", "decode"}
+    src = rep["sources"]["prefill"]
+    assert src["phase"] == "prefill" and src["windows"] == 40
+    assert src["finished"] == len(t0)
+    assert src["prefix_hit_rate"] == 0.25
+    assert src["tok_s_w"] == pytest.approx(40 / 0.02)
+
+    fleet = rep["fleet"]
+    assert fleet["sources"] == 2
+    assert fleet["requests_finished"] == len(t0) + len(t1)
+    # fleet queue depth is the SUM of the pools' last-seen depths
+    assert fleet["queue_depth"] == (
+        src["queue_depth"] + rep["sources"]["decode"]["queue_depth"]
+    )
+    all_ttft = np.asarray(t0 + t1)
+    all_tpot = np.asarray(d0 + d1)
+    for key, vals in (("ttft", all_ttft), ("tpot", all_tpot)):
+        for q in (50.0, 99.0):
+            got = fleet[f"{key}_p{int(q)}_ms"]
+            want = float(np.percentile(vals, q, method="lower"))
+            assert got == pytest.approx(want, rel=0.03), (key, q)
+
+    # the convenience wrapper is the same rollup
+    rep2 = aggregate_streams({"prefill": p0, "decode": p1},
+                             window=16, alpha=0.01)
+    assert rep2["fleet"]["requests_finished"] == fleet["requests_finished"]
+
+
+def test_aggregator_rolling_window_bounds_state(tmp_path):
+    agg = MetricsAggregator(window=4)
+    for i in range(50):
+        agg.ingest("x", {"metrics": {"serve": {
+            "queue_depth": i, "occupancy": 1.0, "finished": [],
+        }}, "step_wall_s": 0.01, "tokens_per_s": 0.0})
+    rep = agg.aggregate_report()
+    src = rep["sources"]["x"]
+    assert src["windows"] == 50
+    # mean over the rolling window only: last 4 depths are 46..49
+    assert src["queue_depth_mean_w"] == pytest.approx((46 + 47 + 48 + 49) / 4)
+    assert src["queue_depth"] == 49
+
+
+def test_aggregator_ignores_training_records(tmp_path):
+    agg = MetricsAggregator()
+    agg.ingest("train", step_record(0, 0.0, loss=1.0))
+    rep = agg.aggregate_report()
+    assert rep["sources"]["train"]["windows"] == 1
+    assert rep["sources"]["train"]["queue_depth"] is None
+    assert rep["fleet"]["ttft_p99_ms"] is None
+
+
+def test_ffagg_snapshot_roundtrip_and_merge_across_restart(tmp_path):
+    p0 = str(tmp_path / "p0.jsonl")
+    ttfts, _ = _pool_stream(p0, 30, seed=5, phase=None, base_ttft=12.0)
+    agg = MetricsAggregator(window=8, alpha=0.02)
+    agg.ingest_stream("pool", p0)
+    snap = json.loads(json.dumps(agg.snapshot(t=42.0)))
+    assert snap["schema"] == AGG_SCHEMA and snap["t"] == 42.0
+
+    back = MetricsAggregator.from_snapshot(snap)
+    assert back.alpha == 0.02 and back.window == 8
+    assert back.requests_finished == len(ttfts)
+    assert back.sketches["ttft_ms"].quantile(99) == (
+        agg.sketches["ttft_ms"].quantile(99)
+    )
+    # restored state keeps accumulating — the autoscaler restart path
+    back.ingest("pool", {"metrics": {"serve": {
+        "queue_depth": 1, "occupancy": 0.5,
+        "finished": [{"ttft_ms": 9.0, "tpot_ms": 1.0}],
+    }}})
+    assert back.requests_finished == len(ttfts) + 1

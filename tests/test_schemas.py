@@ -1,0 +1,282 @@
+"""Schema-registry tests (ISSUE 16, docs/OBSERVABILITY.md "Versioned
+file schemas").
+
+Every ``ff<name>/<version>`` tag the repo emits must be registered in
+:mod:`flexflow_tpu.obs.schemas` (tools/lint_schemas.py gates tier-0 on
+that), and every REGISTERED tag must round-trip here: write with the
+owning module's writer, read with its reader, and get the same facts
+back.  The parametrized case table below is asserted complete against
+the registry — adding a schema without adding its round-trip case
+fails ``test_every_registered_schema_has_a_roundtrip_case``.
+
+Cross-cutting policies exercised per family where they apply:
+strict-JSON NaN/Inf encoding (JSONL streams), torn-tail tolerance
+(JSONL streams), digest refusal on tamper (npz payloads), and
+old-record interop (consumers ignore unknown keys; absent optional
+keys read as absent).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)))
+)
+
+from flexflow_tpu.obs.schemas import SCHEMA_RE, SCHEMAS, known  # noqa: E402
+
+
+# --------------------------------------------------------------- registry
+def test_registry_shape():
+    assert len(SCHEMAS) >= 9
+    for tag, (module, desc) in SCHEMAS.items():
+        assert SCHEMA_RE.fullmatch(tag), tag
+        assert module and desc
+    assert known("ffmetrics/1")
+    assert not known("ffbogus/7")
+
+
+def test_scan_text_flags_unregistered_tags():
+    from flexflow_tpu.obs.schemas import scan_text
+
+    hits = scan_text("writes ffmetrics/1 then ffbogus/3 frames", "x.py")
+    assert [h[2] for h in hits] == ["ffbogus/3"]
+
+
+# ------------------------------------------------------- round-trip cases
+def _rt_ffmetrics(tmp_path):
+    from flexflow_tpu.obs.metrics import (
+        MetricsStream,
+        read_metrics,
+        step_record,
+    )
+
+    path = str(tmp_path / "m.jsonl")
+    s = MetricsStream(path)
+    s.append(step_record(0, 1.0, loss=float("nan"), step_wall_s=0.5))
+    s.append(step_record(1, 2.0, loss=2.5, grad_norm=float("inf")))
+    s.close()
+    # strict JSON on disk: non-finite floats are string-encoded, so
+    # every line parses even with bare NaN/Infinity literals rejected
+    for line in open(path):
+        json.loads(line, parse_constant=lambda c: pytest.fail(
+            f"bare {c} literal on disk — not strict JSON"
+        ))
+    # torn tail: a crash mid-write leaves everything before it readable
+    with open(path, "a") as f:
+        f.write('{"schema": "ffmetrics/1", "step": 2, "t"')
+    recs = read_metrics(path)
+    assert [r["step"] for r in recs] == [0, 1]
+    assert np.isnan(recs[0]["loss"]) and np.isinf(recs[1]["grad_norm"])
+    # old-record interop: an unknown key is carried, not fatal
+    with open(path, "w") as f:
+        f.write(json.dumps({"schema": "ffmetrics/1", "step": 9,
+                            "future_key": 1}) + "\n")
+    assert read_metrics(path)[0]["step"] == 9
+
+
+def _rt_ffspan(tmp_path):
+    from flexflow_tpu.obs.spans import (
+        SPAN_KINDS,
+        SpanRecorder,
+        read_spans,
+        span_record,
+    )
+
+    path = str(tmp_path / "s.jsonl")
+    rec = SpanRecorder(path)
+
+    class R:
+        id = 7
+        trace_id = None
+        span_parent = None
+
+    r = R()
+    rec.begin_trace(r)
+    assert r.trace_id == "t7" and r.span_parent == "t7/root"
+    sid = rec.span("queue", r, 0.1, 0.2, pool="prefill", tier="batch")
+    rec.root(r, 0.0, 1.0, "finished", tokens=4)
+    rec.close()
+    out = read_spans(path)
+    assert len(out) == 2
+    q, root = out
+    assert q["span"] == sid and q["parent"] == "t7/root"
+    assert q["name"] in SPAN_KINDS and q["attrs"]["tier"] == "batch"
+    assert root["span"] == "t7/root" and root["parent"] is None
+    assert root["attrs"] == {"outcome": "finished", "tokens": 4}
+    # the shared record builder IS the schema
+    assert set(q) == set(span_record("queue", "t", "s", 0, 0))
+    # torn tail tolerated, same as every JSONL stream
+    with open(path, "a") as f:
+        f.write('{"schema": "ffspan/1", "trace')
+    assert len(read_spans(path)) == 2
+
+
+def _rt_ffagg(tmp_path):
+    from flexflow_tpu.obs.aggregate import AGG_SCHEMA, MetricsAggregator
+
+    agg = MetricsAggregator(window=8, alpha=0.02)
+    for i in range(20):
+        agg.ingest("pool0", {
+            "schema": "ffmetrics/1", "step": i, "step_wall_s": 0.01,
+            "tokens_per_s": 100.0,
+            "metrics": {"serve": {
+                "queue_depth": i % 3, "occupancy": 0.5,
+                "finished": [{"ttft_ms": 10.0 + i, "tpot_ms": 1.0}],
+            }},
+        })
+    snap = agg.snapshot(t=123.0)
+    assert snap["schema"] == AGG_SCHEMA
+    snap2 = json.loads(json.dumps(snap))  # strict-JSON round trip
+    back = MetricsAggregator.from_snapshot(snap2)
+    assert back.requests_finished == agg.requests_finished == 20
+    for k in ("ttft_ms", "tpot_ms"):
+        assert back.sketches[k].count == agg.sketches[k].count
+        assert back.sketches[k].quantile(99) == pytest.approx(
+            agg.sketches[k].quantile(99)
+        )
+    with pytest.raises(ValueError, match="schema"):
+        MetricsAggregator.from_snapshot({"schema": "ffagg/0"})
+
+
+def _rt_ffcal(tmp_path):
+    from flexflow_tpu.search.calibration import (
+        CALIBRATION_SCHEMA,
+        CalibrationStore,
+    )
+
+    store = CalibrationStore("idA", backend="cpu", compute_dtype="float32")
+    store.add_step_sample("s0", 1.0, 2.0)
+    path = str(tmp_path / "cal.json")
+    store.save(path)
+    doc = json.load(open(path))
+    assert doc["schema"] == CALIBRATION_SCHEMA
+    back = CalibrationStore.load(path, expect_identity="idA")
+    assert back.step_samples == store.step_samples
+
+
+def _rt_ffckpt2(tmp_path):
+    from flexflow_tpu.model import (
+        CHECKPOINT_SCHEMA,
+        _checkpoint_digest,
+        _write_checkpoint_atomic,
+    )
+
+    flat = {"layer0/w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    path = _write_checkpoint_atomic(
+        str(tmp_path / "c"), flat, {"schema": CHECKPOINT_SCHEMA},
+    )
+    with np.load(path) as z:
+        got = {k: np.asarray(z[k]) for k in z.files}
+    manifest = json.loads(got.pop("meta/manifest").tobytes().decode())
+    assert manifest["schema"] == CHECKPOINT_SCHEMA
+    assert manifest["digest"] == _checkpoint_digest(got)
+    np.testing.assert_array_equal(got["layer0/w"], flat["layer0/w"])
+
+
+def _rt_ffckpt1_legacy(tmp_path):
+    # ffckpt/1 is manifest-less and READ-only: a plain npz of weight
+    # arrays.  The interop pinned is that the flattening still reads —
+    # no manifest, no digest, loader returns manifest=None (the full
+    # engine-level legacy load lives in tests/test_checkpoint.py).
+    path = str(tmp_path / "legacy.npz")
+    np.savez(path, **{"layer0/w": np.ones((2, 2), np.float32)})
+    with np.load(path) as z:
+        flat = {k: np.asarray(z[k]) for k in z.files}
+    assert "meta/manifest" not in flat
+    np.testing.assert_array_equal(flat["layer0/w"], np.ones((2, 2)))
+
+
+def _rt_ffkv(tmp_path):
+    from flexflow_tpu.serve.wire import (
+        KV_SCHEMA,
+        HandoffError,
+        decode_handoff,
+        encode_handoff,
+    )
+
+    req = {
+        "id": 3, "prompt": np.arange(4, dtype=np.int32),
+        "max_new_tokens": 5, "tokens": [9, 8],
+        "kv_spill": {"length": 4, "layers": {"layer0": {
+            "k": np.ones((2, 4, 3), np.float32),
+            "v": np.zeros((2, 4, 3), np.float32),
+        }}},
+    }
+    frame = encode_handoff(req)
+    back = decode_handoff(frame)
+    assert back["id"] == 3 and back["tokens"] == [9, 8]
+    assert int(back["kv_spill"]["length"]) == 4
+    # tamper → digest refusal
+    with pytest.raises(HandoffError):
+        decode_handoff(frame[:-7] + b"garbage")
+    assert KV_SCHEMA == "ffkv/1"
+
+
+def _rt_ffdrain(tmp_path):
+    from flexflow_tpu.serve.engine import DRAIN_SCHEMA, load_drain, save_drain
+
+    payload = {"requests": [{
+        "id": 1, "prompt": np.arange(3, dtype=np.int32),
+        "max_new_tokens": 4, "tokens": [5], "kv_spill": None,
+    }]}
+    path = save_drain(str(tmp_path / "d"), payload)
+    back = load_drain(path)
+    assert back["schema"] == DRAIN_SCHEMA
+    [r] = back["requests"]
+    assert r["id"] == 1 and r["tokens"] == [5] and r["kv_spill"] is None
+
+
+def _rt_ffcheck(tmp_path):
+    from flexflow_tpu.analysis.core import AnalysisReport, Violation
+
+    rep = AnalysisReport()
+    rep.extend([Violation(check="demo", severity="error",
+                          program="fit", message="x")])
+    doc = json.loads(rep.to_json())
+    assert doc["schema"] == "ffcheck/1"
+    assert len(doc["violations"]) == 1
+
+
+_ROUNDTRIPS = {
+    "ffmetrics/1": _rt_ffmetrics,
+    "ffspan/1": _rt_ffspan,
+    "ffagg/1": _rt_ffagg,
+    "ffcal/1": _rt_ffcal,
+    "ffckpt/2": _rt_ffckpt2,
+    "ffckpt/1": _rt_ffckpt1_legacy,
+    "ffkv/1": _rt_ffkv,
+    "ffdrain/1": _rt_ffdrain,
+    "ffcheck/1": _rt_ffcheck,
+}
+
+
+def test_every_registered_schema_has_a_roundtrip_case():
+    assert set(_ROUNDTRIPS) == set(SCHEMAS), (
+        "registry and round-trip case table diverged — add a case (or "
+        "registry entry) for: "
+        f"{set(_ROUNDTRIPS) ^ set(SCHEMAS)}"
+    )
+
+
+@pytest.mark.parametrize("tag", sorted(_ROUNDTRIPS))
+def test_schema_roundtrip(tag, tmp_path):
+    _ROUNDTRIPS[tag](tmp_path)
+
+
+def test_lint_schemas_gate_runs_clean():
+    """tier-0's schema lint must pass on the tree as committed."""
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "lint_schemas.py")],
+        capture_output=True, text=True, cwd=root,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr

@@ -1,0 +1,429 @@
+"""Per-request distributed tracing tests (ISSUE 16,
+docs/OBSERVABILITY.md "Request timelines").
+
+Pins the ffspan/1 contract end to end:
+
+  * tracing OFF is free — token streams and the host-sync ledger are
+    identical to a traced run, untraced metrics records carry no
+    trace-era keys, and untraced ffkv/1 frames are byte-identical;
+  * tracing ON adds ZERO host syncs and changes no tokens;
+  * every finished request yields a COMPLETE span chain (queue →
+    prefill → first_token → decode windows → finish → request root)
+    with monotone timestamps, and on a disaggregated cluster the chain
+    crosses the wire: the decode pool's spans parent under the prefill
+    pool's handoff_encode span via the digest-covered trace context in
+    the ffkv/1 frame, with the MEASURED transit beside the priced
+    estimate;
+  * stream rotation (--metrics-max-mb) keeps every record readable in
+    order; and the serve_report --timeline / trace_report --merge
+    surfaces render from the streams.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)))
+)
+
+from flexflow_tpu import FFConfig, FFModel  # noqa: E402
+from flexflow_tpu.models.transformer import gpt_decoder  # noqa: E402
+from flexflow_tpu.obs.metrics import (  # noqa: E402
+    MetricsStream,
+    metrics_file_set,
+    read_metrics,
+)
+from flexflow_tpu.obs.spans import (  # noqa: E402
+    SPAN_KINDS,
+    SpanRecorder,
+    read_spans,
+    spans_by_trace,
+)
+from flexflow_tpu.serve import (  # noqa: E402
+    DisaggregatedCluster,
+    ServeEngine,
+    TrafficSpec,
+    synthetic_requests,
+)
+
+SLOTS, SEQ, VOCAB = 4, 48, 31
+SHAPE = dict(hidden=32, heads=4, ff_dim=64, num_layers=1, vocab=VOCAB)
+SPEC = TrafficSpec(
+    n_requests=5, seed=11, prompt_len=(4, 10), max_new=(3, 8), vocab=VOCAB,
+)
+
+
+def _machine_2slice():
+    from flexflow_tpu.search.cost import TPUMachineModel
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "machine_configs", "v5p_2slice.json",
+    )
+    return TPUMachineModel.from_file(path)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = FFConfig(batch_size=SLOTS)
+    m = FFModel(cfg)
+    gpt_decoder(m, SLOTS, SEQ, use_flash=False, **SHAPE)
+    m.compile(seed=0)
+    return m
+
+
+def _tokens(engines):
+    out = {}
+    for eng in engines:
+        for r in eng.sched.finished:
+            out[r.id] = list(r.tokens)
+    return out
+
+
+# ------------------------------------------------------------ rotation
+def test_metrics_stream_rotation_reads_back_in_order(tmp_path):
+    path = str(tmp_path / "r.jsonl")
+    s = MetricsStream(path, max_mb=0.0005)  # 500 bytes per file
+    for i in range(40):
+        s.append({"schema": "ffmetrics/1", "step": i, "pad": "x" * 60})
+    s.close()
+    assert s.rotations >= 2
+    files = metrics_file_set(path)
+    # oldest first: path.N … path.1, then the live path if the last
+    # append didn't itself trigger the rotation
+    assert files[0] == f"{path}.{s.rotations}"
+    if os.path.exists(path):
+        assert files[-1] == path
+    recs = read_metrics(path)
+    assert [r["step"] for r in recs] == list(range(40))
+    # rotation lands on record boundaries — every file parses whole
+    for p in files:
+        for line in open(p):
+            json.loads(line)
+
+
+def test_span_recorder_rotation(tmp_path):
+    path = str(tmp_path / "sp.jsonl")
+    rec = SpanRecorder(path, max_mb=0.0005)
+
+    class R:
+        id = 1
+        trace_id = None
+        span_parent = None
+
+    r = R()
+    rec.begin_trace(r)
+    for i in range(30):
+        rec.span("decode_window", r, float(i), float(i) + 0.5, window=i)
+        rec.flush()
+    rec.close()
+    assert rec.stream.rotations >= 1
+    out = read_spans(path)
+    assert [s["attrs"]["window"] for s in out] == list(range(30))
+
+
+# ----------------------------------------------------- wire propagation
+def test_wire_trace_roundtrip_interop_and_digest_coverage():
+    from flexflow_tpu.serve.wire import (
+        HandoffError,
+        decode_handoff,
+        encode_handoff,
+        flatten_requests,
+    )
+
+    base = {
+        "id": 5, "prompt": np.arange(4, dtype=np.int32),
+        "max_new_tokens": 4, "tokens": [2],
+        "kv_spill": {"length": 4, "layers": {"layer0": {
+            "k": np.ones((2, 4, 3), np.float32),
+            "v": np.zeros((2, 4, 3), np.float32),
+        }}},
+    }
+    # untraced frames carry no trace array and are byte-identical to a
+    # pre-trace build's (deterministic npz of the same arrays)
+    flat, _ = flatten_requests([dict(base)])
+    assert "r0/trace" not in flat
+    assert encode_handoff(dict(base)) == encode_handoff(dict(base))
+
+    traced = dict(base)
+    traced["trace"] = {"trace_id": "t5", "parent": "s9"}
+    frame = encode_handoff(traced)
+    back = decode_handoff(frame)
+    assert back["trace"] == {"trace_id": "t5", "parent": "s9"}
+    # old-frame interop: a frame without the array decodes trace-less
+    old = decode_handoff(encode_handoff(dict(base)))
+    assert "trace" not in old
+
+    # the digest COVERS the trace context: flipping one byte of the
+    # trace array fails verification like tampered KV would
+    import io
+    import zipfile
+
+    with np.load(io.BytesIO(frame)) as z:
+        payload = {k: np.asarray(z[k]) for k in z.files}
+    tr = payload["r0/trace"].copy()
+    tr[0] ^= 0xFF
+    payload["r0/trace"] = tr
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    with pytest.raises(HandoffError, match="digest"):
+        decode_handoff(buf.getvalue())
+    del zipfile
+
+
+# ------------------------------------------------- colocated off/on pin
+@pytest.fixture(scope="module")
+def colocated_ab(model, tmp_path_factory):
+    """The SAME workload through the SAME engine config, untraced then
+    traced — the zero-cost pin."""
+    d = tmp_path_factory.mktemp("spans_colo")
+
+    def run(spans_out):
+        eng = ServeEngine(
+            model, slots=SLOTS, block_size=8, sync_every=4,
+            metrics_out=str(d / f"m_{bool(spans_out)}.jsonl"),
+            spans_out=spans_out,
+        )
+        rep = eng.run(synthetic_requests(SPEC))
+        return eng, rep
+
+    eng_off, rep_off = run(None)
+    spans_path = str(d / "spans.jsonl")
+    eng_on, rep_on = run(spans_path)
+    return dict(
+        d=d, eng_off=eng_off, rep_off=rep_off, eng_on=eng_on,
+        rep_on=rep_on, spans=spans_path,
+    )
+
+
+def test_tracing_off_equals_on_tokens_and_host_syncs(colocated_ab):
+    ab = colocated_ab
+    assert _tokens([ab["eng_off"]]) == _tokens([ab["eng_on"]])
+    # the ledger pin: tracing adds ZERO host syncs
+    assert ab["rep_off"].host_syncs == ab["rep_on"].host_syncs
+    assert ab["rep_off"].windows == ab["rep_on"].windows
+    # untraced serve records carry no trace-era keys
+    for r in read_metrics(str(ab["d"] / "m_False.jsonl")):
+        s = (r.get("metrics") or {}).get("serve") or {}
+        assert "handoff_observed_ms" not in s
+
+
+def test_colocated_span_chain_complete_and_monotone(colocated_ab):
+    ab = colocated_ab
+    spans = read_spans(ab["spans"])
+    assert spans and all(s["schema"] == "ffspan/1" for s in spans)
+    assert all(s["name"] in SPAN_KINDS for s in spans)
+    chains = spans_by_trace(spans)
+    finished = {r.id for r in ab["eng_on"].sched.finished}
+    assert {int(t[1:]) for t in chains} == finished
+    for tid, chain in chains.items():
+        names = [s["name"] for s in chain]
+        for required in ("queue", "prefill", "first_token",
+                        "decode_window", "finish", "request"):
+            assert required in names, (tid, names)
+        root = next(s for s in chain if s["name"] == "request")
+        assert root["span"] == f"{tid}/root"
+        assert root["attrs"]["outcome"] == "finished"
+        # every non-root span nests (directly or transitively) under
+        # the root, and ids are unique within the stream
+        ids = {s["span"] for s in chain}
+        assert len(ids) == len(chain)
+        for s in chain:
+            if s["name"] != "request":
+                assert s["parent"] in ids, s
+        # timestamps: well-formed; all but decode_window stay inside
+        # the root's envelope (window spans close at the SHARED window
+        # boundary, a hair after the per-request finish stamp)
+        for s in chain:
+            assert s["t1"] >= s["t0"] >= 0.0
+            if s["name"] != "decode_window":
+                assert s["t1"] <= root["t1"] + 1e-6
+        # lifecycle order along the chain
+        t_queue = next(s for s in chain if s["name"] == "queue")["t1"]
+        t_pre = next(s for s in chain if s["name"] == "prefill")["t0"]
+        t_first = next(s for s in chain if s["name"] == "first_token")["t1"]
+        t_fin = next(s for s in chain if s["name"] == "finish")["t1"]
+        assert t_queue <= t_pre + 1e-9 <= t_first + 1e-9 <= t_fin + 1e-9
+
+
+# ------------------------------------------------------ disagg chains
+@pytest.fixture(scope="module")
+def disagg_traced(model, tmp_path_factory):
+    d = tmp_path_factory.mktemp("spans_disagg")
+    spans_path = str(d / "spans.jsonl")
+    cluster = DisaggregatedCluster(
+        model, prefill_slots=SLOTS, decode_slots=SLOTS,
+        prefill_block_size=8, decode_block_size=16, sync_every=4,
+        machine=_machine_2slice(),
+        metrics_out=str(d / "m.jsonl"),
+        spans_out=spans_path,
+    )
+    rep = cluster.run(synthetic_requests(SPEC))
+    return dict(cluster=cluster, rep=rep, spans=spans_path, d=d)
+
+
+def test_disagg_traced_tokens_match_untraced_colocated(
+    colocated_ab, disagg_traced,
+):
+    """Bit-identity holds ACROSS tracing and across the split: the
+    traced cluster's streams equal the untraced colocated engine's."""
+    c = disagg_traced["cluster"]
+    assert _tokens([c.prefill, c.decode]) == _tokens(
+        [colocated_ab["eng_off"]]
+    )
+
+
+def test_disagg_span_chain_crosses_wire(disagg_traced):
+    c = disagg_traced["cluster"]
+    spans = read_spans(disagg_traced["spans"])
+    chains = spans_by_trace(spans)
+    migrated = {r.id for r in c.decode.sched.finished}
+    assert c.migrated == len(migrated) > 0
+    for rid in migrated:
+        chain = chains[f"t{rid}"]
+        by = {}
+        for s in chain:
+            by.setdefault(s["name"], []).append(s)
+        # the full disagg lifecycle: both pools' admissions, the three
+        # handoff legs, the decode-side KV restore, and the terminals
+        for required in ("queue", "prefill", "first_token",
+                        "handoff_encode", "handoff_transit",
+                        "handoff_restore", "restore", "decode_window",
+                        "finish", "request"):
+            assert required in by, (rid, sorted(by))
+        assert len(by["queue"]) == 2  # prefill admission + decode requeue
+        enc, = by["handoff_encode"]
+        transit, = by["handoff_transit"]
+        restore_h, = by["handoff_restore"]
+        # pool attribution and cross-pool parenting: the decode pool
+        # learned the encode span's id from the wire frame alone
+        assert enc["pool"] == "prefill"
+        assert transit["pool"] == restore_h["pool"] == "decode"
+        assert transit["parent"] == enc["span"]
+        assert restore_h["parent"] == transit["span"]
+        # measured transit beside the priced estimate, in one record
+        assert transit["attrs"]["observed_ms"] > 0.0
+        assert transit["attrs"]["priced_ms"] > 0.0
+        assert transit["attrs"]["observed_ms"] == pytest.approx(
+            (transit["t1"] - transit["t0"]) * 1e3
+        )
+        # the chain is monotone across the pool boundary (shared base)
+        assert (enc["t0"] <= transit["t0"] + 1e-9
+                <= transit["t1"] + 1e-9 <= restore_h["t0"] + 1e-9)
+        assert restore_h["t1"] <= by["finish"][0]["t1"] + 1e-6
+
+    # the cluster report carries the measured transit percentiles
+    rep = disagg_traced["rep"]
+    assert rep.handoff_observed_p50_ms is not None
+    assert rep.handoff_observed_p99_ms >= rep.handoff_observed_p50_ms
+    # and the decode pool's traced records carry observed beside priced
+    recs = read_metrics(str(disagg_traced["d"] / "m.jsonl"))
+    obs = [
+        v for r in recs
+        for v in ((r.get("metrics") or {}).get("serve") or {}).get(
+            "handoff_observed_ms", ()
+        )
+    ]
+    assert len(obs) == c.migrated
+
+
+def test_untraced_disagg_report_has_no_observed_fields(model, tmp_path):
+    cluster = DisaggregatedCluster(
+        model, prefill_slots=SLOTS, decode_slots=SLOTS,
+        prefill_block_size=8, decode_block_size=16, sync_every=4,
+        machine=_machine_2slice(),
+    )
+    rep = cluster.run(synthetic_requests(SPEC))
+    assert rep.migrated > 0
+    assert rep.handoff_observed_p50_ms is None
+    assert rep.handoff_observed_p99_ms is None
+
+
+# ------------------------------------------------------------ reporting
+def test_serve_report_timeline_renders_decomposition(
+    disagg_traced, capsys,
+):
+    from tools.serve_report import main as report_main
+
+    rc = report_main(["--timeline", disagg_traced["spans"]])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "request timelines:" in out
+    assert "TTFT decomposition per request" in out
+    assert "slowest requests" in out
+    assert "KV handoff transit: observed" in out
+    # every finished request appears as a row
+    n_fin = disagg_traced["rep"].requests_finished
+    assert f"{n_fin} traces" in out
+
+
+def test_serve_report_metrics_plus_timeline(disagg_traced, capsys):
+    from tools.serve_report import main as report_main
+
+    rc = report_main([
+        str(disagg_traced["d"] / "m.jsonl"),
+        "--timeline", disagg_traced["spans"],
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "serve run:" in out and "request timelines:" in out
+
+
+def test_serve_report_requires_some_input(capsys):
+    from tools.serve_report import main as report_main
+
+    with pytest.raises(SystemExit):
+        report_main([])
+
+
+def test_trace_report_merge_clock_aligns_lanes(tmp_path, capsys):
+    from tools.trace_report import main as trace_main
+
+    a = {"traceEvents": [
+        {"ph": "X", "name": "step", "cat": "runtime", "ts": 5000.0,
+         "dur": 10.0, "pid": 42, "tid": 1},
+    ], "flexflow_tpu": {"summary": {"wall_s": 0.01, "level": "step"}}}
+    b = {"traceEvents": [
+        {"ph": "X", "name": "step", "cat": "runtime", "ts": 90000.0,
+         "dur": 20.0, "pid": 42, "tid": 1},
+    ]}
+    pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    json.dump(a, open(pa, "w"))
+    json.dump(b, open(pb, "w"))
+    out_path = str(tmp_path / "merged.json")
+    rc = trace_main(["--merge", pa, pb, "--out", out_path])
+    assert rc == 0
+    assert "merged 2 traces" in capsys.readouterr().out
+    merged = json.load(open(out_path))
+    ev = merged["traceEvents"]
+    lanes = [e for e in ev if e["ph"] == "M" and e["name"] == "process_name"]
+    assert [(e["pid"], e["args"]["name"]) for e in lanes] == [
+        (0, "a.json"), (1, "b.json"),
+    ]
+    xs = [e for e in ev if e["ph"] == "X"]
+    # clock-aligned: each source's earliest event lands at ts=0 in its
+    # own lane, regardless of original absolute clocks
+    assert [(e["pid"], e["ts"]) for e in xs] == [(0, 0.0), (1, 0.0)]
+    assert merged["flexflow_tpu"]["merged_from"] == ["a.json", "b.json"]
+    # the merged doc still renders through the normal report path
+    rc = trace_main([out_path, "--by", "cat"])
+    assert rc == 0
+    assert "per-phase time breakdown" in capsys.readouterr().out
+
+
+# ------------------------------------------------------------- config
+def test_config_flags_parse():
+    cfg = FFConfig()
+    rest = cfg.parse_args([
+        "--serve-spans-out", "sp.jsonl", "--metrics-max-mb", "2.5",
+    ])
+    assert rest == []
+    assert cfg.serve_spans_out == "sp.jsonl"
+    assert cfg.metrics_max_mb == 2.5
+    assert FFConfig().serve_spans_out is None

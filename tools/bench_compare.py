@@ -104,6 +104,13 @@ COMPARABLE_METADATA = (
     # are the same experiment, but the gate surfaces the change because
     # exposed_comm_frac only moves when the ring engages
     "grad_overlap",
+    # serve_ttft_queue_ms_p99 / serve_handoff_observed_ms (r16,
+    # docs/OBSERVABILITY.md): wall-clock waits read off the traced
+    # disagg arm's ffspan/1 stream — the queue leg is load-shaped and
+    # the measured transit is host-scheduling-shaped, so both are
+    # surfaced for drift visibility, never gated
+    "serve_ttft_queue_ms_p99",
+    "serve_handoff_observed_ms",
 )
 
 # (label, path into the record, higher_is_better) — the gated metrics.

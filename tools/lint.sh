@@ -13,6 +13,11 @@ set -e
 cd "$(dirname "$0")/.."
 PATHS="${*:-flexflow_tpu tools tests bench.py}"
 
+# schema-registry gate: every ff<name>/<ver> literal in the source tree
+# must be registered in flexflow_tpu/obs/schemas.py (tests/ excluded —
+# refusal tests fabricate invalid tags on purpose)
+python tools/lint_schemas.py
+
 if command -v ruff >/dev/null 2>&1; then
     echo "[lint] ruff check $PATHS"
     # shellcheck disable=SC2086

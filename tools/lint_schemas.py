@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Tier-0 schema-registry gate (docs/ANALYSIS.md "Static gates").
+
+Greps every ``ff[a-z]+/[0-9]+`` literal in the source tree and fails on
+any tag not registered in ``flexflow_tpu/obs/schemas.py`` — a new wire
+or file schema (or a typo'd version bump) cannot land without being
+enumerated in the registry (and, per its contract, round-trip tested in
+tests/test_schemas.py).
+
+Scans ``flexflow_tpu tools bench.py`` by default.  tests/ is
+deliberately EXCLUDED: refusal tests fabricate invalid tags on purpose
+(e.g. the stale calibration-store case in tests/test_calibration.py,
+which writes a version-0 tag the loader must refuse).
+
+Loads the registry by file path — no flexflow_tpu (hence no jax) import,
+so the gate runs in the same hermetic containers as tools/lint.sh.
+
+Usage: python tools/lint_schemas.py [paths...]
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATHS = ("flexflow_tpu", "tools", "bench.py")
+
+
+def _load_registry():
+    path = os.path.join(REPO, "flexflow_tpu", "obs", "schemas.py")
+    spec = importlib.util.spec_from_file_location("ff_schemas", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _py_files(paths):
+    for p in paths:
+        full = os.path.join(REPO, p)
+        if os.path.isfile(full):
+            if full.endswith(".py"):
+                yield full
+        else:
+            for root, _dirs, files in os.walk(full):
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    paths = argv or list(DEFAULT_PATHS)
+    schemas = _load_registry()
+    bad = []
+    n_files = 0
+    for path in _py_files(paths):
+        n_files += 1
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        rel = os.path.relpath(path, REPO)
+        bad.extend(schemas.scan_text(text, rel))
+    if bad:
+        print(f"[lint-schemas] {len(bad)} unregistered schema tag(s):")
+        for path, line, tag in bad:
+            print(f"  {path}:{line}: {tag!r} not in obs/schemas.py registry")
+        return 1
+    print(
+        f"[lint-schemas] OK — {n_files} files, "
+        f"{len(schemas.SCHEMAS)} registered schemas"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,6 +3,15 @@
 
 Usage:
     python tools/serve_report.py METRICS.jsonl [--windows N]
+    python tools/serve_report.py --timeline SPANS.jsonl [--top N]
+
+``--timeline`` reads the ``--serve-spans-out`` ``ffspan/1`` stream
+instead (or additionally) and renders per-request timelines: each
+finished request's TTFT decomposed into queue-wait, prefill compute,
+and flush residual (the window-boundary wait before its first token
+flushed), the KV-handoff encode/transit/restore legs on disaggregated
+runs (measured transit beside the priced estimate), decode time, and a
+slowest-requests table — docs/OBSERVABILITY.md "Request timelines".
 
 Reads the ``--metrics-out`` stream a
 :class:`flexflow_tpu.serve.engine.ServeEngine` run writes (one record
@@ -252,20 +261,181 @@ def render(records: List[Dict], max_windows: int = 30) -> str:
     return "\n\n".join(out)
 
 
+def _ms(span: Dict) -> float:
+    return (span["t1"] - span["t0"]) * 1e3
+
+
+def _trace_row(trace_id: str, spans: List[Dict]) -> Dict:
+    """Fold one trace's spans into the timeline vocabulary (all times
+    ms).  Robust to partial chains — absent legs render as ``-``."""
+    by_name: Dict[str, List[Dict]] = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    root = (by_name.get("request") or [None])[0]
+    first = (by_name.get("first_token") or [None])[0]
+    queues = by_name.get("queue", [])
+    row = {
+        "trace": trace_id,
+        "req": root["req"] if root else (spans[0]["req"] if spans else "?"),
+        "outcome": (root or {}).get("attrs", {}).get("outcome", "?"),
+        "tokens": (root or {}).get("attrs", {}).get("tokens"),
+        "total_ms": _ms(root) if root else None,
+        # first queue span = first-pool admission wait (a disagg trace
+        # has a second queue span: the decode-pool wait after delivery)
+        "queue_ms": _ms(queues[0]) if queues else None,
+        "queue2_ms": _ms(queues[1]) if len(queues) > 1 else None,
+        "prefill_ms": sum(_ms(s) for s in by_name.get("prefill", ())) or None,
+        "decode_ms": sum(
+            _ms(s) for s in by_name.get("decode_window", ())
+        ) or None,
+        "ttft_ms": None,
+        "flush_ms": None,
+        "handoff_ms": None,
+        "transit_priced_ms": None,
+        "transit_observed_ms": None,
+        "preempt_ms": sum(
+            _ms(s)
+            for n in ("spill", "restore")
+            for s in by_name.get(n, ())
+        ) or None,
+    }
+    if root is not None and first is not None:
+        row["ttft_ms"] = (first["t1"] - root["t0"]) * 1e3
+        # flush residual: TTFT not accounted to queue-wait or prefill
+        # compute — the wait for the window boundary where the first
+        # token's host flush happened
+        spent = (row["queue_ms"] or 0.0) + (row["prefill_ms"] or 0.0)
+        row["flush_ms"] = max(0.0, row["ttft_ms"] - spent)
+    hand = [
+        s for n in ("handoff_encode", "handoff_transit", "handoff_restore")
+        for s in by_name.get(n, ())
+    ]
+    if hand:
+        row["handoff_ms"] = sum(_ms(s) for s in hand)
+        transit = by_name.get("handoff_transit", [])
+        if transit:
+            row["transit_priced_ms"] = transit[0]["attrs"].get("priced_ms")
+            row["transit_observed_ms"] = transit[0]["attrs"].get(
+                "observed_ms"
+            )
+    return row
+
+
+def render_timeline(spans: List[Dict], top: int = 10) -> str:
+    """Per-request timeline report from an ``ffspan/1`` stream
+    (``--serve-spans-out``): TTFT decomposition + slowest requests."""
+    by_trace: Dict[str, List[Dict]] = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    if not by_trace:
+        return "serve_report: no ffspan/1 records in this stream"
+    rows = [
+        _trace_row(t, sorted(ss, key=lambda s: (s["t0"], s["t1"])))
+        for t, ss in sorted(by_trace.items())
+    ]
+    outcomes: Dict[str, int] = {}
+    for r in rows:
+        outcomes[str(r["outcome"])] = outcomes.get(str(r["outcome"]), 0) + 1
+    out = [
+        f"request timelines: {len(rows)} traces, outcomes "
+        + ", ".join(f"{k}={v}" for k, v in sorted(outcomes.items()))
+    ]
+
+    def f(v, nd=3):
+        return f"{v:.{nd}f}" if isinstance(v, (int, float)) else "-"
+
+    ttfts = [r["ttft_ms"] for r in rows if r["ttft_ms"] is not None]
+    queues = [r["queue_ms"] for r in rows if r["queue_ms"] is not None]
+    if ttfts:
+        out.append(
+            f"TTFT p50 {_pct(ttfts, 50):.3f} ms / p99 "
+            f"{_pct(ttfts, 99):.3f} ms; queue-wait p50 "
+            f"{_pct(queues, 50):.3f} ms / p99 {_pct(queues, 99):.3f} ms"
+            if queues else
+            f"TTFT p50 {_pct(ttfts, 50):.3f} ms / p99 {_pct(ttfts, 99):.3f} ms"
+        )
+    obs = [
+        r["transit_observed_ms"] for r in rows
+        if r["transit_observed_ms"] is not None
+    ]
+    if obs:
+        priced = [
+            r["transit_priced_ms"] for r in rows
+            if r["transit_priced_ms"] is not None
+        ]
+        out.append(
+            f"KV handoff transit: observed p50 {_pct(obs, 50):.3f} ms / "
+            f"p99 {_pct(obs, 99):.3f} ms (priced estimate p50 "
+            f"{_pct(priced, 50):.3f} ms) over {len(obs)} migrations"
+        )
+
+    hdr = ["req", "outcome", "queue", "prefill", "flush", "ttft",
+           "handoff", "queue2", "decode", "total", "tokens"]
+    table_rows = [
+        [
+            r["req"], r["outcome"], f(r["queue_ms"]), f(r["prefill_ms"]),
+            f(r["flush_ms"]), f(r["ttft_ms"]), f(r["handoff_ms"]),
+            f(r["queue2_ms"]), f(r["decode_ms"]), f(r["total_ms"]),
+            r["tokens"] if r["tokens"] is not None else "-",
+        ]
+        for r in rows
+    ]
+    out.append(
+        "TTFT decomposition per request (ms; queue = first-pool "
+        "admission wait, flush = window-boundary residual, queue2 = "
+        "decode-pool wait after handoff):\n"
+        + _table(hdr, table_rows)
+    )
+    slow = sorted(
+        (r for r in rows if r["total_ms"] is not None),
+        key=lambda r: -r["total_ms"],
+    )[:top]
+    out.append(
+        f"slowest requests (top {len(slow)} by end-to-end time):\n"
+        + _table(
+            ["req", "outcome", "total_ms", "ttft_ms", "queue_ms",
+             "preempt_ms", "tokens"],
+            [
+                [r["req"], r["outcome"], f(r["total_ms"]), f(r["ttft_ms"]),
+                 f(r["queue_ms"]), f(r["preempt_ms"]),
+                 r["tokens"] if r["tokens"] is not None else "-"]
+                for r in slow
+            ],
+        )
+    )
+    return "\n\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("metrics", help="ffmetrics JSONL written by --metrics-out")
+    ap.add_argument("metrics", nargs="?", default=None,
+                    help="ffmetrics JSONL written by --metrics-out")
     ap.add_argument("--windows", type=int, default=30,
                     help="max per-window rows (newest kept)")
+    ap.add_argument("--timeline", default=None, metavar="SPANS",
+                    help="ffspan/1 JSONL written by --serve-spans-out: "
+                         "render per-request timelines")
+    ap.add_argument("--top", type=int, default=10,
+                    help="slowest-requests rows in --timeline mode")
     args = ap.parse_args(argv)
+    if args.metrics is None and args.timeline is None:
+        ap.error("give a METRICS stream, --timeline SPANS, or both")
     # read_metrics only parses JSONL (no jax import), but the package
     # must be importable when this runs from a checkout without install
     sys.path.insert(
         0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
     from flexflow_tpu.obs.metrics import read_metrics
+    from flexflow_tpu.obs.spans import read_spans
 
-    print(render(read_metrics(args.metrics), max_windows=args.windows))
+    parts = []
+    if args.metrics is not None:
+        parts.append(render(read_metrics(args.metrics),
+                            max_windows=args.windows))
+    if args.timeline is not None:
+        parts.append(render_timeline(read_spans(args.timeline),
+                                     top=args.top))
+    print("\n\n".join(parts))
     return 0
 
 

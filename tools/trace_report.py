@@ -3,6 +3,14 @@
 
 Usage:
     python tools/trace_report.py TRACE.json [--by {cat,name}] [--top N]
+    python tools/trace_report.py --merge A.json B.json ... [--out M.json]
+
+``--merge`` clock-aligns several Chrome traces (each source's earliest
+timestamp becomes t=0) and emits ONE merged trace with a process lane
+per source file (``pid`` 0..N-1 + ``process_name`` metadata events) —
+load it in Perfetto to see, e.g., a prefill pool's trace beside its
+decode pool's on one timeline.  The merged doc is also rendered (or
+written to ``--out`` for the browser).
 
 Reads the ``--trace-out`` JSON (``{"traceEvents": [...], "flexflow_tpu":
 {"summary": {...}}}``, also loadable in chrome://tracing / Perfetto) and
@@ -228,13 +236,72 @@ def render(doc: Dict, by: str = "both", top: int = 40) -> str:
     return "\n\n".join(out)
 
 
+def merge_traces(docs: List[Dict], names: List[str]) -> Dict:
+    """Clock-align ``docs`` (each source's earliest ``ts`` → 0) and
+    merge into one Chrome-trace doc with a process lane per source:
+    events keep their shape but gain ``pid=i``, and ``process_name``
+    metadata events label each lane with its source file.  Summaries
+    ride along under ``flexflow_tpu.sources`` keyed by name."""
+    events: List[Dict] = []
+    sources: Dict[str, Dict] = {}
+    for i, (doc, name) in enumerate(zip(docs, names)):
+        src = doc.get("traceEvents", [])
+        t0 = min((float(e.get("ts", 0.0)) for e in src), default=0.0)
+        events.append({
+            "ph": "M", "name": "process_name", "pid": i, "tid": 0,
+            "args": {"name": name},
+        })
+        for e in src:
+            e2 = dict(e)
+            e2["pid"] = i
+            if "ts" in e2:
+                e2["ts"] = float(e2["ts"]) - t0
+            events.append(e2)
+        summary = (doc.get("flexflow_tpu") or {}).get("summary")
+        if summary is not None:
+            sources[name] = summary
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "flexflow_tpu": {"merged_from": names, "sources": sources},
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="Chrome-trace JSON written by --trace-out")
+    ap.add_argument("trace", nargs="?", default=None,
+                    help="Chrome-trace JSON written by --trace-out")
     ap.add_argument("--by", choices=("cat", "name", "both"), default="both")
     ap.add_argument("--top", type=int, default=40,
                     help="max rows per breakdown table")
+    ap.add_argument("--merge", nargs="+", default=None, metavar="TRACE",
+                    help="clock-align + merge several traces into one "
+                         "doc with a process lane per source")
+    ap.add_argument("--out", default=None,
+                    help="write the merged doc here (with --merge)")
     args = ap.parse_args(argv)
+    if args.merge is not None:
+        import os
+
+        docs = []
+        for path in args.merge:
+            with open(path) as f:
+                docs.append(json.load(f))
+        names = [os.path.basename(p) for p in args.merge]
+        merged = merge_traces(docs, names)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(merged, f)
+            print(
+                f"merged {len(docs)} traces "
+                f"({sum(len(d.get('traceEvents', ())) for d in docs)} "
+                f"events) -> {args.out}"
+            )
+        else:
+            print(render(merged, by=args.by, top=args.top))
+        return 0
+    if args.trace is None:
+        ap.error("give a TRACE file or --merge A B ...")
     with open(args.trace) as f:
         doc = json.load(f)
     print(render(doc, by=args.by, top=args.top))

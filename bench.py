@@ -744,9 +744,17 @@ def _serve_continuous_ab(on_tpu: bool) -> dict:
     )
     reqs = synthetic_requests(spec)
 
-    # arm (a): continuous batching (compiles its own paged programs)
+    # arm (a): continuous batching (compiles its own paged programs).
+    # A default-policy SLO engine rides along (ISSUE 17): it evaluates
+    # the window records the engine already builds — zero extra syncs —
+    # and the record carries availability + alerts fired as comparable
+    # metadata (an alert on a smoke box is load, not a regression)
+    from flexflow_tpu.obs.slo import SLOEngine, SLOPolicy
+
+    slo = SLOEngine(SLOPolicy())
     engine = ServeEngine(
         model, slots=slots, block_size=16 if on_tpu else 8, sync_every=4,
+        slo=slo,
     )
     t0 = _time.perf_counter()
     rep = engine.run(reqs)
@@ -807,6 +815,8 @@ def _serve_continuous_ab(on_tpu: bool) -> dict:
         "windows": rep.windows,
         "host_syncs": rep.host_syncs,
         "new_tokens": rep.new_tokens,
+        "serve_slo_availability": round(slo.availability, 6),
+        "serve_alerts_fired": slo.alerts_fired,
     }
 
 
@@ -1777,6 +1787,13 @@ def run_bench(backend: str) -> None:
         # load-shaped, not regressions)
         "serve_ttft_queue_ms_p99": None,
         "serve_handoff_observed_ms": None,
+        # SLO ops plane (ISSUE 17, docs/OBSERVABILITY.md "SLOs, alerts,
+        # and live introspection"): availability and alerts fired under
+        # the default policy during the headline serve run — comparable
+        # metadata, not gated (a smoke box firing a burn alert reflects
+        # load shape, not a code regression)
+        "serve_slo_availability": None,
+        "serve_alerts_fired": None,
         # paged decode attention (ISSUE 14, docs/PERF.md "Paged decode
         # attention"): the paged decode program's peak live temp bytes
         # (LOWER-is-better gate — the gather materialization coming
@@ -1863,6 +1880,8 @@ def run_bench(backend: str) -> None:
     record["serve_tok_s"] = sab.get("serve_tok_s")
     record["serve_p99_ms"] = sab.get("serve_p99_ms")
     record["serve_traffic"] = sab.get("serve_traffic")
+    record["serve_slo_availability"] = sab.get("serve_slo_availability")
+    record["serve_alerts_fired"] = sab.get("serve_alerts_fired")
     pab = record["secondary"].get("serve_prefix_ab") or {}
     record["serve_prefix_hit_rate"] = pab.get("serve_prefix_hit_rate")
     xab = record["secondary"].get("serve_spec_ab") or {}

@@ -225,6 +225,11 @@ class FFConfig:
     serve_watchdog_s: float = 0.0  # flag windows slower than this (0 = off)
     serve_shed_windows: int = 0  # shed batch tier after N SLO-breach windows
     serve_drain_file: Optional[str] = None  # SIGTERM drain payload target
+    # --- SLO ops plane (docs/OBSERVABILITY.md "SLOs, alerts, and live
+    # introspection") ---
+    serve_slo_policy: Optional[str] = None  # SLOPolicy JSON file
+    serve_alerts_out: Optional[str] = None  # ffalert/1 fire/resolve JSONL
+    serve_status_port: int = 0  # /healthz /statusz /spanz /metricz (0 = off)
 
     def __post_init__(self) -> None:
         self._devices = None
@@ -434,6 +439,12 @@ class FFConfig:
                 self.serve_shed_windows = int(take())
             elif a == "--serve-drain-file":
                 self.serve_drain_file = take()
+            elif a == "--serve-slo-policy":
+                self.serve_slo_policy = take()
+            elif a == "--serve-alerts-out":
+                self.serve_alerts_out = take()
+            elif a == "--serve-status-port":
+                self.serve_status_port = int(take())
             else:
                 rest.append(a)
             i += 1

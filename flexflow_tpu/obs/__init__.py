@@ -35,7 +35,17 @@ from flexflow_tpu.obs.metrics import (
     read_metrics,
     step_record,
 )
+from flexflow_tpu.obs.export import render_prometheus
 from flexflow_tpu.obs.schemas import SCHEMAS
+from flexflow_tpu.obs.slo import (
+    ALERT_SCHEMA,
+    SLOEngine,
+    SLOPolicy,
+    fleet_from_serve_report,
+    read_alerts,
+    replay_stream,
+    scaling_recommendation,
+)
 from flexflow_tpu.obs.spans import (
     SPAN_KINDS,
     SPAN_SCHEMA,
@@ -88,4 +98,12 @@ __all__ = [
     "AGG_SCHEMA",
     "aggregate_streams",
     "SCHEMAS",
+    "SLOPolicy",
+    "SLOEngine",
+    "ALERT_SCHEMA",
+    "scaling_recommendation",
+    "read_alerts",
+    "replay_stream",
+    "fleet_from_serve_report",
+    "render_prometheus",
 ]

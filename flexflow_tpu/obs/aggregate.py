@@ -212,6 +212,19 @@ class MetricsAggregator:
             self.ingest(source, r)
         return len(records)
 
+    def ingest_follow(
+        self, source: str, path: str, stop=None, poll_s: float = 0.05
+    ) -> int:
+        """Live-tail ``path`` into the rollup until ``stop()`` returns
+        True (rotation-aware — ``read_metrics(follow=True)`` underneath).
+        Blocks; run it on its own thread (the introspection server
+        does).  Returns the record count ingested."""
+        n = 0
+        for r in read_metrics(path, follow=True, poll_s=poll_s, stop=stop):
+            self.ingest(source, r)
+            n += 1
+        return n
+
     # --- rollups ------------------------------------------------------
     def aggregate_report(self) -> Dict[str, Any]:
         """The fleet rollup: per-source gauges over the rolling window

@@ -1,0 +1,226 @@
+"""Prometheus text-exposition rendering of the observability state.
+
+``/metricz`` (serve/introspect.py) and ``tools/slo_report.py --prom``
+render the SAME state Prometheus scrapers expect — text exposition
+format 0.0.4: ``# HELP`` / ``# TYPE`` comment pairs followed by
+``name{label="value"} number`` sample lines.
+
+Metric names derive from the schema registry's tag families, so the
+scrape vocabulary and the file vocabulary stay one vocabulary:
+
+  * ``ffmetrics_*``  — the latest window record's numeric fields plus
+    its ``metrics.serve`` gauges (labels: ``phase``, ``attn_kernel``;
+    per-tenant gauges add ``tenant``/``tier``)
+  * ``ffagg_fleet_*`` — the aggregator's fleet rollup
+  * ``ffalert_*``     — SLO burn/budget gauges and the alert latch
+  * ``fftracer_counter_total`` — the process tracer's counters
+
+Rendering is pure string work over host-side dicts — no jax import, no
+device interaction (the zero-sync contract of the introspection plane
+is inherited, not re-earned here).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prom_name(family: str, *parts: str) -> str:
+    """A legal Prometheus metric name from a schema-tag family (e.g.
+    ``"ffmetrics/1"`` → ``ffmetrics``) plus name parts."""
+    base = family.split("/")[0]
+    return _NAME_RE.sub("_", "_".join([base, *[str(p) for p in parts]]))
+
+
+def _escape(v: Any) -> str:
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace("\n", "\\n")
+        .replace('"', '\\"')
+    )
+
+
+def _fmt_value(v: Any) -> Optional[str]:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, (int, float)):
+        f = float(v)
+        if math.isnan(f):
+            return "NaN"
+        if math.isinf(f):
+            return "+Inf" if f > 0 else "-Inf"
+        return repr(f) if isinstance(v, float) else str(v)
+    return None  # non-numeric: not a sample
+
+
+class PromText:
+    """Accumulates samples per metric, renders grouped exposition text
+    (one HELP/TYPE pair per metric name, samples beneath it)."""
+
+    def __init__(self) -> None:
+        # name -> (type, help, [(labels, value_str)])
+        self._m: Dict[str, Tuple[str, str, List[Tuple[Dict, str]]]] = {}
+
+    def add(
+        self,
+        name: str,
+        value: Any,
+        labels: Optional[Dict[str, Any]] = None,
+        mtype: str = "gauge",
+        help_text: str = "",
+    ) -> None:
+        s = _fmt_value(value)
+        if s is None:
+            return
+        _, _, samples = self._m.setdefault(name, (mtype, help_text, []))
+        samples.append((
+            {k: v for k, v in (labels or {}).items() if v is not None}, s,
+        ))
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for name in sorted(self._m):
+            mtype, help_text, samples = self._m[name]
+            if help_text:
+                lines.append(f"# HELP {name} {_escape(help_text)}")
+            lines.append(f"# TYPE {name} {mtype}")
+            for labels, value in samples:
+                if labels:
+                    body = ",".join(
+                        f'{k}="{_escape(v)}"'
+                        for k, v in sorted(labels.items())
+                    )
+                    lines.append(f"{name}{{{body}}} {value}")
+                else:
+                    lines.append(f"{name} {value}")
+        return "\n".join(lines) + "\n" if lines else ""
+
+
+def add_record(out: PromText, record: Dict[str, Any]) -> None:
+    """Fold one ``ffmetrics/1`` window record's numeric facts in.
+    Gauges are point-in-time — callers pass the LATEST record."""
+    serve = (record.get("metrics") or {}).get("serve") or {}
+    base_labels = {
+        "phase": serve.get("phase"),
+        "attn_kernel": serve.get("attn_kernel"),
+    }
+    for k, v in record.items():
+        if k in ("schema", "counters", "metrics"):
+            continue
+        out.add(
+            prom_name("ffmetrics/1", k), v, base_labels,
+            help_text=f"ffmetrics record field {k}",
+        )
+    for k, v in serve.items():
+        if k in ("finished", "tenants", "phase", "attn_kernel"):
+            continue
+        if isinstance(v, list):
+            continue  # per-event lists (handoff_ms) are not gauges
+        out.add(
+            prom_name("ffmetrics/1", "serve", k), v, base_labels,
+            help_text=f"serve window gauge {k}",
+        )
+    out.add(
+        prom_name("ffmetrics/1", "serve", "finished_window"),
+        len(serve.get("finished") or ()), base_labels,
+        help_text="requests finished in the latest window",
+    )
+    for tenant, d in (serve.get("tenants") or {}).items():
+        labels = {**base_labels, "tenant": tenant, "tier": d.get("tier")}
+        for k in ("active", "queued"):
+            out.add(
+                prom_name("ffmetrics/1", "serve", "tenant", k),
+                d.get(k), labels,
+                help_text=f"per-tenant {k} requests",
+            )
+    for k, v in (record.get("counters") or {}).items():
+        out.add(
+            prom_name("ffmetrics/1", "counter"), v,
+            {**base_labels, "name": k},
+            help_text="tracer counter delta carried by the record",
+        )
+
+
+def add_fleet(out: PromText, fleet: Dict[str, Any]) -> None:
+    """The aggregator's ``aggregate_report()["fleet"]`` rollup."""
+    for k, v in (fleet or {}).items():
+        out.add(
+            prom_name("ffagg/1", "fleet", k), v,
+            help_text=f"fleet rollup {k} (MetricsAggregator)",
+        )
+
+
+def add_slo(out: PromText, slo_state: Dict[str, Any]) -> None:
+    """SLO burn/budget gauges + the alert latch, from
+    :meth:`flexflow_tpu.obs.slo.SLOEngine.state`."""
+    if not slo_state:
+        return
+    out.add(
+        prom_name("ffalert/1", "fired_total"),
+        slo_state.get("alerts_fired", 0), mtype="counter",
+        help_text="SLO alerts fired so far",
+    )
+    out.add(
+        prom_name("ffalert/1", "resolved_total"),
+        slo_state.get("alerts_resolved", 0), mtype="counter",
+        help_text="SLO alerts resolved so far",
+    )
+    out.add(
+        prom_name("ffalert/1", "availability"),
+        slo_state.get("availability"),
+        help_text="observed availability (1 - bad/offered)",
+    )
+    for obj, st in (slo_state.get("objectives") or {}).items():
+        labels = {"objective": obj}
+        for k in ("budget_spent", "error_rate", "target"):
+            out.add(
+                prom_name("ffalert/1", k), st.get(k), labels,
+                help_text=f"SLO {k} per objective",
+            )
+        for tier in ("fast", "slow"):
+            out.add(
+                prom_name("ffalert/1", "burn"), st.get(f"burn_{tier}"),
+                {**labels, "tier": tier},
+                help_text="burn rate (error rate / budget) per window tier",
+            )
+            out.add(
+                prom_name("ffalert/1", "active"),
+                1 if tier in (st.get("active") or ()) else 0,
+                {**labels, "tier": tier},
+                help_text="1 while the (objective, tier) alert is latched",
+            )
+
+
+def add_tracer_counters(out: PromText, counters: Dict[str, float]) -> None:
+    """The process tracer's cumulative counters (obs/trace.py)."""
+    for name, v in sorted((counters or {}).items()):
+        out.add(
+            "fftracer_counter_total", v, {"name": name}, mtype="counter",
+            help_text="process tracer cumulative counter",
+        )
+
+
+def render_prometheus(
+    record: Optional[Dict[str, Any]] = None,
+    fleet: Optional[Dict[str, Any]] = None,
+    slo_state: Optional[Dict[str, Any]] = None,
+    counters: Optional[Dict[str, float]] = None,
+) -> str:
+    """One scrape body from whichever pieces of state exist.  Every
+    argument is optional — a pre-SLO stream still renders its record
+    and counter families."""
+    out = PromText()
+    if record:
+        add_record(out, record)
+    if fleet:
+        add_fleet(out, fleet)
+    if slo_state:
+        add_slo(out, slo_state)
+    if counters:
+        add_tracer_counters(out, counters)
+    return out.render()

@@ -286,22 +286,133 @@ def metrics_file_set(path: str) -> List[str]:
     return out
 
 
-def read_metrics(path: str) -> List[Dict[str, Any]]:
+def _parse_line(line: str) -> Optional[Dict[str, Any]]:
+    line = line.strip()
+    if not line:
+        return None
+    try:
+        rec = json.loads(line)
+    except json.JSONDecodeError:
+        return None  # torn tail line
+    return {k: _unclean(v) for k, v in rec.items()}
+
+
+def read_metrics(
+    path: str,
+    follow: bool = False,
+    poll_s: float = 0.05,
+    stop=None,
+):
     """Parse a metrics JSONL file back into records (non-finite floats
     restored).  A trailing partial line — the signature of a hard crash
     mid-write — is skipped, everything before it is returned.  When the
     stream rotated (``MetricsStream(max_mb=...)``) the whole rotated set
-    is read transparently, oldest file first."""
+    is read transparently, oldest file first.
+
+    ``follow=True`` returns a GENERATOR instead: after catching up on
+    everything already written it live-tails the stream, yielding each
+    record as it is appended and stepping across ``path.N`` rotation
+    boundaries (the writer renames the live file and reopens fresh; the
+    follower drains the renamed file to its record boundary, then
+    reopens ``path``).  Torn-tail tolerance is unchanged — only
+    newline-terminated lines are parsed, so a partially-flushed record
+    is held until its write completes.  ``stop`` is a zero-arg callable
+    polled every ``poll_s`` while idle; returning True ends the
+    generator after draining what is already on disk."""
+    if follow:
+        return _follow_metrics(path, poll_s=poll_s, stop=stop)
     out: List[Dict[str, Any]] = []
     for p in metrics_file_set(path):
         with open(p) as f:
             for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue  # torn tail line
-                out.append({k: _unclean(v) for k, v in rec.items()})
+                rec = _parse_line(line)
+                if rec is not None:
+                    out.append(rec)
     return out
+
+
+def _follow_metrics(path: str, poll_s: float = 0.05, stop=None):
+    import time as _time
+
+    stop = stop or (lambda: False)
+    # rotated files already consumed, by inode: rotation only RENAMES
+    # (path -> path.1 -> path.2 ...), so an inode identifies one file's
+    # contents for the stream's whole life whatever name it sits at —
+    # this is what keeps a fast writer (several rotations per poll)
+    # from ever skipping an intermediate path.N
+    seen: set = set()
+
+    def _drain_new_rotated():
+        # completed rotated files not yet consumed, oldest first
+        # (complete by construction — rotation renames whole files,
+        # never splits a record); the live ``path`` is never here
+        for p in metrics_file_set(path):
+            if p == path:
+                continue
+            try:
+                ino = os.stat(p).st_ino
+            except OSError:
+                continue  # shifted again mid-walk; next pass gets it
+            if ino in seen:
+                continue
+            with open(p) as rf:
+                for line in rf:
+                    rec = _parse_line(line)
+                    if rec is not None:
+                        yield rec
+            seen.add(ino)
+
+    f = None
+    buf = ""
+    try:
+        while True:
+            if f is None:
+                # catch up on anything rotated while we were not
+                # holding a live fd (startup, or a rotation step)
+                for rec in _drain_new_rotated():
+                    yield rec
+                if os.path.exists(path):
+                    f = open(path)
+                    buf = ""
+                elif stop():
+                    return  # everything on disk has been drained
+                else:
+                    _time.sleep(poll_s)
+                    continue
+            chunk = f.read()
+            if chunk:
+                buf += chunk
+                while "\n" in buf:
+                    line, buf = buf.split("\n", 1)
+                    rec = _parse_line(line)
+                    if rec is not None:
+                        yield rec
+                continue
+            # at EOF: has the live file rotated out from under the fd?
+            try:
+                rotated = (
+                    os.stat(path).st_ino != os.fstat(f.fileno()).st_ino
+                )
+            except FileNotFoundError:
+                rotated = True  # renamed; fresh live file not open yet
+            if rotated:
+                # drain the renamed file's tail (appends race the
+                # rename: the record that triggered rotation may have
+                # landed after our last read), mark it consumed, then
+                # step forward through any newer rotated files
+                buf += f.read()
+                while "\n" in buf:
+                    line, buf = buf.split("\n", 1)
+                    rec = _parse_line(line)
+                    if rec is not None:
+                        yield rec
+                seen.add(os.fstat(f.fileno()).st_ino)
+                f.close()
+                f = None
+                continue
+            if stop():
+                return
+            _time.sleep(poll_s)
+    finally:
+        if f is not None:
+            f.close()

@@ -60,6 +60,10 @@ SCHEMAS: Dict[str, Tuple[str, str]] = {
         "flexflow_tpu/analysis/core.py",
         "compiled-program static-analysis report (--verify-compiled)",
     ),
+    "ffalert/1": (
+        "flexflow_tpu/obs/slo.py",
+        "SLO burn-rate alert fire/resolve JSONL (--serve-alerts-out)",
+    ),
 }
 
 # matches a schema tag wherever it appears in source — string literal,

@@ -1,0 +1,453 @@
+"""SLO burn-rate engine: policy, alerts (``ffalert/1``), scaling signal.
+
+PR 16 landed the measurement substrate (``ffmetrics/1`` windows,
+``MetricsAggregator``); this module is the layer that ACTS on it — the
+control signal ROADMAP #2's fleet autoscaler consumes instead of
+re-deriving one from raw streams:
+
+  * :class:`SLOPolicy` — the objectives a serve deployment promises:
+    availability (1 − (rejected + expired) / offered), p99 TTFT and
+    TPOT targets, and a max queue depth.  JSON-loadable
+    (``--serve-slo-policy policy.json``); unknown keys are ignored so a
+    newer policy file still loads here (the ffmetrics interop rule
+    applied to config).
+  * :class:`SLOEngine` — evaluates the policy once per metrics window
+    with Google-SRE-style **multi-window burn-rate alerting**: each
+    objective's per-window (good, bad) events roll into a FAST window
+    (``fast_windows`` windows, high ``fast_burn`` threshold — the page)
+    and a SLOW window (``slow_windows``, low ``slow_burn`` — the
+    ticket).  Burn rate = observed error rate ÷ error budget, so a
+    burn of 1.0 spends budget exactly at the sustainable rate.  The
+    windows are measured in WINDOW COUNTS, not wall minutes, so a
+    20-window CPU-smoke run exercises both tiers deterministically.
+  * ``ffalert/1`` — the versioned alert stream: one JSONL record per
+    fire/resolve transition, latched per (objective, tier) — a
+    breaching alert fires ONCE and stays latched until its burn drops
+    below threshold, which emits the matching resolve record.  Same
+    strict-JSON / torn-tail / rotation contract as every JSONL stream
+    (the writer IS :class:`~flexflow_tpu.obs.metrics.MetricsStream`).
+  * :func:`scaling_recommendation` — a pure function from the
+    aggregator's ``aggregate_report()`` + a policy to
+    ``{action: scale_up | scale_down | hold | drain, reason}`` — the
+    direct autoscaler input, surfaced in the serve driver summary and
+    replayable offline by ``tools/slo_report.py``.
+
+Evaluation is entirely host-side arithmetic on records the engine
+already built after its single per-window sync — attaching an
+``SLOEngine`` adds zero host syncs and leaves every serve stream
+byte-identical (pinned in tests/test_introspect.py).
+
+Pure stdlib — importable without jax (the fleet controller will not
+run on an accelerator host), like ``obs/aggregate.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from flexflow_tpu.obs.metrics import MetricsStream, read_metrics
+
+# bump when a field changes meaning; ADDING fields keeps the version
+# (consumers ignore unknown keys — same interop rule as ffmetrics/1)
+ALERT_SCHEMA = "ffalert/1"
+
+# alert tiers, Google-SRE style: "fast" pages (high burn over a short
+# window), "slow" tickets (low burn sustained over a long window)
+ALERT_TIERS = ("fast", "slow")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOPolicy:
+    """The promises a serve deployment makes, plus the burn windows.
+
+    ``availability`` is the fraction of OFFERED requests that must be
+    served (offered = finished + rejected + expired + shed; the
+    scheduler's ``rejected`` ledger already folds expiry and shedding
+    in).  ``ttft_p99_ms`` / ``tpot_p99_ms`` are latency objectives at
+    ``latency_quantile``: at most ``1 − q/100`` of finished requests
+    may exceed the target.  ``max_queue_depth`` bounds the per-window
+    queue gauge; a window over it is one bad window-event against the
+    availability budget fraction (documented, not hidden).
+    """
+
+    availability: float = 0.99
+    ttft_p99_ms: float = 500.0
+    tpot_p99_ms: float = 200.0
+    max_queue_depth: int = 64
+    latency_quantile: float = 99.0
+    # burn windows in WINDOW COUNTS (not wall time): the fast tier
+    # looks at the last ``fast_windows`` metrics windows, the slow tier
+    # at the last ``slow_windows``
+    fast_windows: int = 3
+    slow_windows: int = 12
+    fast_burn: float = 10.0
+    slow_burn: float = 2.0
+
+    def __post_init__(self) -> None:
+        if not (0.0 < self.availability <= 1.0):
+            raise ValueError(
+                f"availability must be in (0, 1], got {self.availability}"
+            )
+        if not (50.0 <= self.latency_quantile < 100.0):
+            raise ValueError(
+                f"latency_quantile must be in [50, 100), got "
+                f"{self.latency_quantile}"
+            )
+        if self.fast_windows < 1 or self.slow_windows < self.fast_windows:
+            raise ValueError(
+                f"need 1 <= fast_windows <= slow_windows, got "
+                f"{self.fast_windows}/{self.slow_windows}"
+            )
+
+    # --- error budgets (fraction of events allowed to be bad) ---------
+    def budget(self, objective: str) -> float:
+        if objective in ("availability", "queue_depth"):
+            return 1.0 - self.availability
+        if objective in ("ttft_p99", "tpot_p99"):
+            return 1.0 - self.latency_quantile / 100.0
+        raise KeyError(objective)
+
+    def target(self, objective: str) -> float:
+        return {
+            "availability": self.availability,
+            "ttft_p99": self.ttft_p99_ms,
+            "tpot_p99": self.tpot_p99_ms,
+            "queue_depth": float(self.max_queue_depth),
+        }[objective]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SLOPolicy":
+        """Build from a JSON dict, IGNORING unknown keys — a policy
+        file written for a newer engine still loads (interop rule)."""
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})
+
+    @classmethod
+    def from_file(cls, path: str) -> "SLOPolicy":
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError(
+                f"SLO policy {path!r} must be a JSON object, got "
+                f"{type(doc).__name__}"
+            )
+        return cls.from_dict(doc)
+
+
+# the objective vocabulary SLOEngine evaluates per window
+OBJECTIVES = ("availability", "ttft_p99", "tpot_p99", "queue_depth")
+
+
+def _burn(events, budget: float, n: Optional[int] = None) -> Tuple[float, int]:
+    """Burn rate over the last ``n`` window-events (all when None):
+    observed error rate ÷ budget.  (burn, windows_measured)."""
+    ev = list(events)[-n:] if n is not None else list(events)
+    good = sum(e[0] for e in ev)
+    bad = sum(e[1] for e in ev)
+    total = good + bad
+    if total == 0 or budget <= 0.0:
+        return 0.0, len(ev)
+    return (bad / total) / budget, len(ev)
+
+
+class SLOEngine:
+    """Per-window SLO evaluation with latched multi-window alerts.
+
+    Feed it full ``ffmetrics/1`` records (:meth:`observe_record`) —
+    live from the serve loop, or replayed from a recorded stream in
+    file order; both produce the identical fire/resolve sequence
+    because everything is derived from the records themselves.
+    Cumulative counters (``rejected_total``) are deltaed per source
+    (the record's ``phase``), so a disagg cluster's two pools share
+    one engine without double counting.
+    """
+
+    def __init__(
+        self,
+        policy: SLOPolicy,
+        alerts_out: Optional[str] = None,
+        max_mb: float = 0.0,
+    ) -> None:
+        self.policy = policy
+        self.stream = MetricsStream(alerts_out, max_mb=max_mb)
+        self.windows = 0
+        self._hist: Dict[str, deque] = {
+            o: deque(maxlen=policy.slow_windows) for o in OBJECTIVES
+        }
+        self.totals: Dict[str, List[int]] = {o: [0, 0] for o in OBJECTIVES}
+        self._last_bad: Dict[str, int] = {}
+        # latched alerts: (objective, tier) -> the fire record
+        self.active: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self.alerts: List[Dict[str, Any]] = []  # fire/resolve, in order
+        self.alerts_fired = 0
+        self.alerts_resolved = 0
+
+    # --- ingestion ----------------------------------------------------
+    def observe_record(self, record: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Fold one metrics window in; returns the alert records (fire
+        and/or resolve) this window emitted (usually none)."""
+        m = record.get("metrics")
+        serve = m.get("serve") if isinstance(m, dict) else None
+        if not isinstance(serve, dict):
+            return []
+        pol = self.policy
+        src = serve.get("phase") or "_"
+        bad_total = int(serve.get("rejected_total") or 0)
+        bad = max(0, bad_total - self._last_bad.get(src, 0))
+        self._last_bad[src] = bad_total
+        fin = serve.get("finished") or []
+        ttfts = [f["ttft_ms"] for f in fin if f.get("ttft_ms") is not None]
+        tpots = [f["tpot_ms"] for f in fin if f.get("tpot_ms") is not None]
+        qd = serve.get("queue_depth")
+        q_bad = 1 if (qd is not None and qd > pol.max_queue_depth) else 0
+        events = {
+            "availability": (len(fin), bad),
+            "ttft_p99": (
+                sum(1 for v in ttfts if v <= pol.ttft_p99_ms),
+                sum(1 for v in ttfts if v > pol.ttft_p99_ms),
+            ),
+            "tpot_p99": (
+                sum(1 for v in tpots if v <= pol.tpot_p99_ms),
+                sum(1 for v in tpots if v > pol.tpot_p99_ms),
+            ),
+            "queue_depth": (1 - q_bad, q_bad) if qd is not None else (0, 0),
+        }
+        out: List[Dict[str, Any]] = []
+        t = float(record.get("t") or 0.0)
+        for obj in OBJECTIVES:
+            g, b = events[obj]
+            self._hist[obj].append((g, b))
+            self.totals[obj][0] += g
+            self.totals[obj][1] += b
+            budget = pol.budget(obj)
+            for tier, win_n, thr in (
+                ("fast", pol.fast_windows, pol.fast_burn),
+                ("slow", pol.slow_windows, pol.slow_burn),
+            ):
+                burn, n = _burn(self._hist[obj], budget, win_n)
+                key = (obj, tier)
+                if burn >= thr and key not in self.active:
+                    rec = self._alert_record(
+                        "fire", obj, tier, burn, thr, n, budget, t,
+                    )
+                    self.active[key] = rec
+                    self.alerts_fired += 1
+                    out.append(rec)
+                elif burn < thr and key in self.active:
+                    del self.active[key]
+                    rec = self._alert_record(
+                        "resolve", obj, tier, burn, thr, n, budget, t,
+                    )
+                    self.alerts_resolved += 1
+                    out.append(rec)
+        for rec in out:
+            self.alerts.append(rec)
+            self.stream.append(rec)
+        self.windows += 1
+        return out
+
+    def _alert_record(
+        self, event: str, objective: str, tier: str, burn: float,
+        threshold: float, n_windows: int, budget: float, t: float,
+    ) -> Dict[str, Any]:
+        verb = (
+            "exceeds" if event == "fire" else "back under"
+        )
+        return {
+            "schema": ALERT_SCHEMA,
+            "t": t,
+            "window": self.windows,
+            "event": event,
+            "objective": objective,
+            "tier": tier,
+            "burn": round(burn, 4),
+            "threshold": threshold,
+            "windows_measured": n_windows,
+            "budget": round(budget, 6),
+            "budget_spent": round(self.budget_spent(objective), 4),
+            "reason": (
+                f"{objective} burn {burn:.2f}x {verb} the {tier}-tier "
+                f"threshold {threshold:g}x over the last {n_windows} "
+                f"window(s) (error budget {budget:g})"
+            ),
+        }
+
+    # --- accounting ---------------------------------------------------
+    def error_rate(self, objective: str) -> float:
+        g, b = self.totals[objective]
+        return b / (g + b) if (g + b) else 0.0
+
+    def budget_spent(self, objective: str) -> float:
+        """Fraction of the run-to-date error budget consumed: observed
+        error rate ÷ budget (> 1 means the budget is blown)."""
+        budget = self.policy.budget(objective)
+        return self.error_rate(objective) / budget if budget > 0 else 0.0
+
+    @property
+    def availability(self) -> float:
+        """Observed availability so far: 1 − bad/offered (1.0 with no
+        offered requests yet — nothing has been refused)."""
+        return 1.0 - self.error_rate("availability")
+
+    def state(self) -> Dict[str, Any]:
+        """The full evaluation state (``/statusz``, slo_report)."""
+        pol = self.policy
+        objectives: Dict[str, Any] = {}
+        for obj in OBJECTIVES:
+            budget = pol.budget(obj)
+            fast, _ = _burn(self._hist[obj], budget, pol.fast_windows)
+            slow, _ = _burn(self._hist[obj], budget, pol.slow_windows)
+            g, b = self.totals[obj]
+            objectives[obj] = {
+                "target": pol.target(obj),
+                "budget": budget,
+                "good": g,
+                "bad": b,
+                "error_rate": round(self.error_rate(obj), 6),
+                "budget_spent": round(self.budget_spent(obj), 4),
+                "burn_fast": round(fast, 4),
+                "burn_slow": round(slow, 4),
+                "active": sorted(
+                    t for (o, t) in self.active if o == obj
+                ),
+            }
+        return {
+            "policy": pol.to_dict(),
+            "windows": self.windows,
+            "availability": round(self.availability, 6),
+            "alerts_fired": self.alerts_fired,
+            "alerts_resolved": self.alerts_resolved,
+            "active_alerts": [
+                {"objective": o, "tier": t} for (o, t) in sorted(self.active)
+            ],
+            "objectives": objectives,
+        }
+
+    def summary(self) -> Dict[str, Any]:
+        """The compact driver/bench summary."""
+        return {
+            "availability": round(self.availability, 6),
+            "alerts_fired": self.alerts_fired,
+            "alerts_resolved": self.alerts_resolved,
+            "active_alerts": len(self.active),
+            "windows": self.windows,
+            "budget_spent": {
+                o: round(self.budget_spent(o), 4) for o in OBJECTIVES
+            },
+        }
+
+    def close(self) -> None:
+        self.stream.close()
+
+
+def read_alerts(path: str) -> List[Dict[str, Any]]:
+    """Parse an ``ffalert/1`` JSONL stream (rotation-aware, torn-tail
+    tolerant — the shared :func:`read_metrics` contract)."""
+    return [r for r in read_metrics(path) if r.get("schema") == ALERT_SCHEMA]
+
+
+def replay_stream(
+    path: str, policy: SLOPolicy, alerts_out: Optional[str] = None,
+) -> SLOEngine:
+    """Replay a recorded metrics stream through a fresh engine — the
+    offline twin of live evaluation.  Record order IS emission order
+    (both pools of a disagg cluster append to one file), so the
+    fire/resolve sequence reproduces the live run's exactly."""
+    eng = SLOEngine(policy, alerts_out=alerts_out)
+    for rec in read_metrics(path):
+        eng.observe_record(rec)
+    return eng
+
+
+# ---------------------------------------------------------------- scaling
+def scaling_recommendation(
+    aggregate_report: Dict[str, Any], policy: SLOPolicy,
+) -> Dict[str, str]:
+    """Pure function from the fleet rollup to an autoscaler action.
+
+    Input is ``MetricsAggregator.aggregate_report()`` (ROADMAP #2: the
+    autoscaler consumes the rollup, not raw streams).  Decision order,
+    most to least urgent, each with a truthful reason:
+
+      * ``scale_up``  — queue depth over policy, or a fleet latency
+        percentile over its target (capacity is the binding constraint)
+      * ``drain``     — multiple sources, near-idle occupancy, empty
+        queues: a replica can drain via the SIGTERM path
+      * ``scale_down`` — one source, low occupancy, empty queues
+      * ``hold``      — within targets, or no serve signal to act on
+    """
+    fleet = (aggregate_report or {}).get("fleet") or {}
+    n_src = int(fleet.get("sources") or 0)
+    qd = fleet.get("queue_depth")
+    occ = fleet.get("occupancy_mean")
+    if n_src == 0 or (qd is None and occ is None):
+        return {
+            "action": "hold",
+            "reason": "no serve signal in the aggregate report",
+        }
+    if qd is not None and qd > policy.max_queue_depth:
+        return {
+            "action": "scale_up",
+            "reason": (
+                f"fleet queue depth {qd} exceeds policy max "
+                f"{policy.max_queue_depth}"
+            ),
+        }
+    for key, target in (
+        ("ttft_p99_ms", policy.ttft_p99_ms),
+        ("tpot_p99_ms", policy.tpot_p99_ms),
+    ):
+        v = fleet.get(key)
+        if v is not None and v > target:
+            return {
+                "action": "scale_up",
+                "reason": (
+                    f"fleet {key} {v:.1f} ms exceeds policy target "
+                    f"{target:g} ms"
+                ),
+            }
+    if occ is not None and (qd is None or qd == 0):
+        if occ < 0.1 and n_src > 1:
+            return {
+                "action": "drain",
+                "reason": (
+                    f"fleet occupancy {occ:.2f} with empty queues "
+                    f"across {n_src} sources — a replica can drain"
+                ),
+            }
+        if occ < 0.3:
+            return {
+                "action": "scale_down",
+                "reason": (
+                    f"fleet occupancy {occ:.2f} with empty queues — "
+                    f"capacity exceeds demand"
+                ),
+            }
+    return {"action": "hold", "reason": "fleet within SLO targets"}
+
+
+def fleet_from_serve_report(report: Dict[str, Any]) -> Dict[str, Any]:
+    """Shape one ServeReport dict as a (single-source) aggregate report
+    so ``scaling_recommendation`` works on runs recorded without a
+    metrics stream.  End-of-run truth: the queue has drained (depth 0),
+    occupancy/latency are the run means/percentiles."""
+    return {
+        "sources": {"serve": {}},
+        "fleet": {
+            "sources": 1,
+            "queue_depth": 0,
+            "occupancy_mean": report.get("occupancy_mean"),
+            "requests_finished": report.get("requests_finished"),
+            "new_tokens": report.get("new_tokens"),
+            "ttft_p50_ms": report.get("ttft_p50_ms"),
+            "ttft_p99_ms": report.get("ttft_p99_ms"),
+            "tpot_p50_ms": report.get("tpot_p50_ms"),
+            "tpot_p99_ms": report.get("tpot_p99_ms"),
+        },
+    }

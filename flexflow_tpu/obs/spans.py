@@ -245,9 +245,19 @@ class SpanRecorder:
         self.stream.close()
 
 
-def read_spans(path: str) -> List[Dict[str, Any]]:
+def read_spans(path: str, follow: bool = False, **kw):
     """Parse an ``ffspan/1`` JSONL stream (rotation-aware, torn-tail
-    tolerant — same reader contract as :func:`read_metrics`)."""
+    tolerant — same reader contract as :func:`read_metrics`).
+
+    ``follow=True`` returns a live-tail generator that yields span
+    records as they are appended, stepping across rotation boundaries
+    (``poll_s``/``stop`` pass through to :func:`read_metrics`)."""
+    if follow:
+        return (
+            r
+            for r in read_metrics(path, follow=True, **kw)
+            if r.get("schema") == SPAN_SCHEMA
+        )
     return [r for r in read_metrics(path) if r.get("schema") == SPAN_SCHEMA]
 
 

@@ -116,6 +116,7 @@ class DisaggregatedCluster:
         attn: str = "auto",
         spans_out: Optional[str] = None,
         metrics_max_mb: float = 0.0,
+        slo=None,
     ) -> None:
         self.machine = machine
         # ONE shared ffspan/1 recorder for both pools (obs/spans.py):
@@ -142,6 +143,7 @@ class DisaggregatedCluster:
             phase="prefill",
             span_recorder=self.spans,
             metrics_max_mb=metrics_max_mb,
+            slo=slo,
         )
         self.decode = ServeEngine(
             decode_model if decode_model is not None else model,
@@ -158,6 +160,7 @@ class DisaggregatedCluster:
             phase="decode",
             span_recorder=self.spans,
             metrics_max_mb=metrics_max_mb,
+            slo=slo,
         )
         self.transport = (
             transport if transport is not None
@@ -177,6 +180,14 @@ class DisaggregatedCluster:
         # land beside the priced estimates in the report
         self._sent: Dict[int, Tuple[float, float]] = {}
         self.handoff_observed_ms: List[float] = []
+        # ONE shared SLO engine for both pools (obs/slo.py — per-phase
+        # counter deltas inside keep the two streams from double
+        # counting); live introspection publishes a cluster-level
+        # snapshot by atomic reference swap, same contract as the
+        # engines' own (serve/introspect.py flips publish_status)
+        self.slo = slo
+        self.publish_status = False
+        self.status_snapshot: Optional[Dict[str, Any]] = None
 
     def _now(self) -> float:
         return time.perf_counter()
@@ -463,6 +474,23 @@ class DisaggregatedCluster:
             if self.decode.sched.active:
                 self.decode._window()
             self._pump(self._now() - t0)
+            if self.publish_status:
+                # cluster rollup beside the per-pool snapshots the
+                # engines publish at their own window boundaries
+                self.status_snapshot = {
+                    "t": time.time(),
+                    "split": (
+                        f"p{self.prefill.slots}+d{self.decode.slots}"
+                    ),
+                    "pools": {
+                        "prefill": self.prefill.status_snapshot,
+                        "decode": self.decode.status_snapshot,
+                    },
+                    "migrated": self.migrated,
+                    "migrated_kv_bytes": self.migrated_kv_bytes,
+                    "outbox": len(self._outbox),
+                    "transport_pending": self.transport.pending(),
+                }
             if (n_sub >= len(pending)
                     and self.prefill.sched.idle
                     and not self._outbox

@@ -132,6 +132,46 @@ def main(argv: Optional[List[str]] = None) -> int:
               "(incompatible with --disagg)", file=sys.stderr)
         return 2
 
+    # --- SLO ops plane (docs/OBSERVABILITY.md "SLOs, alerts, and live
+    # introspection") — set up BEFORE the model build so a bad policy
+    # file or an already-bound status port fails fast and truthfully
+    # (no compile, no silent fallback port)
+    slo = None
+    if (cfg.serve_slo_policy or cfg.serve_alerts_out
+            or cfg.serve_status_port):
+        from flexflow_tpu.obs.slo import SLOEngine, SLOPolicy
+
+        try:
+            policy = (
+                SLOPolicy.from_file(cfg.serve_slo_policy)
+                if cfg.serve_slo_policy else SLOPolicy()
+            )
+        except (OSError, ValueError) as e:
+            print(
+                f"--serve: cannot load SLO policy "
+                f"{cfg.serve_slo_policy!r}: {e}",
+                file=sys.stderr,
+            )
+            return 1
+        slo = SLOEngine(
+            policy, alerts_out=cfg.serve_alerts_out,
+            max_mb=cfg.metrics_max_mb,
+        )
+    status = None
+    if cfg.serve_status_port:
+        from flexflow_tpu.serve.introspect import StatusServer
+
+        try:
+            status = StatusServer(cfg.serve_status_port)
+        except OSError as e:
+            print(
+                f"--serve: cannot bind status port "
+                f"{cfg.serve_status_port}: {e} — the port is in use; "
+                f"pick another with --serve-status-port",
+                file=sys.stderr,
+            )
+            return 1
+
     from flexflow_tpu import FFModel
     from flexflow_tpu.models.transformer import gpt_decoder
     from flexflow_tpu.serve import ServeEngine, TrafficSpec, synthetic_requests
@@ -172,6 +212,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             machine=machine,
             spans_out=cfg.serve_spans_out,
             metrics_max_mb=cfg.metrics_max_mb,
+            slo=slo,
         )
     else:
         engine = ServeEngine(
@@ -192,6 +233,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             drain_path=cfg.serve_drain_file,
             spans_out=cfg.serve_spans_out,
             metrics_max_mb=cfg.metrics_max_mb,
+            slo=slo,
         )
         if opts["resume_drain"]:
             from flexflow_tpu.serve.engine import load_drain
@@ -215,15 +257,41 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         if opts["deadline_ms"] > 0:
             r.deadline_ms = opts["deadline_ms"]
-    report = engine.run(reqs)
+    model_desc = (
+        f"gpt L{opts['num_layers']} h{opts['hidden']} "
+        f"v{opts['vocab']} s{opts['seq']}"
+    )
+    if status is not None:
+        status.attach(
+            engine, slo=slo,
+            metrics_path=cfg.metrics_out,
+            spans_path=cfg.serve_spans_out,
+            meta={
+                "traffic": spec.identity,
+                "model": model_desc,
+                "disagg": opts["disagg"],
+                "strategy": {
+                    "grad_overlap": model.strategy.grad_overlap,
+                    "pipeline": model.strategy.pipeline is not None,
+                    "serve_price": getattr(
+                        model.strategy, "serve_price", None,
+                    ),
+                },
+            },
+        )
+        status.start()
+    try:
+        report = engine.run(reqs)
+    finally:
+        if status is not None:
+            status.close()
+        if slo is not None:
+            slo.close()
 
     out = {
         "metric": "serve_demo",
         "serve_traffic": spec.identity,
-        "model": (
-            f"gpt L{opts['num_layers']} h{opts['hidden']} "
-            f"v{opts['vocab']} s{opts['seq']}"
-        ),
+        "model": model_desc,
         "slots": slots,
         "block_size": (
             engine.decode.kv.block_size if opts["disagg"]
@@ -248,6 +316,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         out["serve_price"] = {
             k: sp[k] for k in ("tok_s", "p99_ms", "feasible")
         }
+    if slo is not None:
+        from flexflow_tpu.obs.aggregate import MetricsAggregator
+        from flexflow_tpu.obs.slo import (
+            fleet_from_serve_report,
+            scaling_recommendation,
+        )
+
+        # the autoscaler signal (ROADMAP #2), from the recorded stream
+        # when there is one (per-window fleet view) else from the run
+        # report (end-of-run view — queue drained by definition)
+        if cfg.metrics_out:
+            from flexflow_tpu.obs.metrics import read_metrics
+
+            agg = MetricsAggregator()
+            for rec in read_metrics(cfg.metrics_out):
+                src = (
+                    ((rec.get("metrics") or {}).get("serve") or {})
+                    .get("phase") or "serve"
+                )
+                agg.ingest(src, rec)
+            fleet_report = agg.aggregate_report()
+        else:
+            fleet_report = fleet_from_serve_report(out)
+        out["slo"] = slo.summary()
+        out["scaling"] = scaling_recommendation(fleet_report, slo.policy)
     print(json.dumps(out), flush=True)
     return 0
 

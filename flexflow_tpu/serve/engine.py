@@ -201,6 +201,7 @@ class ServeEngine:
         spans_out: Optional[str] = None,
         span_recorder: Optional[SpanRecorder] = None,
         metrics_max_mb: float = 0.0,
+        slo=None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -249,6 +250,18 @@ class ServeEngine:
         )
         self.sched = ContinuousBatchingScheduler(self.slots, self.kv)
         self.metrics = MetricsStream(metrics_out, max_mb=metrics_max_mb)
+        # SLO burn-rate engine (obs/slo.py): fed the SAME window record
+        # the metrics stream gets, strictly after the window's single
+        # host sync — attaching it adds zero syncs and leaves every
+        # stream byte-identical.  A disagg cluster passes ONE shared
+        # engine to both pools (per-phase counter deltas inside).
+        self.slo = slo
+        # live introspection (serve/introspect.py): when a StatusServer
+        # is attached it flips ``publish_status`` and the window loop
+        # publishes an immutable snapshot dict by atomic reference swap
+        # — no locks on the hot path, readers see old-or-new, never torn
+        self.publish_status = False
+        self.status_snapshot: Optional[Dict[str, Any]] = None
         # per-request distributed tracing (ffspan/1, obs/spans.py): a
         # disagg cluster passes ONE shared recorder to both pool engines
         # (shared clock base + unique span ids); a colocated engine owns
@@ -1182,7 +1195,12 @@ class ServeEngine:
             tracer.counter("serve.windows", 1.0)
             if steps:
                 tracer.counter("serve.decode_steps", float(steps))
-        if self.metrics.enabled:
+        # the window record is built once and fanned out: the metrics
+        # stream (when recording), the SLO engine, and the status
+        # snapshot all see the IDENTICAL dict — what the file says is
+        # what the alerts and endpoints say
+        if (self.metrics.enabled or self.slo is not None
+                or self.publish_status):
             fin = [
                 {
                     "id": r.id, "tokens": r.done_tokens,
@@ -1242,7 +1260,7 @@ class ServeEngine:
                     "drafted": spec_drafted_w,
                     "accepted": spec_accepted_w,
                 }
-            self.metrics.append(step_record(
+            rec = step_record(
                 step=self.windows - 1,
                 t=time.time(),
                 step_wall_s=win_wall,
@@ -1252,7 +1270,15 @@ class ServeEngine:
                 predicted_step_s=self.predicted_step_s,
                 predicted_tok_s=self.predicted_tok_s,
                 metrics={"serve": serve_m},
-            ))
+            )
+            if self.metrics.enabled:
+                self.metrics.append(rec)
+            if self.slo is not None:
+                self.slo.observe_record(rec)
+            if self.publish_status:
+                # immutable snapshot, published by atomic reference
+                # swap — the introspection server reads it lock-free
+                self.status_snapshot = self._status_snapshot(rec)
         # handoff accumulators are per-window whether or not a metrics
         # stream is attached
         self._handoff_ms_w = []
@@ -1263,6 +1289,24 @@ class ServeEngine:
         # sync, so tracing adds file writes but never a device wait
         if spans is not None:
             spans.flush()
+
+    def _status_snapshot(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+        """One immutable per-window snapshot for the introspection
+        server: the window record itself plus the scheduler ledgers and
+        the engine's drain/health flags.  Built strictly after the
+        window's single host sync from values already on the host —
+        publishing it costs a dict build, never a device wait."""
+        return {
+            "t": rec.get("t"),
+            "window": self.windows - 1,
+            "phase": self.phase,
+            "record": rec,
+            "sched": self.sched.publish_status(),
+            "drain_requested": self._drain_requested,
+            "drained": self.drained,
+            "watchdog_fires": self.watchdog_fires,
+            "attn_kernel": self.attn_kernel,
+        }
 
     def _finish_if_done(self, req: Request, tok: int) -> None:
         if req.eos_id is not None and tok == req.eos_id:

@@ -173,6 +173,10 @@ class ContinuousBatchingScheduler:
         # byte identical, tracing on adds zero host syncs.
         self.spans = None
         self.pool: Optional[str] = None
+        # last published immutable status snapshot (serve/introspect.py)
+        # — written by publish_status() via atomic reference swap, read
+        # lock-free by the status server; None until first publication
+        self.last_status: Optional[Dict[str, Any]] = None
 
     @property
     def queue(self) -> List[Request]:
@@ -462,6 +466,27 @@ class ContinuousBatchingScheduler:
     @property
     def idle(self) -> bool:
         return self.queue_depth == 0 and not self.active
+
+    def publish_status(self) -> Dict[str, Any]:
+        """Build (and retain as ``last_status``) an immutable snapshot
+        of the admission ledgers — plain host-side counters, no device
+        interaction.  The introspection server reads ``last_status``
+        by reference; a reader always sees a complete snapshot."""
+        snap = {
+            "queue_depth": self.queue_depth,
+            "queued_by_tier": {
+                t: len(q) for t, q in self._queues.items()
+            },
+            "active": len(self.active),
+            "occupancy": self.occupancy,
+            "finished_total": len(self.finished),
+            "rejected_total": len(self.rejected),
+            "expired_total": self.expired,
+            "shed_total": self.shed,
+            "preemptions_total": self.preemptions,
+        }
+        self.last_status = snap
+        return snap
 
     def tenant_summary(self) -> Dict[str, Dict[str, Any]]:
         """Per-tenant fairness aggregates over everything this

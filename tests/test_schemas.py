@@ -244,6 +244,58 @@ def _rt_ffcheck(tmp_path):
     assert len(doc["violations"]) == 1
 
 
+def _rt_ffalert(tmp_path):
+    from flexflow_tpu.obs.slo import (
+        ALERT_SCHEMA,
+        SLOEngine,
+        SLOPolicy,
+        read_alerts,
+    )
+
+    path = str(tmp_path / "alerts.jsonl")
+    pol = SLOPolicy(fast_windows=1, slow_windows=2)
+    eng = SLOEngine(pol, alerts_out=path)
+
+    def rec(rejected, n_fin):
+        return {
+            "schema": "ffmetrics/1", "t": 1.0, "step": 0,
+            "metrics": {"serve": {
+                "queue_depth": 0, "rejected_total": rejected,
+                "finished": [
+                    {"ttft_ms": 1.0, "tpot_ms": 1.0}
+                ] * n_fin,
+            }},
+        }
+
+    # window 0: all-rejected → fast-tier availability fire (latched);
+    # later all-served windows slide the breach out → resolve
+    eng.observe_record(rec(rejected=4, n_fin=0))
+    eng.observe_record(rec(rejected=4, n_fin=4))
+    eng.observe_record(rec(rejected=4, n_fin=4))
+    eng.close()
+    out = read_alerts(path)
+    assert all(r["schema"] == ALERT_SCHEMA for r in out)
+    events = [(r["event"], r["objective"], r["tier"]) for r in out]
+    assert ("fire", "availability", "fast") in events
+    assert ("resolve", "availability", "fast") in events
+    # latched dedup: exactly one fire per (objective, tier) transition
+    fires = [e for e in events if e[0] == "fire"]
+    assert len(fires) == len(set(fires))
+    for r in out:
+        assert r["reason"] and r["burn"] >= 0 and r["window"] >= 0
+    # old-record interop: unknown keys carried, not fatal
+    with open(path, "a") as f:
+        f.write(json.dumps({
+            "schema": "ffalert/1", "event": "fire", "objective": "x",
+            "tier": "fast", "window": 0, "future_key": True,
+        }) + "\n")
+    assert read_alerts(path)[-1]["future_key"] is True
+    # torn tail tolerated, same as every JSONL stream
+    with open(path, "a") as f:
+        f.write('{"schema": "ffalert/1", "event"')
+    assert len(read_alerts(path)) == len(out) + 1
+
+
 _ROUNDTRIPS = {
     "ffmetrics/1": _rt_ffmetrics,
     "ffspan/1": _rt_ffspan,
@@ -254,6 +306,7 @@ _ROUNDTRIPS = {
     "ffkv/1": _rt_ffkv,
     "ffdrain/1": _rt_ffdrain,
     "ffcheck/1": _rt_ffcheck,
+    "ffalert/1": _rt_ffalert,
 }
 
 

@@ -1,0 +1,373 @@
+"""SLO burn-rate engine tests (ISSUE 17, docs/OBSERVABILITY.md).
+
+Covers the :class:`SLOPolicy` contract (validation, JSON load, interop
+with newer policy files), the multi-window burn-rate mechanics
+(fast/slow tiers, latched fire/resolve, per-source cumulative-counter
+deltas), error-budget accounting, the pure
+:func:`scaling_recommendation` decision table, and the seeded-overload
+E2E: a real serve run whose queue backlog deterministically fires the
+fast-tier ``ffalert/1`` alert, drives a ``scale_up`` recommendation
+with a truthful reason, and resolves once the load subsides — then the
+recorded stream replays to the identical alert sequence offline, both
+via :func:`replay_stream` and via ``tools/slo_report.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)))
+)
+
+from flexflow_tpu.obs.aggregate import MetricsAggregator  # noqa: E402
+from flexflow_tpu.obs.metrics import read_metrics  # noqa: E402
+from flexflow_tpu.obs.slo import (  # noqa: E402
+    ALERT_SCHEMA,
+    OBJECTIVES,
+    SLOEngine,
+    SLOPolicy,
+    fleet_from_serve_report,
+    read_alerts,
+    replay_stream,
+    scaling_recommendation,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rec(window, *, rejected_total=0, queue_depth=0, fin=(),
+         phase=None, t=None):
+    """One synthetic ffmetrics/1 record with a serve block.  ``fin`` is
+    a list of (ttft_ms, tpot_ms) pairs for the window's finishes."""
+    serve = {
+        "queue_depth": queue_depth,
+        "rejected_total": rejected_total,
+        "finished": [
+            {"ttft_ms": a, "tpot_ms": b} for a, b in fin
+        ],
+    }
+    if phase is not None:
+        serve["phase"] = phase
+    return {
+        "schema": "ffmetrics/1",
+        "t": float(window) if t is None else t,
+        "step": window,
+        "metrics": {"serve": serve},
+    }
+
+
+# ----------------------------------------------------------------- policy
+def test_policy_defaults_and_budgets():
+    pol = SLOPolicy()
+    assert pol.availability == 0.99
+    assert pol.budget("availability") == pytest.approx(0.01)
+    assert pol.budget("queue_depth") == pytest.approx(0.01)
+    # latency objectives budget from the quantile, not availability
+    assert pol.budget("ttft_p99") == pytest.approx(0.01)
+    assert pol.budget("tpot_p99") == pytest.approx(0.01)
+    assert pol.target("ttft_p99") == 500.0
+    assert pol.target("queue_depth") == 64.0
+    with pytest.raises(KeyError):
+        pol.budget("nope")
+
+
+@pytest.mark.parametrize("bad", [
+    {"availability": 0.0},
+    {"availability": 1.5},
+    {"latency_quantile": 100.0},
+    {"latency_quantile": 10.0},
+    {"fast_windows": 0},
+    {"fast_windows": 8, "slow_windows": 4},
+])
+def test_policy_validation_rejects(bad):
+    with pytest.raises(ValueError):
+        SLOPolicy(**bad)
+
+
+def test_policy_json_roundtrip_ignores_unknown_keys(tmp_path):
+    pol = SLOPolicy(availability=0.95, fast_windows=2, slow_windows=8)
+    d = pol.to_dict()
+    d["from_the_future"] = {"nested": True}  # newer-engine key
+    path = tmp_path / "policy.json"
+    path.write_text(json.dumps(d))
+    loaded = SLOPolicy.from_file(str(path))
+    assert loaded == pol
+    # a non-object document is a truthful error, not a silent default
+    path.write_text("[1, 2]")
+    with pytest.raises(ValueError, match="JSON object"):
+        SLOPolicy.from_file(str(path))
+
+
+# ------------------------------------------------------- burn mechanics
+def test_fast_tier_fires_latches_and_resolves(tmp_path):
+    out = str(tmp_path / "alerts.jsonl")
+    pol = SLOPolicy(fast_windows=2, slow_windows=4)
+    eng = SLOEngine(pol, alerts_out=out)
+    # two all-rejected windows: availability burn over the fast window
+    # = (1.0 error rate) / 0.01 budget = 100x >= 10x -> fire once
+    eng.observe_record(_rec(0, rejected_total=4))
+    eng.observe_record(_rec(1, rejected_total=8))
+    fires = [a for a in eng.alerts
+             if a["event"] == "fire" and a["objective"] == "availability"]
+    assert [(a["tier"]) for a in fires] == ["fast", "slow"]
+    assert eng.active  # latched
+    # a third bad window must NOT re-fire (latched dedup)
+    eng.observe_record(_rec(2, rejected_total=12))
+    assert eng.alerts_fired == len(fires)
+    # all-served windows slide the breach out of the fast window ->
+    # fast resolves first (2-window lookback), slow once its 4-window
+    # lookback is clean enough to drop under 2x
+    good = [(1.0, 1.0)] * 8
+    for w in range(3, 9):
+        eng.observe_record(_rec(w, rejected_total=12, fin=good))
+    events = [(a["event"], a["objective"], a["tier"]) for a in eng.alerts]
+    assert ("resolve", "availability", "fast") in events
+    assert ("resolve", "availability", "slow") in events
+    assert not eng.active
+    assert eng.alerts_fired == eng.alerts_resolved == 2
+    # the stream on disk is the same sequence, schema-tagged
+    eng.close()
+    disk = read_alerts(out)
+    assert [r["schema"] for r in disk] == [ALERT_SCHEMA] * len(disk)
+    assert [(r["event"], r["objective"], r["tier"]) for r in disk] == events
+    for r in disk:
+        assert r["reason"] and "burn" in r["reason"]
+        assert r["windows_measured"] >= 1
+
+
+def test_rejected_total_deltas_per_source_no_double_count():
+    pol = SLOPolicy(fast_windows=2, slow_windows=4)
+    eng = SLOEngine(pol)
+    # two pools of a disagg cluster share one engine; each reports its
+    # OWN cumulative counter.  Constant counters mean zero new
+    # rejections -> no bad events, whatever the absolute values are.
+    good = [(1.0, 1.0)] * 4
+    for w in range(4):
+        eng.observe_record(
+            _rec(w, rejected_total=5, phase="prefill", fin=good))
+        eng.observe_record(
+            _rec(w, rejected_total=3, phase="decode", fin=good))
+    g, b = eng.totals["availability"]
+    # first window of each source seeds the delta baseline from 0, so
+    # exactly 5 + 3 bad events ever — never 8 per window
+    assert b == 8
+    assert g == 8 * 4
+    assert eng.windows == 8
+
+
+def test_latency_objectives_count_threshold_crossings():
+    pol = SLOPolicy(ttft_p99_ms=10.0, tpot_p99_ms=5.0,
+                    fast_windows=1, slow_windows=2)
+    eng = SLOEngine(pol)
+    eng.observe_record(_rec(0, fin=[(8.0, 1.0), (12.0, 9.0), (9.0, 2.0)]))
+    assert eng.totals["ttft_p99"] == [2, 1]
+    assert eng.totals["tpot_p99"] == [2, 1]
+    # 1/3 over budget 0.01 -> burn 33x: both tiers latch immediately
+    assert ("ttft_p99", "fast") in eng.active
+    assert ("tpot_p99", "fast") in eng.active
+
+
+def test_queue_depth_gauge_is_a_window_event():
+    pol = SLOPolicy(max_queue_depth=2, fast_windows=2, slow_windows=4)
+    eng = SLOEngine(pol)
+    eng.observe_record(_rec(0, queue_depth=7))
+    assert eng.totals["queue_depth"] == [0, 1]
+    assert ("queue_depth", "fast") in eng.active
+    eng.observe_record(_rec(1, queue_depth=0))
+    eng.observe_record(_rec(2, queue_depth=1))
+    assert ("queue_depth", "fast") not in eng.active
+
+
+def test_accounting_state_and_summary_shapes():
+    pol = SLOPolicy(fast_windows=2, slow_windows=4)
+    eng = SLOEngine(pol)
+    assert eng.availability == 1.0  # nothing offered, nothing refused
+    eng.observe_record(_rec(0, rejected_total=1, fin=[(1.0, 1.0)] * 3))
+    assert eng.availability == pytest.approx(0.75)
+    assert eng.budget_spent("availability") == pytest.approx(25.0)
+    st = eng.state()
+    assert set(st["objectives"]) == set(OBJECTIVES)
+    for obj in OBJECTIVES:
+        o = st["objectives"][obj]
+        assert {"target", "budget", "good", "bad", "error_rate",
+                "budget_spent", "burn_fast", "burn_slow",
+                "active"} <= set(o)
+    s = eng.summary()
+    assert s["windows"] == 1
+    assert s["availability"] == pytest.approx(0.75)
+    assert set(s["budget_spent"]) == set(OBJECTIVES)
+    # non-serve records are ignored, not crashed on
+    assert eng.observe_record({"schema": "ffmetrics/1",
+                               "metrics": {"loss": 1.0}}) == []
+    assert eng.windows == 1
+
+
+# ------------------------------------------------------------- scaling
+def _fleet(**kw):
+    f = {"sources": 1, "queue_depth": 0, "occupancy_mean": 0.5,
+         "ttft_p99_ms": 100.0, "tpot_p99_ms": 50.0}
+    f.update(kw)
+    return {"fleet": f}
+
+
+def test_scaling_recommendation_decision_table():
+    pol = SLOPolicy(max_queue_depth=4)
+    assert scaling_recommendation({}, pol)["action"] == "hold"
+    assert scaling_recommendation(
+        {"fleet": {"sources": 0}}, pol)["action"] == "hold"
+    r = scaling_recommendation(_fleet(queue_depth=9), pol)
+    assert r["action"] == "scale_up" and "queue depth 9" in r["reason"]
+    r = scaling_recommendation(_fleet(ttft_p99_ms=900.0), pol)
+    assert r["action"] == "scale_up" and "ttft_p99_ms" in r["reason"]
+    r = scaling_recommendation(_fleet(tpot_p99_ms=900.0), pol)
+    assert r["action"] == "scale_up" and "tpot_p99_ms" in r["reason"]
+    r = scaling_recommendation(
+        _fleet(occupancy_mean=0.05, sources=3), pol)
+    assert r["action"] == "drain" and "3 sources" in r["reason"]
+    r = scaling_recommendation(_fleet(occupancy_mean=0.05), pol)
+    assert r["action"] == "scale_down"
+    # a non-empty queue vetoes shrink even at low occupancy
+    r = scaling_recommendation(
+        _fleet(occupancy_mean=0.05, queue_depth=2), pol)
+    assert r["action"] == "hold"
+    assert scaling_recommendation(_fleet(), pol)["action"] == "hold"
+
+
+def test_fleet_from_serve_report_feeds_scaling():
+    rep = {"occupancy_mean": 0.8, "requests_finished": 16,
+           "new_tokens": 400, "ttft_p99_ms": 30.0, "tpot_p99_ms": 9.0}
+    agg = fleet_from_serve_report(rep)
+    assert agg["fleet"]["sources"] == 1
+    assert agg["fleet"]["queue_depth"] == 0
+    r = scaling_recommendation(agg, SLOPolicy())
+    assert r["action"] == "hold"
+
+
+# --------------------------------------------------------- overload E2E
+@pytest.fixture(scope="module")
+def overload_run(tmp_path_factory):
+    """One seeded serve run whose queue backlog breaches a tight
+    ``max_queue_depth`` policy, recorded to disk: (metrics_path,
+    alerts_path, policy, live_engine, report)."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.transformer import gpt_decoder
+    from flexflow_tpu.serve import ServeEngine, TrafficSpec, \
+        synthetic_requests
+
+    tmp = tmp_path_factory.mktemp("slo_e2e")
+    metrics = str(tmp / "metrics.jsonl")
+    alerts = str(tmp / "alerts.jsonl")
+    cfg = FFConfig(batch_size=2)
+    m = FFModel(cfg)
+    gpt_decoder(m, 2, 48, use_flash=False,
+                hidden=32, heads=4, ff_dim=64, num_layers=2, vocab=31)
+    m.compile(seed=0)
+    # latency targets non-binding (wall times depend on host speed);
+    # the queue gauge is the deterministic overload signal
+    pol = SLOPolicy(max_queue_depth=2, fast_windows=2, slow_windows=4,
+                    ttft_p99_ms=1e9, tpot_p99_ms=1e9)
+    slo = SLOEngine(pol, alerts_out=alerts)
+    eng = ServeEngine(m, slots=2, block_size=8, sync_every=2,
+                      metrics_out=metrics, slo=slo)
+    # 12 requests, all at t=0, 2 slots: a deep deterministic backlog
+    # that drains as the run progresses — overload, then recovery
+    spec = TrafficSpec(n_requests=12, seed=0, rate_rps=0.0,
+                       prompt_len=(4, 8), max_new=(4, 10), vocab=31)
+    report = eng.run(synthetic_requests(spec))
+    slo.close()
+    return metrics, alerts, pol, slo, report
+
+
+def test_overload_fires_fast_burn_then_resolves(overload_run):
+    _, _, _, slo, report = overload_run
+    events = [(a["event"], a["objective"], a["tier"]) for a in slo.alerts]
+    assert ("fire", "queue_depth", "fast") in events
+    assert ("resolve", "queue_depth", "fast") in events
+    fire = next(a for a in slo.alerts
+                if (a["event"], a["objective"], a["tier"])
+                == ("fire", "queue_depth", "fast"))
+    res = next(a for a in slo.alerts
+               if (a["event"], a["objective"], a["tier"])
+               == ("resolve", "queue_depth", "fast"))
+    assert res["window"] > fire["window"]
+    assert fire["burn"] >= fire["threshold"] > res["burn"]
+    assert "queue_depth burn" in fire["reason"]
+    # the run itself finished everything — overload was transient
+    assert report.requests_finished == 12
+    assert not slo.active or all(
+        t == "slow" for (_, t) in slo.active)
+
+
+def test_overload_drives_truthful_scale_up(overload_run):
+    metrics, _, pol, _, _ = overload_run
+    agg = MetricsAggregator(window=64)
+    saw_scale_up = None
+    for rec in read_metrics(metrics):
+        serve = ((rec.get("metrics") or {}).get("serve") or {})
+        agg.ingest(serve.get("phase") or "serve", rec)
+        r = scaling_recommendation(agg.aggregate_report(), pol)
+        if r["action"] == "scale_up" and saw_scale_up is None:
+            saw_scale_up = r
+    assert saw_scale_up is not None
+    assert "queue depth" in saw_scale_up["reason"]
+    assert f"policy max {pol.max_queue_depth}" in saw_scale_up["reason"]
+
+
+def test_replay_stream_reproduces_live_alert_sequence(overload_run):
+    metrics, alerts, pol, slo, _ = overload_run
+    key = lambda a: (  # noqa: E731
+        a["window"], a["event"], a["objective"], a["tier"])
+    replayed = replay_stream(metrics, pol)
+    assert [key(a) for a in replayed.alerts] == [key(a) for a in slo.alerts]
+    assert replayed.windows == slo.windows
+    assert replayed.availability == pytest.approx(slo.availability)
+    # and the on-disk ffalert stream is that same sequence
+    assert [key(a) for a in read_alerts(alerts)] \
+        == [key(a) for a in slo.alerts]
+
+
+def test_slo_report_cli_replays_and_matches(overload_run, tmp_path):
+    metrics, alerts, pol, _, _ = overload_run
+    pol_path = tmp_path / "policy.json"
+    pol_path.write_text(json.dumps(pol.to_dict()))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "slo_report.py"),
+         metrics, "--policy", str(pol_path), "--alerts", alerts,
+         "--prom"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "MATCH" in out.stdout and "MISMATCH" not in out.stdout
+    assert "queue_depth" in out.stdout
+    assert "scaling recommendation timeline" in out.stdout
+    assert "scale_up" in out.stdout
+    # --prom tail parses as exposition text (families present)
+    assert "# TYPE ffalert_availability gauge" in out.stdout
+
+    # the --slo section of serve_report rides the same stream
+    out2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_report.py"),
+         metrics, "--slo", str(pol_path)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out2.returncode == 0, out2.stderr
+    assert "SLO" in out2.stdout and "queue_depth" in out2.stdout
+
+
+def test_slo_report_empty_stream_is_graceful(tmp_path):
+    p = tmp_path / "empty.jsonl"
+    p.write_text(json.dumps({"schema": "ffmetrics/1", "step": 0,
+                             "metrics": {"loss": 1.0}}) + "\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "slo_report.py"),
+         str(p)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "no serve records" in out.stdout

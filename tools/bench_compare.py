@@ -111,6 +111,13 @@ COMPARABLE_METADATA = (
     # surfaced for drift visibility, never gated
     "serve_ttft_queue_ms_p99",
     "serve_handoff_observed_ms",
+    # serve_slo_availability / serve_alerts_fired (r17,
+    # docs/OBSERVABILITY.md "SLOs, alerts, and live introspection"):
+    # the headline serve run evaluated under the default SLOPolicy —
+    # availability and burn alerts are load/host-speed shaped on a
+    # smoke box, so both surface for drift visibility, never gated
+    "serve_slo_availability",
+    "serve_alerts_fired",
 )
 
 # (label, path into the record, higher_is_better) — the gated metrics.

@@ -406,6 +406,67 @@ def render_timeline(spans: List[Dict], top: int = 10) -> str:
     return "\n\n".join(out)
 
 
+def render_slo(records: List[Dict], policy) -> str:
+    """SLO/burn-rate/budget section (``--slo policy.json``): replay the
+    stream through an :class:`~flexflow_tpu.obs.slo.SLOEngine`.  Same
+    graceful-absence pattern as the r13 per-phase table — a stream with
+    no serve records (pre-r17 training streams included) renders one
+    truthful line instead of an empty table."""
+    from flexflow_tpu.obs.slo import OBJECTIVES, SLOEngine
+
+    eng = SLOEngine(policy)
+    for r in records:
+        eng.observe_record(r)
+    if eng.windows == 0:
+        return ("SLO (--slo): no serve records in this stream — "
+                "nothing to evaluate")
+    st = eng.state()
+    out = [
+        f"SLO evaluation over {eng.windows} windows: availability "
+        f"{eng.availability:.4f} (target {policy.availability:g}), "
+        f"{eng.alerts_fired} alert(s) fired, "
+        f"{eng.alerts_resolved} resolved, {len(eng.active)} active"
+    ]
+    rows = [
+        [
+            o,
+            f"{d['target']:g}",
+            f"{d['budget']:g}",
+            d["good"], d["bad"],
+            f"{d['error_rate']:.4f}",
+            f"{d['budget_spent']:.2f}x",
+            f"{d['burn_fast']:.2f}x", f"{d['burn_slow']:.2f}x",
+            ",".join(d["active"]) or "-",
+        ]
+        for o, d in ((o, st["objectives"][o]) for o in OBJECTIVES)
+    ]
+    out.append(
+        "per-objective burn/budget (burn = error rate / budget; fast "
+        f"tier = last {policy.fast_windows} windows @ "
+        f"{policy.fast_burn:g}x, slow = last {policy.slow_windows} @ "
+        f"{policy.slow_burn:g}x):\n"
+        + _table(
+            ["objective", "target", "budget", "good", "bad", "err",
+             "spent", "fast", "slow", "latched"],
+            rows,
+        )
+    )
+    if eng.alerts:
+        out.append(
+            "alerts (fire/resolve, in stream order):\n"
+            + _table(
+                ["window", "event", "objective", "tier", "burn",
+                 "threshold"],
+                [
+                    [a["window"], a["event"], a["objective"], a["tier"],
+                     f"{a['burn']:.2f}x", f"{a['threshold']:g}x"]
+                    for a in eng.alerts
+                ],
+            )
+        )
+    return "\n\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("metrics", nargs="?", default=None,
@@ -417,9 +478,15 @@ def main(argv=None) -> int:
                          "render per-request timelines")
     ap.add_argument("--top", type=int, default=10,
                     help="slowest-requests rows in --timeline mode")
+    ap.add_argument("--slo", default=None, metavar="POLICY",
+                    help="SLOPolicy JSON: append the SLO/burn-rate/"
+                         "budget section replayed over METRICS "
+                         "(tools/slo_report.py is the full CLI)")
     args = ap.parse_args(argv)
     if args.metrics is None and args.timeline is None:
         ap.error("give a METRICS stream, --timeline SPANS, or both")
+    if args.slo is not None and args.metrics is None:
+        ap.error("--slo needs a METRICS stream to replay")
     # read_metrics only parses JSONL (no jax import), but the package
     # must be importable when this runs from a checkout without install
     sys.path.insert(
@@ -430,8 +497,12 @@ def main(argv=None) -> int:
 
     parts = []
     if args.metrics is not None:
-        parts.append(render(read_metrics(args.metrics),
-                            max_windows=args.windows))
+        records = read_metrics(args.metrics)
+        parts.append(render(records, max_windows=args.windows))
+        if args.slo is not None:
+            from flexflow_tpu.obs.slo import SLOPolicy
+
+            parts.append(render_slo(records, SLOPolicy.from_file(args.slo)))
     if args.timeline is not None:
         parts.append(render_timeline(read_spans(args.timeline),
                                      top=args.top))

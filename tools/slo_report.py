@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Offline SLO burn-rate / budget / alert replay over a recorded stream.
+
+Usage:
+    python tools/slo_report.py METRICS.jsonl [--policy POLICY.json]
+        [--alerts ALERTS.jsonl] [--window N] [--prom]
+
+Replays a serve run's ``--metrics-out`` ``ffmetrics/1`` stream through
+a fresh :class:`~flexflow_tpu.obs.slo.SLOEngine` — record order IS
+emission order, so the fire/resolve sequence reproduces the live run's
+exactly — and prints:
+
+  * the per-objective burn/budget table (target, error budget, good/bad
+    events, budget spent, fast/slow burn, latched alerts);
+  * every ``ffalert/1`` fire/resolve transition with its truthful
+    reason, plus a MATCH/MISMATCH verdict against a recorded alert
+    stream when ``--alerts`` names the live run's
+    ``--serve-alerts-out`` file;
+  * the :func:`~flexflow_tpu.obs.slo.scaling_recommendation` timeline —
+    the action the ROADMAP #2 autoscaler would have taken at each
+    window where the recommendation CHANGED, and the final one;
+  * with ``--prom``, the final state as Prometheus text exposition
+    (the same rendering ``/metricz`` serves live).
+
+``--policy`` defaults to the default :class:`SLOPolicy` (the same
+default the serve driver uses when ``--serve-status-port`` is set
+without ``--serve-slo-policy``).  Pure stdlib + the repo's readers —
+runnable without jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List
+
+
+def _table(headers: List[str], rows: List[List]) -> str:
+    if not rows:
+        return "  (empty)"
+    widths = [
+        max(len(str(h)), *(len(str(r[i])) for r in rows))
+        for i, h in enumerate(headers)
+    ]
+
+    def fmt(vals):
+        return "  " + "  ".join(str(v).ljust(w) for v, w in zip(vals, widths))
+
+    sep = "  " + "  ".join("-" * w for w in widths)
+    return "\n".join([fmt(headers), sep] + [fmt(r) for r in rows])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("metrics",
+                    help="ffmetrics JSONL written by --metrics-out")
+    ap.add_argument("--policy", default=None, metavar="POLICY",
+                    help="SLOPolicy JSON (default: the default policy)")
+    ap.add_argument("--alerts", default=None, metavar="ALERTS",
+                    help="recorded ffalert/1 stream (--serve-alerts-out) "
+                         "to compare the replay against")
+    ap.add_argument("--window", type=int, default=64,
+                    help="aggregator rolling window for the scaling "
+                         "replay (records)")
+    ap.add_argument("--prom", action="store_true",
+                    help="also dump the final state as Prometheus text "
+                         "exposition (what /metricz serves live)")
+    args = ap.parse_args(argv)
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from flexflow_tpu.obs.aggregate import MetricsAggregator
+    from flexflow_tpu.obs.export import render_prometheus
+    from flexflow_tpu.obs.metrics import read_metrics
+    from flexflow_tpu.obs.slo import (
+        OBJECTIVES,
+        SLOEngine,
+        SLOPolicy,
+        read_alerts,
+        scaling_recommendation,
+    )
+
+    policy = (
+        SLOPolicy.from_file(args.policy) if args.policy else SLOPolicy()
+    )
+    records = read_metrics(args.metrics)
+    eng = SLOEngine(policy)
+    agg = MetricsAggregator(window=args.window)
+    # replay one record at a time, keeping the rolling fleet view in
+    # step with the SLO engine so the scaling timeline is per-window
+    last_action = None
+    timeline: List[Dict] = []
+    for rec in records:
+        alerts = eng.observe_record(rec)
+        src = (
+            ((rec.get("metrics") or {}).get("serve") or {})
+            .get("phase") or "serve"
+        )
+        agg.ingest(src, rec)
+        del alerts  # folded into eng.alerts; the tables read from there
+        scaling = scaling_recommendation(agg.aggregate_report(), policy)
+        if scaling["action"] != last_action:
+            timeline.append({"window": eng.windows - 1, **scaling})
+            last_action = scaling["action"]
+    if eng.windows == 0:
+        print("slo_report: no serve records in this stream — "
+              "nothing to evaluate")
+        return 0
+
+    st = eng.state()
+    print(
+        f"SLO replay: {eng.windows} windows, availability "
+        f"{eng.availability:.4f} (target {policy.availability:g}), "
+        f"{eng.alerts_fired} alert(s) fired, {eng.alerts_resolved} "
+        f"resolved, {len(eng.active)} still active"
+    )
+    print()
+    print(
+        "per-objective burn/budget (burn = error rate / budget; fast "
+        f"tier = last {policy.fast_windows} windows @ "
+        f"{policy.fast_burn:g}x, slow = last {policy.slow_windows} @ "
+        f"{policy.slow_burn:g}x):"
+    )
+    print(_table(
+        ["objective", "target", "budget", "good", "bad", "err",
+         "spent", "fast", "slow", "latched"],
+        [
+            [
+                o,
+                f"{st['objectives'][o]['target']:g}",
+                f"{st['objectives'][o]['budget']:g}",
+                st["objectives"][o]["good"],
+                st["objectives"][o]["bad"],
+                f"{st['objectives'][o]['error_rate']:.4f}",
+                f"{st['objectives'][o]['budget_spent']:.2f}x",
+                f"{st['objectives'][o]['burn_fast']:.2f}x",
+                f"{st['objectives'][o]['burn_slow']:.2f}x",
+                ",".join(st["objectives"][o]["active"]) or "-",
+            ]
+            for o in OBJECTIVES
+        ],
+    ))
+    print()
+    if eng.alerts:
+        print("alerts (fire/resolve, replay order):")
+        print(_table(
+            ["window", "event", "objective", "tier", "burn",
+             "threshold", "reason"],
+            [
+                [a["window"], a["event"], a["objective"], a["tier"],
+                 f"{a['burn']:.2f}x", f"{a['threshold']:g}x",
+                 a["reason"]]
+                for a in eng.alerts
+            ],
+        ))
+    else:
+        print("alerts: none fired")
+    if args.alerts:
+        recorded = read_alerts(args.alerts)
+        key = lambda a: (  # noqa: E731
+            a["window"], a["event"], a["objective"], a["tier"],
+        )
+        rep_keys = [key(a) for a in eng.alerts]
+        rec_keys = [key(a) for a in recorded]
+        verdict = "MATCH" if rep_keys == rec_keys else "MISMATCH"
+        print()
+        print(
+            f"recorded alert stream {args.alerts}: {len(recorded)} "
+            f"record(s) vs {len(eng.alerts)} replayed — {verdict}"
+        )
+        if verdict == "MISMATCH":
+            only_rec = [k for k in rec_keys if k not in rep_keys]
+            only_rep = [k for k in rep_keys if k not in rec_keys]
+            if only_rec:
+                print(f"  only in recorded: {only_rec}")
+            if only_rep:
+                print(f"  only in replay:   {only_rep}")
+    print()
+    print("scaling recommendation timeline (windows where the action "
+          "changed; the ROADMAP #2 autoscaler input):")
+    print(_table(
+        ["window", "action", "reason"],
+        [[t["window"], t["action"], t["reason"]] for t in timeline],
+    ))
+    final = scaling_recommendation(agg.aggregate_report(), policy)
+    print()
+    print(f"final recommendation: {final['action']} — {final['reason']}")
+    if args.prom:
+        print()
+        print(render_prometheus(
+            record=records[-1] if records else None,
+            fleet=agg.aggregate_report()["fleet"],
+            slo_state=st,
+        ), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1309,6 +1309,154 @@ def _serve_disagg_ab(on_tpu: bool) -> dict:
     }
 
 
+def _serve_fleet_ab(on_tpu: bool) -> dict:
+    """Fleet routing A/B (ISSUE 18 acceptance, docs/SERVING.md "Fleet
+    tier"): the SAME compiled model serves the SAME bursty multi-tenant
+    multi-turn workload behind a 3-replica FleetRouter twice — once
+    with prefix-cache-aware routing, once round-robin.
+
+    Round-robin scatters a tenant's shared-prefix repeats across
+    replicas, so each replica pays the full prefill for blocks another
+    replica already holds; prefix routing reads the replicas'
+    window-boundary residency digests and lands repeats where their
+    blocks live.  The gated pair: ``serve_fleet_prefix_hit_rate`` (the
+    POOLED sum-hits/sum-lookups across replicas, higher-is-better) and
+    ``serve_fleet_p99_tpot_ms`` (the prefix arm's p99 per-decode-token
+    window latency across every replica's ffmetrics stream — the r13
+    disagg convention — LOWER-is-better), and prefix must beat
+    round-robin on BOTH: skipped shared prefill removes the chunks
+    that inflate mixed windows, and landing repeats together fills
+    batched decode steps that round-robin leaves fragmented.  Token
+    streams stay bit-identical across arms per request id (greedy
+    argmax, same weights — placement must not change the math)."""
+    import tempfile
+
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.transformer import gpt_decoder
+    from flexflow_tpu.serve import TrafficSpec, synthetic_requests
+    from flexflow_tpu.serve.fleet import FleetRouter
+
+    slots = 8 if on_tpu else 4
+    seq = 512 if on_tpu else 160
+    shape = (
+        dict(hidden=512, heads=8, ff_dim=2048, num_layers=6)
+        if on_tpu
+        else dict(hidden=128, heads=4, ff_dim=256, num_layers=2)
+    )
+    vocab = 32000 if on_tpu else 256
+    cfg = FFConfig(
+        batch_size=slots, compute_dtype="bfloat16" if on_tpu else "float32",
+    )
+    model = FFModel(cfg)
+    gpt_decoder(model, slots, seq, vocab=vocab, **shape)
+    model.compile(seed=0)
+
+    # bursty shared-prefix multi-tenant shape: 4 tenants whose system
+    # prompts span many full KV blocks (the routable residency), short
+    # fresh tails, 2-turn sessions (affinity + turn-2 prompt extension),
+    # bursts so waves of same-tenant arrivals land together
+    spec = TrafficSpec(
+        n_requests=32 if on_tpu else 16,
+        seed=0,
+        rate_rps=25.0,
+        burst_factor=4.0,
+        prompt_len=(8, 16) if not on_tpu else (32, 64),
+        max_new=(16, 32) if not on_tpu else (48, 96),
+        vocab=vocab,
+        tenants=4,
+        shared_prefix=128 if on_tpu else 48,
+        interactive_frac=0.5,
+        session_turns=2,
+    )
+
+    from flexflow_tpu.obs.metrics import read_metrics
+
+    def _pctl(vals, q):
+        vals = sorted(vals)
+        idx = (len(vals) - 1) * q / 100.0
+        lo = int(idx)
+        hi = min(lo + 1, len(vals) - 1)
+        return vals[lo] * (1 - (idx - lo)) + vals[hi] * (idx - lo)
+
+    def _arm(td, routing):
+        base = os.path.join(td, f"m_{routing}.jsonl")
+        fr = FleetRouter(
+            model, replicas=3, routing=routing, slots=slots,
+            block_size=16 if on_tpu else 8, sync_every=4,
+            metrics_out=base,
+            fleet_out=os.path.join(td, f"fleet_{routing}.jsonl"),
+        )
+        rep = fr.run(synthetic_requests(spec))
+        toks = {
+            r.id: np.asarray(r.tokens, np.int32)
+            for rp in fr.replicas.values()
+            for r in rp.engine.sched.finished
+        }
+        # per-decode-token observable latency of every decode-bearing
+        # window, pooled across the replicas' streams (r13 convention)
+        tpot = []
+        for name in fr.replicas:
+            for r in read_metrics(f"{base}.{name}"):
+                s = (r.get("metrics") or {}).get("serve")
+                if not s or not s.get("decode_steps"):
+                    continue
+                tpot.append(
+                    (r.get("step_wall_s") or 0.0)
+                    / s["decode_steps"] * 1e3
+                )
+        return fr, rep, toks, tpot
+
+    with tempfile.TemporaryDirectory() as td:
+        fr_p, rep_p, toks_p, tpot_p = _arm(td, "prefix")
+        fr_r, rep_r, toks_r, tpot_r = _arm(td, "round_robin")
+
+    outputs_match = set(toks_p) == set(toks_r) and all(
+        np.array_equal(toks_p[i], toks_r[i]) for i in toks_p
+    )
+    hit_p = rep_p.fleet_prefix_hit_rate
+    hit_r = rep_r.fleet_prefix_hit_rate
+    p99_p = _pctl(tpot_p, 99) if tpot_p else None
+    p99_r = _pctl(tpot_r, 99) if tpot_r else None
+    return {
+        "config": (
+            f"{'mid' if on_tpu else 'tiny'} gpt x3 replicas "
+            f"{spec.n_requests} reqs bursty 4-tenant 2-turn"
+        ),
+        "serve_traffic": spec.identity,
+        "fleet_replicas": 3,
+        "fleet_routing": "prefix",
+        "serve_fleet_prefix_hit_rate": (
+            round(hit_p, 4) if hit_p is not None else None
+        ),
+        "serve_fleet_p99_tpot_ms": (
+            round(p99_p, 4) if p99_p is not None else None
+        ),
+        "rr_prefix_hit_rate": (
+            round(hit_r, 4) if hit_r is not None else None
+        ),
+        "rr_p99_tpot_ms": (
+            round(p99_r, 4) if p99_r is not None else None
+        ),
+        "prefix_wins_hit_rate": (
+            (hit_p or 0.0) > (hit_r or 0.0)
+        ),
+        "prefix_wins_p99_tpot": (
+            p99_p is not None and p99_r is not None and p99_p < p99_r
+        ),
+        "outputs_match": bool(outputs_match),
+        "prefix_routed": rep_p.prefix_routed,
+        "sessions": rep_p.sessions,
+        "spillovers": rep_p.spillovers,
+        "migrations": rep_p.migrations,
+        "routed_prefix_arm": rep_p.routed,
+        "routed_rr_arm": rep_r.routed,
+        "host_syncs_prefix_arm": rep_p.host_syncs,
+        "fleet_windows_prefix_arm": rep_p.windows,
+    }
+
+
 def _serve_paged_attn_ab(on_tpu: bool) -> dict:
     """Paged-attention A/B (ISSUE 14 acceptance, docs/PERF.md "Paged
     decode attention"): the SAME model serves the SAME workload through
@@ -1550,6 +1698,7 @@ def _bench_secondary(on_tpu: bool) -> dict:
         ("serve_prefix_ab", _serve_prefix_ab),
         ("serve_spec_ab", _serve_spec_ab),
         ("serve_disagg_ab", _serve_disagg_ab),
+        ("serve_fleet_ab", _serve_fleet_ab),
         ("serve_paged_attn_ab", _serve_paged_attn_ab),
         ("recovery_ab", _recovery_ab),
     ):
@@ -1779,6 +1928,16 @@ def run_bench(backend: str) -> None:
         "serve_disagg_p99_tpot_ms": None,
         "serve_handoff_ms": None,
         "serve_disagg_split": None,
+        # fleet tier (ISSUE 18, docs/SERVING.md "Fleet tier"): the
+        # 3-replica fleet A/B's pooled prefix hit rate under
+        # prefix-aware routing (higher-is-better gate) and its p99
+        # per-token latency (LOWER-is-better gate), with the fleet
+        # shape as comparable metadata — different replica counts or
+        # policies are different deployments, not regressions
+        "serve_fleet_prefix_hit_rate": None,
+        "serve_fleet_p99_tpot_ms": None,
+        "fleet_replicas": None,
+        "fleet_routing": None,
         # per-request tracing (ISSUE 16, docs/OBSERVABILITY.md): the
         # disagg arm runs traced, and the ffspan/1 stream yields the
         # prefill-pool admission-wait p99 (the TTFT queue leg) and the
@@ -1894,6 +2053,13 @@ def run_bench(backend: str) -> None:
     record["serve_handoff_observed_ms"] = dab.get(
         "serve_handoff_observed_ms"
     )
+    fab = record["secondary"].get("serve_fleet_ab") or {}
+    record["serve_fleet_prefix_hit_rate"] = fab.get(
+        "serve_fleet_prefix_hit_rate"
+    )
+    record["serve_fleet_p99_tpot_ms"] = fab.get("serve_fleet_p99_tpot_ms")
+    record["fleet_replicas"] = fab.get("fleet_replicas")
+    record["fleet_routing"] = fab.get("fleet_routing")
     qab = record["secondary"].get("serve_paged_attn_ab") or {}
     record["serve_paged_attn_peak_mb"] = qab.get("serve_paged_attn_peak_mb")
     record["serve_attn"] = qab.get("serve_attn")

@@ -230,6 +230,9 @@ class FFConfig:
     serve_slo_policy: Optional[str] = None  # SLOPolicy JSON file
     serve_alerts_out: Optional[str] = None  # ffalert/1 fire/resolve JSONL
     serve_status_port: int = 0  # /healthz /statusz /spanz /metricz (0 = off)
+    # --- fleet tier (docs/SERVING.md "Fleet tier") ---
+    serve_replicas: int = 1  # replica engines behind the fleet router
+    serve_routing: str = "prefix"  # prefix | round_robin | least_loaded
 
     def __post_init__(self) -> None:
         self._devices = None
@@ -445,6 +448,10 @@ class FFConfig:
                 self.serve_alerts_out = take()
             elif a == "--serve-status-port":
                 self.serve_status_port = int(take())
+            elif a == "--serve-replicas":
+                self.serve_replicas = int(take())
+            elif a == "--serve-routing":
+                self.serve_routing = take()
             else:
                 rest.append(a)
             i += 1

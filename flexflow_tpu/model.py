@@ -963,6 +963,10 @@ class FFModel:
                             if cfg.serve_spec_draft_layers > 0
                             else 0.5
                         ),
+                        # fleet axes (serve/fleet.py): priced only when
+                        # --serve-replicas > 1
+                        replicas=cfg.serve_replicas,
+                        routing=cfg.serve_routing,
                     )
                 strategy = unity_search(
                     self.layers,

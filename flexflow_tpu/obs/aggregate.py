@@ -180,12 +180,22 @@ class MetricsAggregator:
         tps = record.get("tokens_per_s") or 0.0
         if wall and tps:
             tokens = int(round(tps * wall))
+        lat: Dict[str, list] = {k: [] for k in _LATENCY_KEYS}
+        for f in serve.get("finished", ()):
+            st["finished"] += 1
+            self.requests_finished += 1
+            for k in _LATENCY_KEYS:
+                v = f.get(k)
+                if v is not None:
+                    lat[k].append(float(v))
+                    self.sketches[k].add(float(v))
         st["recent"].append(
             {
                 "queue_depth": serve.get("queue_depth"),
                 "occupancy": serve.get("occupancy"),
                 "tokens": tokens,
                 "wall_s": wall,
+                "lat": lat,
             }
         )
         st["phase"] = serve.get("phase", st["phase"])
@@ -196,13 +206,6 @@ class MetricsAggregator:
         if serve.get("prefix_hit_rate") is not None:
             st["prefix_hit_rate"] = serve["prefix_hit_rate"]
         st["new_tokens"] += tokens
-        for f in serve.get("finished", ()):
-            st["finished"] += 1
-            self.requests_finished += 1
-            for k in _LATENCY_KEYS:
-                v = f.get(k)
-                if v is not None:
-                    self.sketches[k].add(float(v))
 
     def ingest_stream(self, source: str, path: str) -> int:
         """Read a whole (possibly rotated) stream file into the rollup;
@@ -225,12 +228,32 @@ class MetricsAggregator:
             n += 1
         return n
 
+    def remove_source(self, name: str) -> bool:
+        """Forget a source's per-source state (drained/retired replica,
+        PR 18): its stale queue-depth/occupancy gauges stop feeding the
+        fleet sums that :func:`~flexflow_tpu.obs.slo.scaling_recommendation`
+        reads, so a scaled-down replica cannot hold the fleet in
+        ``scale_up`` forever.  The cumulative latency sketches and
+        finished-request counters are fleet HISTORY, not per-source
+        gauges — they deliberately survive (requests the replica served
+        really happened).  Returns whether the source existed."""
+        return self._src.pop(name, None) is not None
+
     # --- rollups ------------------------------------------------------
     def aggregate_report(self) -> Dict[str, Any]:
         """The fleet rollup: per-source gauges over the rolling window
         plus fleet-wide sums/means and sketch percentiles — the signal
-        ROADMAP #2's autoscaler scales replica counts on."""
+        ROADMAP #2's autoscaler scales replica counts on.
+
+        Latency ships in two views: cumulative sketch percentiles
+        (``ttft_p99_ms`` — fleet history, survives ``remove_source``)
+        and recent-window percentiles over the rolling deques
+        (``ttft_p99_ms_w`` — what the fleet looks like NOW, the view
+        :func:`~flexflow_tpu.obs.slo.scaling_recommendation` prefers:
+        a drained burst's tail must not hold the autoscaler in
+        ``scale_up`` forever)."""
         sources: Dict[str, Any] = {}
+        recent_lat: Dict[str, list] = {k: [] for k in _LATENCY_KEYS}
         for name, st in sorted(self._src.items()):
             recent = [r for r in st["recent"]]
             occ = [r["occupancy"] for r in recent if r["occupancy"] is not None]
@@ -238,6 +261,9 @@ class MetricsAggregator:
                   if r["queue_depth"] is not None]
             w_tok = sum(r["tokens"] for r in recent)
             w_wall = sum(r["wall_s"] for r in recent)
+            for r in recent:
+                for k, vs in (r.get("lat") or {}).items():
+                    recent_lat[k].extend(vs)
             sources[name] = {
                 "windows": st["windows"],
                 "phase": st["phase"],
@@ -267,6 +293,11 @@ class MetricsAggregator:
             base = k[:-3]  # "ttft_ms" -> "ttft"
             fleet[f"{base}_p50_ms"] = sk.quantile(50.0) if sk.count else None
             fleet[f"{base}_p99_ms"] = sk.quantile(99.0) if sk.count else None
+            vals = sorted(recent_lat[k])
+            fleet[f"{base}_p99_ms_w"] = (
+                vals[min(len(vals) - 1, int(round(0.99 * (len(vals) - 1))))]
+                if vals else None
+            )
         return {"sources": sources, "fleet": fleet}
 
     # --- ffagg/1 snapshot ---------------------------------------------

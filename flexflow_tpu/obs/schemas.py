@@ -64,6 +64,10 @@ SCHEMAS: Dict[str, Tuple[str, str]] = {
         "flexflow_tpu/obs/slo.py",
         "SLO burn-rate alert fire/resolve JSONL (--serve-alerts-out)",
     ),
+    "fffleet/1": (
+        "flexflow_tpu/serve/fleet.py",
+        "fleet router/autoscaler decision JSONL (--fleet-out)",
+    ),
 }
 
 # matches a schema tag wherever it appears in source — string literal,

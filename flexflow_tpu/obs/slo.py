@@ -381,6 +381,14 @@ def scaling_recommendation(
         queues: a replica can drain via the SIGTERM path
       * ``scale_down`` — one source, low occupancy, empty queues
       * ``hold``      — within targets, or no serve signal to act on
+
+    Latency percentiles prefer the recent-window view
+    (``ttft_p99_ms_w``) over the cumulative sketch when the rollup
+    carries it, and a latency breach only argues for ``scale_up`` with
+    demand to corroborate it (queue non-empty, or occupancy >= 0.5):
+    a cumulative p99 keeps a drained burst's tail forever, and adding
+    replicas to an idle fleet cannot improve it — without that gate
+    the closed loop can never scale back down after one overload.
     """
     fleet = (aggregate_report or {}).get("fleet") or {}
     n_src = int(fleet.get("sources") or 0)
@@ -399,17 +407,24 @@ def scaling_recommendation(
                 f"{policy.max_queue_depth}"
             ),
         }
+    busy = (qd is not None and qd > 0) or (occ is not None and occ >= 0.5)
+    stale_tail = None
     for key, target in (
         ("ttft_p99_ms", policy.ttft_p99_ms),
         ("tpot_p99_ms", policy.tpot_p99_ms),
     ):
-        v = fleet.get(key)
+        windowed = f"{key}_w" in fleet
+        v = fleet[f"{key}_w"] if windowed else fleet.get(key)
         if v is not None and v > target:
+            if not busy:
+                stale_tail = stale_tail or key
+                continue
+            view = "recent-window " if windowed else ""
             return {
                 "action": "scale_up",
                 "reason": (
-                    f"fleet {key} {v:.1f} ms exceeds policy target "
-                    f"{target:g} ms"
+                    f"fleet {view}{key} {v:.1f} ms exceeds policy "
+                    f"target {target:g} ms"
                 ),
             }
     if occ is not None and (qd is None or qd == 0):
@@ -429,6 +444,15 @@ def scaling_recommendation(
                     f"capacity exceeds demand"
                 ),
             }
+    if stale_tail is not None:
+        return {
+            "action": "hold",
+            "reason": (
+                f"fleet {stale_tail} over target but queues are empty "
+                f"and occupancy is low — a latency tail without demand "
+                f"is history, not a capacity gap"
+            ),
+        }
     return {"action": "hold", "reason": "fleet within SLO targets"}
 
 

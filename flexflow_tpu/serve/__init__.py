@@ -29,10 +29,21 @@ compiled decode path (:mod:`flexflow_tpu.models.gpt_decode`):
   a split-pool cluster whose prefill and decode engines run on
   disjoint submeshes, handing KV across a priced, digest-checked
   ``ffkv/1`` transport.
+* :mod:`flexflow_tpu.serve.fleet` — the fleet tier: a
+  prefix-cache-aware router over N replica engines with session
+  affinity, live replica→replica KV migration, SLO-tiered spillover,
+  and a closed-loop autoscaler driven by the fleet's own ``ffmetrics``
+  rollup (decisions on the ``fffleet/1`` stream).
 """
 
 from flexflow_tpu.serve.disagg import DisaggregatedCluster, DisaggReport
 from flexflow_tpu.serve.engine import ServeEngine, ServeReport
+from flexflow_tpu.serve.fleet import (
+    FleetAutoscaler,
+    FleetReport,
+    FleetRouter,
+    read_fleet,
+)
 from flexflow_tpu.serve.kvcache import KVCacheOOM, PagedKVCache
 from flexflow_tpu.serve.objective import ServeObjective, ServeSpec
 from flexflow_tpu.serve.scheduler import (
@@ -72,6 +83,10 @@ __all__ = [
     "multi_tenant_requests",
     "DisaggregatedCluster",
     "DisaggReport",
+    "FleetRouter",
+    "FleetAutoscaler",
+    "FleetReport",
+    "read_fleet",
     "Transport",
     "InProcessTransport",
     "TransportFull",

@@ -220,6 +220,7 @@ class DisaggregatedCluster:
                 "tenant": req.tenant,
                 "tier": req.tier,
                 "deadline_ms": req.deadline_ms,
+                "session": req.session,
                 "preemptions": int(req.preemptions),
                 "tokens": list(req.tokens),
                 "kv_spill": kv,
@@ -294,6 +295,7 @@ class DisaggregatedCluster:
             tenant=d.get("tenant", "default"),
             tier=d.get("tier", "batch"),
             deadline_ms=d.get("deadline_ms"),
+            session=d.get("session"),
         )
         req.tokens = [int(t) for t in d.get("tokens", ())]
         req.preemptions = int(d.get("preemptions", 0))

@@ -35,6 +35,16 @@ shape the split-pool topology exists for).  The summary line then
 carries the migration/handoff facts (``migrated``, ``handoff_p99_ms``,
 ``split``).
 
+Fleet tier (docs/SERVING.md "Fleet tier"): ``--serve-replicas N`` (N>1)
+serves through a :class:`~flexflow_tpu.serve.fleet.FleetRouter` over N
+replica engines — ``--serve-routing prefix|round_robin|least_loaded``
+picks the placement policy, ``--session-turns K`` makes the synthetic
+traffic multi-turn (session affinity + live KV migration traffic),
+``--fleet-out F`` records every routing/migration/scaling decision as
+an ``fffleet/1`` JSONL stream (``tools/serve_report.py --fleet F``),
+and ``--fleet-autoscale`` closes the loop: the router tails its own
+fleet metrics rollup and adds/drains replicas per the SLO policy.
+
 Resilience (docs/RESILIENCE.md): ``--deadline-ms D`` stamps every
 synthetic request with a queue deadline (expired requests are rejected
 truthfully and counted); ``--serve-drain-file F`` + SIGTERM drains
@@ -71,6 +81,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         traffic_seed=0, tenants=1, shared_prefix=0, interactive_frac=0.0,
         deadline_ms=0.0, resume_drain=None,
         disagg=False, disagg_decode_slots=0, burst_factor=1.0,
+        session_turns=1, fleet_out=None, fleet_autoscale=False,
     )
     i = 0
     while i < len(rest):
@@ -119,6 +130,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             opts["disagg_decode_slots"] = int(take())
         elif a == "--burst-factor":
             opts["burst_factor"] = float(take())
+        elif a == "--session-turns":
+            opts["session_turns"] = int(take())
+        elif a == "--fleet-out":
+            opts["fleet_out"] = take()
+        elif a == "--fleet-autoscale":
+            opts["fleet_autoscale"] = True
         elif a in ("-h", "--help"):
             print(__doc__, file=sys.stderr)
             return 0
@@ -130,6 +147,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     if opts["disagg"] and opts["resume_drain"]:
         print("--serve: --resume-drain is a colocated-engine flag "
               "(incompatible with --disagg)", file=sys.stderr)
+        return 2
+    fleet = cfg.serve_replicas > 1
+    if fleet and opts["disagg"]:
+        print("--serve: --serve-replicas > 1 replicates whole engines "
+              "(incompatible with --disagg; each replica is colocated)",
+              file=sys.stderr)
+        return 2
+    if fleet and opts["resume_drain"]:
+        print("--serve: --resume-drain is a single-engine flag "
+              "(incompatible with --serve-replicas > 1)", file=sys.stderr)
         return 2
 
     # --- SLO ops plane (docs/OBSERVABILITY.md "SLOs, alerts, and live
@@ -187,7 +214,34 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     model.compile(seed=cfg.rng_seed)
 
-    if opts["disagg"]:
+    if fleet:
+        from flexflow_tpu.serve import FleetRouter
+
+        machine = None
+        if cfg.machine_model_file:
+            from flexflow_tpu.parallel.network import load_machine_model
+
+            machine = load_machine_model(cfg.machine_model_file)
+        engine = FleetRouter(
+            model,
+            replicas=cfg.serve_replicas,
+            routing=cfg.serve_routing,
+            slots=slots,
+            block_size=cfg.serve_block_size,
+            num_blocks=cfg.serve_num_blocks or None,
+            prefill_chunk=cfg.serve_prefill_chunk,
+            sync_every=cfg.serve_sync_every,
+            metrics_out=cfg.metrics_out,
+            fleet_out=opts["fleet_out"],
+            prefix_sharing=cfg.serve_prefix_sharing,
+            slo_ms=cfg.serve_slo_ms,
+            attn=cfg.serve_attn,
+            machine=machine,
+            metrics_max_mb=cfg.metrics_max_mb,
+            slo=slo,
+            autoscale=opts["fleet_autoscale"],
+        )
+    elif opts["disagg"]:
         from flexflow_tpu.serve import DisaggregatedCluster
 
         machine = None
@@ -246,6 +300,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         tenants=opts["tenants"], shared_prefix=opts["shared_prefix"],
         interactive_frac=opts["interactive_frac"],
         burst_factor=opts["burst_factor"],
+        session_turns=opts["session_turns"],
     )
     # clamp generated budgets to the compiled position range
     reqs = synthetic_requests(spec)
@@ -263,13 +318,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     if status is not None:
         status.attach(
-            engine, slo=slo,
+            # the fleet's first replica stands in for /statusz — the
+            # status server introspects one engine's scheduler
+            (next(iter(engine.replicas.values())).engine
+             if fleet else engine),
+            slo=slo,
             metrics_path=cfg.metrics_out,
             spans_path=cfg.serve_spans_out,
             meta={
                 "traffic": spec.identity,
                 "model": model_desc,
                 "disagg": opts["disagg"],
+                "fleet": (
+                    {"replicas": cfg.serve_replicas,
+                     "routing": cfg.serve_routing}
+                    if fleet else None
+                ),
                 "strategy": {
                     "grad_overlap": model.strategy.grad_overlap,
                     "pipeline": model.strategy.pipeline is not None,
@@ -288,27 +352,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         if slo is not None:
             slo.close()
 
+    if fleet:
+        # the summary's geometry fields come from any replica (they are
+        # identical by construction — one KV geometry fleet-wide)
+        geo = next(iter(engine.replicas.values())).engine
+    elif opts["disagg"]:
+        geo = engine.decode
+    else:
+        geo = engine
     out = {
         "metric": "serve_demo",
         "serve_traffic": spec.identity,
         "model": model_desc,
         "slots": slots,
-        "block_size": (
-            engine.decode.kv.block_size if opts["disagg"]
-            else engine.kv.block_size
-        ),
-        "num_blocks": (
-            engine.decode.kv.num_blocks if opts["disagg"]
-            else engine.kv.num_blocks
-        ),
-        "sync_every": (
-            engine.decode.sync_every if opts["disagg"]
-            else engine.sync_every
-        ),
-        "attn_kernel": (
-            engine.decode.attn_kernel if opts["disagg"]
-            else engine.attn_kernel
-        ),
+        "block_size": geo.kv.block_size,
+        "num_blocks": geo.kv.num_blocks,
+        "sync_every": geo.sync_every,
+        "attn_kernel": geo.attn_kernel,
         **report.to_dict(),
     }
     sp = getattr(model.strategy, "serve_price", None)
@@ -326,7 +386,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         # the autoscaler signal (ROADMAP #2), from the recorded stream
         # when there is one (per-window fleet view) else from the run
         # report (end-of-run view — queue drained by definition)
-        if cfg.metrics_out:
+        if fleet:
+            # the router already aggregated every replica's windows
+            fleet_report = engine.agg.aggregate_report()
+        elif cfg.metrics_out:
             from flexflow_tpu.obs.metrics import read_metrics
 
             agg = MetricsAggregator()

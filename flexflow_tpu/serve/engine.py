@@ -688,12 +688,13 @@ class ServeEngine:
         tenant: str = "default",
         tier: str = "batch",
         deadline_ms: Optional[float] = None,
+        session: Optional[str] = None,
     ) -> Request:
         req = Request(
             prompt=prompt, max_new_tokens=max_new_tokens, id=req_id,
             eos_id=eos_id if eos_id is not None else self.eos_id,
             arrival_s=arrival_s, tenant=tenant, tier=tier,
-            deadline_ms=deadline_ms,
+            deadline_ms=deadline_ms, session=session,
         )
         # a budget past the compiled position range / pool size comes
         # back REJECTED with a reason (graceful, never a crash)
@@ -867,6 +868,7 @@ class ServeEngine:
                     "tenant": r.tenant,
                     "tier": r.tier,
                     "deadline_ms": r.deadline_ms,
+                    "session": r.session,
                     "preemptions": int(r.preemptions),
                     "tokens": list(r.tokens),
                     "kv_spill": r.kv_spill,
@@ -895,6 +897,7 @@ class ServeEngine:
                 tenant=d.get("tenant", "default"),
                 tier=d.get("tier", "batch"),
                 deadline_ms=d.get("deadline_ms"),
+                session=d.get("session"),
             )
             req.tokens = [int(t) for t in d.get("tokens", ())]
             req.preemptions = int(d.get("preemptions", 0))

@@ -68,6 +68,17 @@ class ServeSpec:
     # KV handoff priced on the DCN) and attaches the best split as
     # ``serve_price["disagg"]``
     disagg: bool = False
+    # fleet arm (PR 18, serve/fleet.py): ``replicas > 1`` prices N
+    # independent copies of the placement behind a router.  Throughput
+    # scales by N, but a routing policy that ignores prefix residency
+    # forfeits cross-request KV reuse — the ``routing`` axis prices
+    # that: "prefix" keeps the single-replica hit economics, the
+    # baselines dilute the shareable-prefix hit probability by 1/N
+    # (a repeat lands on the replica holding its blocks 1/N of the
+    # time).  Defaults (replicas=1) keep the price dict byte-identical
+    # to pre-fleet records.
+    replicas: int = 1
+    routing: str = "prefix"  # prefix | round_robin | least_loaded
 
 
 class ServeObjective:
@@ -140,7 +151,32 @@ class ServeObjective:
         cost = 1.0 / tok_s
         if not feasible:
             cost *= 1.0 + 9.0 * (p99_ms / self.spec.slo_p99_ms - 1.0)
-        return {
+        # fleet arm: N replicas multiply throughput; the routing axis
+        # prices the prefix-reuse economics (ServeSpec.replicas docs).
+        # replicas == 1 skips the block entirely — the returned dict
+        # stays byte-identical to pre-fleet records.
+        fleet_price = None
+        if self.spec.replicas > 1:
+            r = int(self.spec.replicas)
+            hit_frac = (
+                1.0 if self.spec.routing == "prefix" else 1.0 / r
+            )
+            # a lost prefix hit re-pays the shareable prefill — the tax
+            # matches the single-replica prefix-sharing benefit the A/B
+            # measures (~15% of tokens on the shared-prefix shape)
+            miss_tax = 0.15 * (1.0 - hit_frac)
+            fleet_tok_s = tok_s * r * (1.0 - miss_tax)
+            fleet_price = {
+                "replicas": r,
+                "routing": self.spec.routing,
+                "routing_hit_frac": hit_frac,
+                "miss_tax": miss_tax,
+                "fleet_tok_s": fleet_tok_s,
+            }
+            # per-token window latency is per-replica and unchanged by
+            # fanout; only the throughput term of the cost scales
+            cost /= r * (1.0 - miss_tax)
+        out = {
             "objective": "serve",
             "cost": cost,
             "tok_s": tok_s,
@@ -159,3 +195,6 @@ class ServeObjective:
                 k: d[k] for k in ("mem_s", "flops_s", "coll_s")
             },
         }
+        if fleet_price is not None:
+            out["fleet"] = fleet_price
+        return out

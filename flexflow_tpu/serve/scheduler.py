@@ -84,6 +84,10 @@ class Request:
     # submit is expired with a truthful reason instead of waiting
     # forever (docs/RESILIENCE.md).  None = wait indefinitely.
     deadline_ms: Optional[float] = None
+    # multi-turn session id (fleet.py): follow-up turns reuse it so the
+    # router keeps the session on the replica holding its KV.  None =
+    # sessionless (every pre-fleet workload), which changes nothing.
+    session: Optional[str] = None
 
     # --- filled in by the scheduler/engine ---
     state: RequestState = RequestState.QUEUED

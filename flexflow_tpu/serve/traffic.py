@@ -20,6 +20,16 @@ at least one when positive) as the latency tier.  All of it is seeded
 and identity-stamped; the default values keep ``identity`` byte-equal
 to the single-tenant string older records pinned.
 
+**Multi-turn sessions (PR 18).**  ``session_turns > 1`` groups each
+tenant's consecutive requests into sessions of that many turns: a
+follow-up turn reuses the session id and EXTENDS the previous turn's
+prompt (old prompt + a fresh tail), so successive turns share all their
+leading blocks — the shape session affinity and prefix-aware fleet
+routing exist for (fleet.py).  The per-request draw sequence (arrival,
+plen, gen, tail) is unchanged, only the prompt concatenation and the
+``Request.session`` label differ, and the default ``session_turns=1``
+leaves streams and identity strings byte-identical.
+
 **Bursty arrivals (PR 13).**  Real traffic is not Poisson — it clumps.
 ``burst_factor > 1`` Markov-modulates the arrival process between an ON
 state (rate x burst_factor) and an OFF state (rate / burst_factor),
@@ -94,6 +104,10 @@ class TrafficSpec:
     # Markov-modulated on/off burstiness (1.0 = plain Poisson; only
     # meaningful when rate_rps > 0)
     burst_factor: float = 1.0
+    # multi-turn sessions (PR 18): consecutive requests of one tenant
+    # group into sessions of this many turns; follow-up turns extend
+    # the previous prompt and reuse the session id (1 = sessionless)
+    session_turns: int = 1
 
     @property
     def identity(self) -> str:
@@ -113,6 +127,8 @@ class TrafficSpec:
             )
         if self.burst_factor != 1.0:
             s += f"/b{self.burst_factor:g}"
+        if self.session_turns != 1:
+            s += f"/st{self.session_turns}"
         return s
 
 
@@ -120,7 +136,8 @@ def synthetic_requests(spec: TrafficSpec) -> List[Request]:
     """Deterministic workload for ``spec`` (same spec -> same token
     streams and arrival times, any process).  Specs with tenant fields
     route through :func:`multi_tenant_requests`."""
-    if spec.tenants != 1 or spec.shared_prefix or spec.interactive_frac:
+    if (spec.tenants != 1 or spec.shared_prefix or spec.interactive_frac
+            or spec.session_turns != 1):
         return multi_tenant_requests(spec)
     rng = np.random.default_rng(spec.seed)
     clock = _ArrivalClock(spec, rng)
@@ -156,16 +173,32 @@ def multi_tenant_requests(spec: TrafficSpec) -> List[Request]:
     ]
     clock = _ArrivalClock(spec, rng)
     out: List[Request] = []
+    turns = max(1, int(spec.session_turns))
+    n_turn = [0] * nt  # per-tenant turn counter
+    prev_prompt: List[np.ndarray] = [p for p in sys_prompts]
     for i in range(spec.n_requests):
+        # the per-request draw sequence (t, plen, gen, tail) is
+        # identical with sessions on or off — only the prompt
+        # concatenation below differs
         t = clock.next()
         j = i % nt
         plen = int(rng.integers(spec.prompt_len[0], spec.prompt_len[1] + 1))
         gen = int(rng.integers(spec.max_new[0], spec.max_new[1] + 1))
         tail = rng.integers(0, spec.vocab, size=(plen,)).astype(np.int32)
-        prompt = np.concatenate([sys_prompts[j], tail])
+        session = None
+        if turns > 1:
+            s_idx, turn = divmod(n_turn[j], turns)
+            session = f"tenant{j}:s{s_idx}"
+            base = sys_prompts[j] if turn == 0 else prev_prompt[j]
+            prompt = np.concatenate([base, tail])
+            prev_prompt[j] = prompt
+            n_turn[j] += 1
+        else:
+            prompt = np.concatenate([sys_prompts[j], tail])
         out.append(Request(
             prompt=prompt, max_new_tokens=gen, id=i, arrival_s=t,
             tenant=f"tenant{j}",
             tier="interactive" if j < n_inter else "batch",
+            session=session,
         ))
     return out

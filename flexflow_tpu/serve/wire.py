@@ -48,7 +48,7 @@ KV_SCHEMA = "ffkv/1"
 # kept exact so old drain files and new ones stay interchangeable)
 _META_KEYS = (
     "id", "max_new_tokens", "eos_id", "tenant", "tier", "deadline_ms",
-    "preemptions",
+    "preemptions", "session",
 )
 # latency bookkeeping that crosses the pool boundary with the request
 # (floats in the manifest; absent on drain payloads, which resume on
@@ -97,6 +97,9 @@ def flatten_requests(
             "tier": r.get("tier", "batch"),
             "deadline_ms": r.get("deadline_ms"),
             "preemptions": int(r.get("preemptions", 0)),
+            # session id crosses replicas with the KV (fleet migration);
+            # additive — old frames read it back as None via .get
+            "session": r.get("session"),
             "kv_length": int(kv["length"]) if kv is not None else None,
         }
         for key in _TIMING_KEYS:

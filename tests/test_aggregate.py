@@ -172,6 +172,31 @@ def test_aggregator_rolling_window_bounds_state(tmp_path):
     assert src["queue_depth"] == 49
 
 
+def test_aggregator_windowed_latency_ages_out_burst_tail():
+    """r18: the fleet carries two latency views — the cumulative sketch
+    (history) and the rolling-window percentile the autoscaler reads; a
+    drained burst's tail must leave the windowed view."""
+    agg = MetricsAggregator(window=4)
+
+    def win(qd, fin):
+        return {"metrics": {"serve": {
+            "queue_depth": qd, "occupancy": 0.5,
+            "finished": [{"ttft_ms": v, "tpot_ms": v / 10} for v in fin],
+        }}, "step_wall_s": 0.01, "tokens_per_s": 0.0}
+
+    agg.ingest("x", win(8, [900.0, 950.0]))  # the burst tail
+    fleet = agg.aggregate_report()["fleet"]
+    assert fleet["ttft_p99_ms_w"] == pytest.approx(950.0)
+    for _ in range(4):  # quiet windows push the burst out of the deque
+        agg.ingest("x", win(0, [10.0]))
+    fleet = agg.aggregate_report()["fleet"]
+    assert fleet["ttft_p99_ms_w"] == pytest.approx(10.0)
+    assert fleet["tpot_p99_ms_w"] == pytest.approx(1.0)
+    # the cumulative sketch keeps the history (sketch quantile
+    # convention lands on the burst bucket, not the exact sample)
+    assert fleet["ttft_p99_ms"] > 800.0
+
+
 def test_aggregator_ignores_training_records(tmp_path):
     agg = MetricsAggregator()
     agg.ingest("train", step_record(0, 0.0, loss=1.0))

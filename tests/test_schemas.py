@@ -296,6 +296,39 @@ def _rt_ffalert(tmp_path):
     assert len(read_alerts(path)) == len(out) + 1
 
 
+def _rt_fffleet(tmp_path):
+    # the fleet decision stream reuses the ffmetrics JSONL writer, so
+    # strict-JSON and torn-tail policies are inherited; what this pins
+    # is the reader's schema filter (foreign records skipped, not
+    # crashed on) and old-record interop for future event fields
+    from flexflow_tpu.obs.metrics import MetricsStream
+    from flexflow_tpu.serve.fleet import FLEET_SCHEMA, read_fleet
+
+    assert FLEET_SCHEMA == "fffleet/1"
+    path = str(tmp_path / "fleet.jsonl")
+    s = MetricsStream(path)
+    s.append({"schema": FLEET_SCHEMA, "event": "route", "t": 0.1,
+              "request": 0, "replica": "replica0", "policy": "prefix",
+              "reason": "prefix_hit:3", "session": None})
+    s.append({"schema": "ffmetrics/1", "step": 0})  # foreign record
+    s.append({"schema": FLEET_SCHEMA, "event": "scale_up", "t": 0.2,
+              "replica": "replica1", "reason": "queue depth 70 over"})
+    s.close()
+    out = read_fleet(path)
+    assert [e["event"] for e in out] == ["route", "scale_up"]
+    assert out[0]["reason"] == "prefix_hit:3"
+    assert out[0]["session"] is None
+    # old-record interop: unknown event fields carried, not fatal
+    with open(path, "a") as f:
+        f.write(json.dumps({"schema": "fffleet/1", "event": "route",
+                            "t": 0.3, "future_key": 7}) + "\n")
+    assert read_fleet(path)[-1]["future_key"] == 7
+    # torn tail tolerated, same as every JSONL stream
+    with open(path, "a") as f:
+        f.write('{"schema": "fffleet/1", "event"')
+    assert len(read_fleet(path)) == 3
+
+
 _ROUNDTRIPS = {
     "ffmetrics/1": _rt_ffmetrics,
     "ffspan/1": _rt_ffspan,
@@ -307,6 +340,7 @@ _ROUNDTRIPS = {
     "ffdrain/1": _rt_ffdrain,
     "ffcheck/1": _rt_ffcheck,
     "ffalert/1": _rt_ffalert,
+    "fffleet/1": _rt_fffleet,
 }
 
 

@@ -237,6 +237,23 @@ def test_scaling_recommendation_decision_table():
         _fleet(occupancy_mean=0.05, queue_depth=2), pol)
     assert r["action"] == "hold"
     assert scaling_recommendation(_fleet(), pol)["action"] == "hold"
+    # r18: a latency tail over target with empty queues and low
+    # occupancy is history, not a capacity gap — truthful hold, and
+    # the fleet is free to shrink once occupancy falls further
+    r = scaling_recommendation(
+        _fleet(ttft_p99_ms=900.0, occupancy_mean=0.35), pol)
+    assert r["action"] == "hold" and "history" in r["reason"]
+    r = scaling_recommendation(
+        _fleet(ttft_p99_ms=900.0, occupancy_mean=0.2), pol)
+    assert r["action"] == "scale_down"
+    # the recent-window percentile is preferred over the cumulative
+    # sketch when the rollup carries it
+    r = scaling_recommendation(
+        _fleet(ttft_p99_ms=900.0, ttft_p99_ms_w=100.0), pol)
+    assert r["action"] == "hold"
+    r = scaling_recommendation(
+        _fleet(ttft_p99_ms=100.0, ttft_p99_ms_w=900.0), pol)
+    assert r["action"] == "scale_up" and "recent-window" in r["reason"]
 
 
 def test_fleet_from_serve_report_feeds_scaling():

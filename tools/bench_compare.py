@@ -118,6 +118,13 @@ COMPARABLE_METADATA = (
     # smoke box, so both surface for drift visibility, never gated
     "serve_slo_availability",
     "serve_alerts_fired",
+    # fleet_replicas / fleet_routing (r18, docs/SERVING.md "Fleet
+    # tier"): the fleet A/B's replica count and winning routing policy
+    # — runs at different fleet shapes are the same experiment, but the
+    # gate surfaces the change because both shift pooled hit rate and
+    # p99 for configuration (not regression) reasons
+    "fleet_replicas",
+    "fleet_routing",
 )
 
 # (label, path into the record, higher_is_better) — the gated metrics.
@@ -169,6 +176,18 @@ GATED = (
     # fraction drift), the search-quality regression the ring axis
     # exists to prevent
     ("exposed_comm_frac", ("exposed_comm_frac",), False),
+    # serve_fleet_prefix_hit_rate (r18, docs/SERVING.md "Fleet tier")
+    # gates higher-is-better: the prefix-routed fleet's POOLED hit rate
+    # (sum hits / sum lookups across replicas) — a drop means the
+    # router stopped placing repeats on the replica holding their
+    # blocks (digest export or scoring regression), which forfeits the
+    # fleet's cross-request KV reuse long before throughput notices
+    ("serve_fleet_prefix_hit_rate", ("serve_fleet_prefix_hit_rate",),
+     True),
+    # serve_fleet_p99_tpot_ms gates LOWER-is-better: the prefix-routed
+    # fleet's p99 per-token latency under the bursty multi-tenant
+    # shape — routing quality must not buy hit rate with tail latency
+    ("serve_fleet_p99_tpot_ms", ("serve_fleet_p99_tpot_ms",), False),
     ("dlrm", ("secondary", "dlrm", "samples_per_sec"), True),
     ("bert_large", ("secondary", "bert_large", "samples_per_sec"), True),
     ("gpt_decode_cached", ("secondary", "gpt_decode", "cached_tok_per_s"), True),

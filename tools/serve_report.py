@@ -4,6 +4,13 @@
 Usage:
     python tools/serve_report.py METRICS.jsonl [--windows N]
     python tools/serve_report.py --timeline SPANS.jsonl [--top N]
+    python tools/serve_report.py --fleet FLEET.jsonl
+
+``--fleet`` reads the ``--fleet-out`` ``fffleet/1`` stream and renders
+the fleet control plane: per-replica offered/finished/hit-rate/
+migration/p99 table plus the scaling-action timeline (docs/SERVING.md
+"Fleet tier").  Streams without fleet records (anything pre-r18)
+render one truthful line instead.
 
 ``--timeline`` reads the ``--serve-spans-out`` ``ffspan/1`` stream
 instead (or additionally) and renders per-request timelines: each
@@ -261,6 +268,107 @@ def render(records: List[Dict], max_windows: int = 30) -> str:
     return "\n\n".join(out)
 
 
+def render_fleet(records: List[Dict]) -> str:
+    """Fleet control-plane report from an ``fffleet/1`` stream
+    (``--fleet-out`` — docs/SERVING.md "Fleet tier"): per-replica
+    routing/migration table plus the scaling-action timeline.  The
+    graceful-absence pattern holds: a stream with no fleet records
+    (every pre-r18 stream) renders one truthful line."""
+    evs = [r for r in records if r.get("schema") == "fffleet/1"]
+    if not evs:
+        return ("fleet (--fleet): no fffleet/1 records in this stream — "
+                "not a fleet run")
+    by_event: Dict[str, List[Dict]] = {}
+    for e in evs:
+        by_event.setdefault(str(e.get("event")), []).append(e)
+    summary = (by_event.get("summary") or [{}])[-1]
+    routes = by_event.get("route", [])
+    delivers = by_event.get("deliver", [])
+    out = [
+        f"fleet run: routing={summary.get('routing', '?')}, "
+        f"{len(routes)} requests routed, "
+        f"{summary.get('migrations', len(delivers))} migrations, "
+        f"{summary.get('spillovers', 0)} spillovers, "
+        f"{summary.get('scale_ups', 0)} scale-ups / "
+        f"{summary.get('scale_downs', 0)} scale-downs"
+        + (
+            f", fleet prefix hit rate "
+            f"{summary['fleet_prefix_hit_rate']:.3f}"
+            if summary.get("fleet_prefix_hit_rate") is not None else ""
+        )
+    ]
+
+    # per-replica table: routing decisions from the event stream,
+    # enriched with the summary's per-replica stats when present
+    names = sorted(
+        {str(e["replica"]) for e in routes if e.get("replica") is not None}
+        | set((summary.get("per_replica") or {}).keys())
+        | {str(e["replica"]) for e in delivers
+           if e.get("replica") is not None}
+    )
+    per = summary.get("per_replica") or {}
+    rows = []
+    for n in names:
+        offered = sum(1 for e in routes if e.get("replica") == n)
+        mig_in = sum(
+            1 for e in delivers
+            if e.get("replica") == n and e.get("admitted")
+        )
+        p = per.get(n, {})
+        hit = p.get("prefix_hit_rate")
+        p99 = p.get("tpot_p99_ms")
+        rows.append([
+            n, offered, p.get("finished", "-"),
+            f"{hit:.3f}" if hit is not None else "-",
+            mig_in,
+            f"{p99:.3f}" if p99 is not None else "-",
+            "yes" if p.get("drained") else "-",
+        ])
+    out.append(
+        "per-replica (offered = routing decisions; migr_in = admitted "
+        "ffkv/1 deliveries):\n"
+        + _table(
+            ["replica", "offered", "done", "hit_rate", "migr_in",
+             "tpot_p99", "drained"],
+            rows,
+        )
+    )
+
+    # scaling-action + lifecycle timeline, in stream order
+    acts = sorted(
+        (
+            e for e in evs
+            if e.get("event") in
+            ("scale_up", "scale_down", "retire", "spillover")
+        ),
+        key=lambda e: e.get("t", 0.0),
+    )
+    if acts:
+        out.append(
+            "scaling actions (autoscaler + SLO-tier spillover, stream "
+            "order):\n"
+            + _table(
+                ["t", "event", "replica", "reason"],
+                [
+                    [
+                        f"{e.get('t', 0.0):.3f}", e["event"],
+                        e.get("replica")
+                        or f"{e.get('src')}→{e.get('dst')}",
+                        str(e.get("reason", "-"))[:60],
+                    ]
+                    for e in acts
+                ],
+            )
+        )
+    bad = [e for e in delivers if not e.get("digest_ok", True)]
+    if bad:
+        out.append(
+            f"WARNING: {len(bad)} delivery frame(s) failed ffkv/1 "
+            "digest verification (rejected, not admitted)"
+        )
+    return "\n\n".join(out)
+
+
 def _ms(span: Dict) -> float:
     return (span["t1"] - span["t0"]) * 1e3
 
@@ -482,9 +590,15 @@ def main(argv=None) -> int:
                     help="SLOPolicy JSON: append the SLO/burn-rate/"
                          "budget section replayed over METRICS "
                          "(tools/slo_report.py is the full CLI)")
+    ap.add_argument("--fleet", default=None, metavar="FLEET",
+                    help="fffleet/1 JSONL written by --fleet-out: "
+                         "render the per-replica routing table and "
+                         "scaling-action timeline")
     args = ap.parse_args(argv)
-    if args.metrics is None and args.timeline is None:
-        ap.error("give a METRICS stream, --timeline SPANS, or both")
+    if args.metrics is None and args.timeline is None \
+            and args.fleet is None:
+        ap.error("give a METRICS stream, --timeline SPANS, "
+                 "--fleet FLEET, or any combination")
     if args.slo is not None and args.metrics is None:
         ap.error("--slo needs a METRICS stream to replay")
     # read_metrics only parses JSONL (no jax import), but the package
@@ -506,6 +620,8 @@ def main(argv=None) -> int:
     if args.timeline is not None:
         parts.append(render_timeline(read_spans(args.timeline),
                                      top=args.top))
+    if args.fleet is not None:
+        parts.append(render_fleet(read_metrics(args.fleet)))
     print("\n\n".join(parts))
     return 0
 

@@ -1586,6 +1586,179 @@ def _serve_paged_attn_ab(on_tpu: bool) -> dict:
     }
 
 
+def _serve_kv_quant_ab(on_tpu: bool) -> dict:
+    """Quantized-KV serving A/B (ISSUE 19 acceptance, docs/SERVING.md
+    "Quantized KV cache and weight-only decode"): the SAME model serves
+    the SAME workload through a full-precision engine and an int8
+    engine (int8 paged KV pool + int8 weight-only decode), and the
+    facts recorded are (1) concurrent sessions per pool at the
+    ADMISSION level — under the SAME HBM byte budget the int8 pool
+    admits >= 1.9x the sessions (``kv_sessions_per_pool_ratio``, from
+    the pools' own ``bytes_per_token``, scale stream included), (2)
+    ffkv/1 handoff frames for the same session are >= 1.9x smaller
+    (``kv_frame_bytes_ratio``, measured on real encode_handoff bytes of
+    a spilled session long enough that npz framing overhead does not
+    flatter the ratio), and (3) the TRUTHFUL greedy-stream divergence
+    count between arms (``divergent_streams`` — quantization is lossy;
+    the tiny smoke shape happens to diverge nowhere, but the number is
+    measured, never asserted zero here).  ``serve_kv_bytes_per_tok``
+    (the int8 arm's per-token pool bytes) is gated lower-is-better by
+    tools/bench_compare.py; ``kv_dtype``/``weight_dtype`` ride as
+    comparable metadata."""
+    import time as _time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.transformer import gpt_decoder
+    from flexflow_tpu.serve import Request, ServeEngine
+    from flexflow_tpu.serve.kvcache import PagedKVCache, quantize_kv
+    from flexflow_tpu.serve.wire import encode_handoff
+
+    slots = 4
+    seq = 512 if on_tpu else 128
+    shape = (
+        dict(hidden=512, heads=8, ff_dim=2048, num_layers=6)
+        if on_tpu
+        else dict(hidden=64, heads=4, ff_dim=128, num_layers=2)
+    )
+    vocab = 32000 if on_tpu else 256
+    block_size = 8
+    n_requests, max_new = 6, 8
+    sess_len = 96  # admission/frame session depth (multiple of BS)
+
+    def build():
+        cfg = FFConfig(
+            batch_size=slots,
+            compute_dtype="bfloat16" if on_tpu else "float32",
+        )
+        model = FFModel(cfg)
+        gpt_decoder(
+            model, slots, seq, vocab=vocab, use_flash=False, **shape
+        )
+        model.compile(seed=0)
+        return model
+
+    def workload():
+        rng = np.random.default_rng(0)
+        return [
+            Request(
+                prompt=rng.integers(
+                    0, vocab, size=(int(rng.integers(4, 14)),)
+                ).astype(np.int32),
+                max_new_tokens=max_new, id=i,
+            )
+            for i in range(n_requests)
+        ]
+
+    arms = {}
+    for label, kvdt, wdt in (
+        ("fp32", "fp32", "fp32"), ("int8", "int8", "int8"),
+    ):
+        engine = ServeEngine(
+            build(), slots=slots, block_size=block_size, sync_every=4,
+            kv_dtype=kvdt, weight_dtype=wdt,
+        )
+        t0 = _time.perf_counter()
+        rep = engine.run(workload())
+        wall = _time.perf_counter() - t0
+        arms[label] = {
+            "rep": rep, "wall": wall,
+            "streams": {
+                r.id: np.asarray(r.tokens, np.int32)
+                for r in engine.sched.finished
+            },
+            "bpt": engine.kv.bytes_per_token,
+        }
+    s_f, s_q = arms["fp32"]["streams"], arms["int8"]["streams"]
+    complete = set(s_f) == set(s_q) == set(range(n_requests))
+    divergent = sum(
+        1 for i in s_f if not np.array_equal(s_f[i], s_q.get(i))
+    )
+
+    # admission: size ONE budget — the fp32 pool provisioned for
+    # ``slots`` sessions of sess_len — then count how many sessions
+    # each arm's per-token bytes fit into it
+    budget = slots * sess_len * arms["fp32"]["bpt"]
+    sessions = {
+        label: int(budget // (sess_len * arms[label]["bpt"]))
+        for label in arms
+    }
+
+    # ffkv/1 frame bytes: restore a synthetic sess_len session into a
+    # pool of each dtype (quantizing host-side for the int8 arm with
+    # the pool's own contract), spill it, and frame the spill exactly
+    # as the disagg/fleet transport would
+    def frame_bytes(kvdt: str) -> int:
+        L, H = shape["num_layers"], shape["heads"]
+        D = shape["hidden"] // shape["heads"]
+        pool = PagedKVCache(
+            L, H, D, slots=1, block_size=block_size,
+            max_seq_len=sess_len, kv_dtype=kvdt,
+        )
+        rng = np.random.default_rng(7)
+        dense = rng.standard_normal(
+            (2, L, H, sess_len, D)
+        ).astype(np.float32)
+        payload = {"length": sess_len, "layers": {}}
+        if pool.quantized:
+            payload["kv_dtype"] = kvdt
+        for i in range(L):
+            d = {}
+            for name, x in (("k", dense[0, i]), ("v", dense[1, i])):
+                if pool.quantized:
+                    # (len, H, D) layout gives the contract's
+                    # per-position scales; back to (H, len, D) on disk
+                    q, s = quantize_kv(
+                        jnp, jnp.asarray(x.transpose(1, 0, 2)), kvdt
+                    )
+                    d[name] = np.asarray(q).transpose(1, 0, 2)
+                    d["s" + name] = np.asarray(s)
+                else:
+                    d[name] = x
+            payload["layers"][f"layer{i}"] = d
+        pool.restore(0, payload, sess_len)
+        spill = pool.spill(0, sess_len)
+        return len(encode_handoff({
+            "id": 0, "prompt": np.zeros((4,), np.int32), "tokens": [],
+            "max_new_tokens": 1, "eos_id": None, "kv_spill": spill,
+        }))
+
+    fb_f, fb_q = frame_bytes("fp32"), frame_bytes("int8")
+    rep_q = arms["int8"]["rep"]
+    return {
+        "config": (
+            f"{'mid' if on_tpu else 'tiny'} gpt sv={seq} bs={block_size} "
+            f"{n_requests} reqs sess={sess_len} int8 kv+weights vs fp32"
+        ),
+        "kv_dtype": "int8",
+        "weight_dtype": "int8",
+        "serve_kv_bytes_per_tok": arms["int8"]["bpt"],
+        "kv_bytes_per_tok_fp32": arms["fp32"]["bpt"],
+        "kv_sessions_per_pool": sessions,
+        "kv_sessions_per_pool_ratio": (
+            round(sessions["int8"] / sessions["fp32"], 4)
+            if sessions["fp32"] else None
+        ),
+        "kv_frame_bytes": {"fp32": fb_f, "int8": fb_q},
+        "kv_frame_bytes_ratio": round(fb_f / fb_q, 4) if fb_q else None,
+        "outputs_complete": bool(complete),
+        "divergent_streams": int(divergent),
+        "serve_tok_s_int8": (
+            round(rep_q.new_tokens / arms["int8"]["wall"], 2)
+            if arms["int8"]["wall"] else None
+        ),
+        "serve_tok_s_fp32": (
+            round(
+                arms["fp32"]["rep"].new_tokens / arms["fp32"]["wall"], 2
+            )
+            if arms["fp32"]["wall"] else None
+        ),
+        "windows": rep_q.windows,
+    }
+
+
 def _recovery_ab(on_tpu: bool) -> dict:
     """Kill-and-resume A/B (ISSUE 12 acceptance): train a tiny model to
     completion (arm A), then re-run it with a deterministic injected
@@ -1700,6 +1873,7 @@ def _bench_secondary(on_tpu: bool) -> dict:
         ("serve_disagg_ab", _serve_disagg_ab),
         ("serve_fleet_ab", _serve_fleet_ab),
         ("serve_paged_attn_ab", _serve_paged_attn_ab),
+        ("serve_kv_quant_ab", _serve_kv_quant_ab),
         ("recovery_ab", _recovery_ab),
     ):
         try:
@@ -1960,6 +2134,14 @@ def run_bench(backend: str) -> None:
         # comparable metadata
         "serve_paged_attn_peak_mb": None,
         "serve_attn": None,
+        # quantized KV serving (ISSUE 19, docs/SERVING.md "Quantized KV
+        # cache and weight-only decode"): the int8 arm's per-token pool
+        # bytes (LOWER-is-better gate — a full-precision pool sneaking
+        # back shows up here first) and the storage dtypes as
+        # comparable metadata
+        "serve_kv_bytes_per_tok": None,
+        "kv_dtype": None,
+        "weight_dtype": None,
         # resilience (ISSUE 12, docs/RESILIENCE.md): checkpoint-restore
         # wall time (LOWER-is-better), the kill-and-resume bit-identity
         # bit (gated AT TRUE), and the injected fault plan (comparable
@@ -2063,6 +2245,10 @@ def run_bench(backend: str) -> None:
     qab = record["secondary"].get("serve_paged_attn_ab") or {}
     record["serve_paged_attn_peak_mb"] = qab.get("serve_paged_attn_peak_mb")
     record["serve_attn"] = qab.get("serve_attn")
+    kvab = record["secondary"].get("serve_kv_quant_ab") or {}
+    record["serve_kv_bytes_per_tok"] = kvab.get("serve_kv_bytes_per_tok")
+    record["kv_dtype"] = kvab.get("kv_dtype")
+    record["weight_dtype"] = kvab.get("weight_dtype")
     rab = record["secondary"].get("recovery_ab") or {}
     record["recovery_s"] = rab.get("recovery_s")
     record["resume_replay_exact"] = rab.get("resume_replay_exact")

@@ -294,23 +294,34 @@ def analyze_serve_engine(
     bt0 = jnp.zeros((B, MB), jnp.int32)
     dt = str(ex.compute_dtype)
     report = AnalysisReport()
+    # quantized pools (r19): the serve programs take the scale pools
+    # alongside the element pools, and int8 weight-only decode swaps
+    # the params arg for the engine's quantized (qparams, scales) tuple
+    # — capture exactly what the engine runs so the kv_quant check sees
+    # the truth
+    pool_args = (kv.cache_k, kv.cache_v) + (
+        (kv.scale_k, kv.scale_v) if kv.quantized else ()
+    )
+    pool_names = ("cache_k", "cache_v") + (
+        ("scale_k", "scale_v") if kv.quantized else ()
+    )
+    params_arg = getattr(engine, "_params_arg", ex.params)
     programs = [
         (
             "serve.decode",
             engine._decode,
-            (ex.params, kv.cache_k, kv.cache_v, z, z, bt0),
-            ("params", "cache_k", "cache_v", "tok", "pos", "block_tables"),
+            (params_arg,) + pool_args + (z, z, bt0),
+            ("params",) + pool_names + ("tok", "pos", "block_tables"),
         ),
         (
             "serve.prefill",
             engine._prefill,
-            (
-                ex.params, kv.cache_k, kv.cache_v,
+            (params_arg,) + pool_args + (
                 jnp.zeros((engine.prefill_chunk,), jnp.int32),
                 jnp.asarray(0, jnp.int32), jnp.asarray(1, jnp.int32),
                 bt0[0],
             ),
-            ("params", "cache_k", "cache_v", "toks", "start", "n_valid",
+            ("params",) + pool_names + ("toks", "start", "n_valid",
              "block_tables"),
         ),
     ]
@@ -318,17 +329,16 @@ def analyze_serve_engine(
         programs.append((
             "serve.draft",
             engine._draft,
-            (ex.params, kv.cache_k, kv.cache_v, z, z, bt0),
-            ("params", "cache_k", "cache_v", "tok", "pos", "block_tables"),
+            (params_arg,) + pool_args + (z, z, bt0),
+            ("params",) + pool_names + ("tok", "pos", "block_tables"),
         ))
         programs.append((
             "serve.verify",
             engine._verify,
-            (
-                ex.params, kv.cache_k, kv.cache_v,
+            (params_arg,) + pool_args + (
                 jnp.zeros((B, engine.spec_k + 1), jnp.int32), z, bt0,
             ),
-            ("params", "cache_k", "cache_v", "toks", "pos0",
+            ("params",) + pool_names + ("toks", "pos0",
              "block_tables"),
         ))
     # pool geometry + the engine's resolved attention kernel ride the
@@ -339,6 +349,12 @@ def analyze_serve_engine(
         "serve_attn": getattr(engine, "attn_kernel", "gather"),
         "max_blocks_per_seq": MB,
         "block_size": kv.block_size,
+        # quantization claims (r19): the kv_quant check cross-examines
+        # these against the captured pool avals — a config that CLAIMS
+        # int8/fp8 KV while lowering a full-precision cache_k is lying
+        # about its HBM footprint
+        "kv_dtype": kv.kv_dtype,
+        "weight_dtype": getattr(engine, "weight_dtype", "fp32"),
     }
     for name, jitted, args, names in programs:
         art = capture_jit(

@@ -294,6 +294,60 @@ def check_paged_attn(artifact: ProgramArtifact) -> List[Violation]:
     return out
 
 
+@register_check("kv_quant")
+def check_kv_quant(artifact: ProgramArtifact) -> List[Violation]:
+    """Structural proof the quantized KV pool actually shrank: a serve
+    program whose details CLAIM ``kv_dtype: "int8"|"fp8"`` (docs/
+    SERVING.md "Quantized KV cache and weight-only decode") must lower
+    its 5-D ``cache_k`` pool input with a 1-byte element type.  A
+    config that claims int8 while the traced pool aval is still
+    float32/bfloat16 prices and reports an HBM footprint it does not
+    have — the exact graft this check exists to catch.
+
+    Total: artifacts without a quantized ``kv_dtype`` claim (fp32/bf16
+    engines, non-serve programs) or without a 5-D ``cache_k`` input all
+    skip.  Prefill is included — it writes the same pool the decode
+    programs read, so a full-precision prefill pool is the same lie."""
+    det = artifact.details or {}
+    if det.get("kv_dtype") not in ("int8", "fp8"):
+        return []
+    if artifact.role not in ("decode", "draft", "verify", "prefill"):
+        return []
+    out: List[Violation] = []
+    for label, shape, dtype, _ in artifact.inputs:
+        if label not in ("cache_k", "cache_v") or len(shape) != 5:
+            continue
+        ds = str(dtype)
+        # ml_dtypes float8 names don't round-trip through np.dtype —
+        # size the aval by name for the 1-byte families
+        if ds == "int8" or "float8" in ds or "uint8" in ds:
+            nbytes = 1
+        else:
+            nbytes = _dtype_bytes(ds)
+        if nbytes > 1:
+            out.append(Violation(
+                check="kv_quant",
+                severity="error",
+                program=artifact.name,
+                message=(
+                    f"program claims kv_dtype "
+                    f"{det.get('kv_dtype')!r} but lowers pool input "
+                    f"{label!r} as {ds} ({nbytes} bytes/elem, shape "
+                    f"{tuple(shape)}) — the full-precision pool "
+                    f"survived, so the claimed HBM/bandwidth savings "
+                    f"are fictional"
+                ),
+                where=f"inputs[{label}]",
+                details={
+                    "claimed_kv_dtype": det.get("kv_dtype"),
+                    "pool_input": label,
+                    "pool_dtype": ds,
+                    "pool_shape": list(shape),
+                },
+            ))
+    return out
+
+
 @register_check("replication")
 def check_replication(artifact: ProgramArtifact) -> List[Violation]:
     """Operands lowered fully replicated when the strategy says sharded:

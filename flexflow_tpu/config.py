@@ -209,6 +209,12 @@ class FFConfig:
     # decode-attention kernel: "auto" = fused Pallas paged attention
     # where it can run (TPU / interpret), dense gather otherwise
     serve_attn: str = "auto"  # auto | gather | paged
+    # quantized serving (docs/SERVING.md "Quantized KV cache and
+    # weight-only decode"): KV pool storage format (per-block symmetric
+    # scales, in-kernel dequant) and decode weight storage format
+    # (per-channel int8, dequantized at the matmul edge)
+    serve_kv_dtype: str = "fp32"  # fp32 | bf16 | int8 | fp8
+    serve_weight_dtype: str = "fp32"  # fp32 | int8
     serve_spec_k: int = 0  # speculative draft depth (0 = off)
     serve_spec_draft_layers: int = 0  # draft slice depth (0 = half)
     serve_spec_accept: float = 0.7  # priced per-draft acceptance prob.
@@ -416,6 +422,10 @@ class FFConfig:
                 )
             elif a == "--serve-attn":
                 self.serve_attn = take()
+            elif a == "--serve-kv-dtype":
+                self.serve_kv_dtype = take()
+            elif a == "--serve-weight-dtype":
+                self.serve_weight_dtype = take()
             elif a == "--serve-spec-k":
                 self.serve_spec_k = int(take())
             elif a == "--serve-spec-draft-layers":

@@ -967,6 +967,10 @@ class FFModel:
                         # --serve-replicas > 1
                         replicas=cfg.serve_replicas,
                         routing=cfg.serve_routing,
+                        # quantized arms (r19): priced only when the
+                        # flags move off fp32
+                        kv_dtype=cfg.serve_kv_dtype,
+                        weight_dtype=cfg.serve_weight_dtype,
                     )
                 strategy = unity_search(
                     self.layers,

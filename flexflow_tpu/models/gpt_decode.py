@@ -101,6 +101,48 @@ def make_cast(jnp, dt):
     return cast
 
 
+def quantize_weights_int8(jnp, params):
+    """Weight-only int8 for the weight-streaming-bound decode roofline
+    (``ServeSpec.weight_dtype`` — docs/SERVING.md): every float leaf
+    with >= 2 axes is stored int8 with a per-output-channel (last axis)
+    symmetric float32 scale; 1-D leaves (biases, layer-norm params) and
+    integer leaves stay as-is with scale 1.  Returns ``(qparams,
+    scales)`` — two trees of identical structure that
+    :func:`dequantize_weights_int8` folds back at the matmul edge, so
+    HBM streams 1-byte elements and the dequant happens in-register."""
+    import numpy as np
+
+    def q(x):
+        xa = np.asarray(x)
+        if xa.ndim < 2 or not np.issubdtype(xa.dtype, np.floating):
+            return x, jnp.asarray(1.0, jnp.float32)
+        xf = xa.astype(np.float32)
+        amax = np.max(np.abs(xf), axis=tuple(range(xa.ndim - 1)))
+        scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+        qx = np.clip(np.round(xf / scale), -127, 127).astype(np.int8)
+        return jnp.asarray(qx), jnp.asarray(scale)
+
+    import jax
+
+    pairs = jax.tree.map(q, params)
+    qparams = jax.tree.map(lambda p: p[0], pairs, is_leaf=lambda p: isinstance(p, tuple))
+    scales = jax.tree.map(lambda p: p[1], pairs, is_leaf=lambda p: isinstance(p, tuple))
+    return qparams, scales
+
+
+def dequantize_weights_int8(jax, jnp, qparams, scales):
+    """The read-side rule of :func:`quantize_weights_int8`: int8 leaves
+    become ``w.astype(f32) * scale`` (scale broadcasts on the last
+    axis); everything else passes through.  Traced inside each serve
+    program, so the lowered HLO reads int8 from HBM and widens next to
+    the consuming matmul."""
+    return jax.tree.map(
+        lambda w, s: w.astype(jnp.float32) * s
+        if w.dtype == jnp.int8 else w,
+        qparams, scales,
+    )
+
+
 def layer_norm(jax, jnp, p, x, eps):
     mean = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)

@@ -117,16 +117,18 @@ def _kernel(
     q_ref,  # VMEM (1, G, H, D)
     k_ref,  # VMEM (1, H, BS, D) — page table[b, min(i, last)]
     v_ref,  # VMEM (1, H, BS, D)
-    o_ref,  # VMEM (1, G, H, D)
-    acc_ref,  # VMEM (G*H, D) f32 — running output numerator
-    m_ref,  # VMEM (G*H, 128) f32 — running max (lane 0 live)
-    l_ref,  # VMEM (G*H, 128) f32 — running denominator (lane 0 live)
-    *,
+    *rest,  # [sk_ref, sv_ref (VMEM (1, BS) f32)], o_ref, 3 scratch refs
     G: int,
     BS: int,
     MB: int,
     scale: float,
+    quantized: bool,
 ):
+    if quantized:
+        sk_ref, sv_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        sk_ref = sv_ref = None
+        o_ref, acc_ref, m_ref, l_ref = rest
     H = q_ref.shape[2]
     b = pl.program_id(0)
     i = pl.program_id(1)
@@ -145,6 +147,14 @@ def _kernel(
         q = q_ref[0].astype(jnp.float32)  # (G, H, D)
         k = k_ref[0].astype(jnp.float32)  # (H, BS, D)
         v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            # in-register dequant of the DMA'd page: the SAME
+            # ``int.astype(f32) * scale`` rule as the gather fallback
+            # (kvcache.dequantize_kv), applied before the f32 online-
+            # softmax carry — elementwise, so the two paths agree
+            # bit-for-bit
+            k = k * sk_ref[0][None, :, None]  # scales (BS,) per position
+            v = v * sv_ref[0][None, :, None]
         # the dense path's mul+reduce contraction, one page at a time
         s = (q[:, :, None, :] * k[None]).sum(-1) * scale  # (G, H, BS)
         k_pos = i * BS + jax.lax.broadcasted_iota(
@@ -174,13 +184,15 @@ def _kernel(
         o_ref[0] = out.reshape(G, *o_ref.shape[2:]).astype(o_ref.dtype)
 
 
-def _paged_call(q, pool_k, pool_v, positions, block_tables, scale):
+def _paged_call(q, pool_k, pool_v, positions, block_tables, scale,
+                scale_k=None, scale_v=None):
     # NOT jitted here: the callers (the serve programs) are jitted
     # closures, and an own-cache jit would pin the INTERPRET flag at
     # first trace — tests flip it per engine build.
     B, G, H, D = q.shape
     N, _, BS, _ = pool_k.shape
     MB = block_tables.shape[1]
+    quantized = scale_k is not None
 
     def q_map(b, i, pos_ref, bt_ref):
         return (b, 0, 0, 0)
@@ -192,14 +204,28 @@ def _paged_call(q, pool_k, pool_v, positions, block_tables, scale):
         last = jnp.minimum((pos_ref[b] + G - 1) // BS, MB - 1)
         return (bt_ref[b, jnp.minimum(i, last)], 0, 0, 0)
 
+    def sc_map(b, i, pos_ref, bt_ref):
+        # the scale row rides the same physical-block index as its page
+        last = jnp.minimum((pos_ref[b] + G - 1) // BS, MB - 1)
+        return (bt_ref[b, jnp.minimum(i, last)], 0)
+
+    in_specs = [
+        pl.BlockSpec((1, G, H, D), q_map),
+        pl.BlockSpec((1, H, BS, D), kv_map),
+        pl.BlockSpec((1, H, BS, D), kv_map),
+    ]
+    operands = [positions, block_tables, q, pool_k, pool_v]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, BS), sc_map),
+            pl.BlockSpec((1, BS), sc_map),
+        ]
+        operands += [scale_k, scale_v]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, MB),
-        in_specs=[
-            pl.BlockSpec((1, G, H, D), q_map),
-            pl.BlockSpec((1, H, BS, D), kv_map),
-            pl.BlockSpec((1, H, BS, D), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, G, H, D), q_map),
         scratch_shapes=[
             pltpu.VMEM((G * H, D), jnp.float32),
@@ -208,7 +234,7 @@ def _paged_call(q, pool_k, pool_v, positions, block_tables, scale):
         ],
     )
     kernel = functools.partial(
-        _kernel, G=G, BS=BS, MB=MB, scale=scale
+        _kernel, G=G, BS=BS, MB=MB, scale=scale, quantized=quantized
     )
     interpret = INTERPRET
     compiler_params = None
@@ -223,11 +249,12 @@ def _paged_call(q, pool_k, pool_v, positions, block_tables, scale):
         out_shape=jax.ShapeDtypeStruct((B, G, H, D), q.dtype),
         compiler_params=compiler_params,
         interpret=interpret,
-    )(positions, block_tables, q, pool_k, pool_v)
+    )(*operands)
 
 
 def paged_decode_attention(
-    q, pool_k, pool_v, positions, block_tables, scale=None
+    q, pool_k, pool_v, positions, block_tables, scale=None,
+    scale_k=None, scale_v=None,
 ):
     """Fused paged decode attention over one layer's K/V pool.
 
@@ -242,15 +269,24 @@ def paged_decode_attention(
         path's ``k_pos <= pos`` mask).
       block_tables: (B, MB) int32 — logical page -> physical block.
       scale: score scale; default ``1/sqrt(D)``.
+      scale_k / scale_v: optional (num_blocks, BS) float32 per-position
+        dequant scales for an int8/fp8 pool (``PagedKVCache.scale_k[i]``
+        for layer ``i``); when given each DMA'd page is dequantized
+        in-register via the shared ``int.astype(f32) * scale`` rule
+        before the f32 online-softmax carry, so kernel and gather
+        fallback stay bit-identical.  Pass both or neither.
 
     Returns (B, G, H, D) in ``q.dtype``.  Numerics: online softmax in
     float32 — agrees with the dense gather path to reordering ulp
     (the greedy argmax streams are bit-identical; tests pin both).
     """
+    if (scale_k is None) != (scale_v is None):
+        raise ValueError("pass both scale_k and scale_v, or neither")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     positions = jnp.asarray(positions, jnp.int32)
     block_tables = jnp.asarray(block_tables, jnp.int32)
     return _paged_call(
-        q, pool_k, pool_v, positions, block_tables, float(scale)
+        q, pool_k, pool_v, positions, block_tables, float(scale),
+        scale_k=scale_k, scale_v=scale_v,
     )

@@ -1295,6 +1295,8 @@ def estimate_decode_step_time(
     train_tokens: int,
     mxu_util: float = 0.5,
     attn_kernel: str = "paged",
+    kv_dtype: str = "fp32",
+    weight_dtype: str = "fp32",
 ) -> Dict[str, float]:
     """Analytic ONE-token decode step time under a strategy — the
     serving analog of :func:`estimate_strategy_cost` (docs/SERVING.md,
@@ -1324,8 +1326,30 @@ def estimate_decode_step_time(
     pages plus one write of the dense virtual-length buffer before the
     attention re-reads it, i.e. 3x the K/V bytes.
 
+    ``kv_dtype``/``weight_dtype`` price the quantized serving arms
+    (docs/SERVING.md "Quantized KV cache and weight-only decode") the
+    same way ``attn_kernel`` prices the kernel: per-element bytes in
+    the K/V stream and the weight stream drop to the storage format's
+    (int8/fp8 = 1, bf16 = 2), a quantized pool additionally streams
+    its float32 per-position scales, and the FLOPs terms are untouched
+    (dequant rides the same mul units the contraction uses).  The
+    ``"fp32"`` defaults mean "the model's own dtypes" and reproduce
+    the pre-quantization numbers exactly, so every existing serve
+    golden is byte-identical with the arms off.
+
     Returns ``{"step_s", "mem_s", "flops_s", "coll_s"}``.
     """
+    _QBYTES = {"fp32": None, "bf16": 2, "int8": 1, "fp8": 1}
+    if kv_dtype not in _QBYTES:
+        raise ValueError(
+            f"kv_dtype {kv_dtype!r}: expected one of {tuple(_QBYTES)}"
+        )
+    if weight_dtype not in ("fp32", "int8"):
+        raise ValueError(
+            f"weight_dtype {weight_dtype!r}: expected fp32 | int8"
+        )
+    kv_nb = _QBYTES[kv_dtype]  # None = use the graph dtype
+    w_nb = 1 if weight_dtype == "int8" else None
     mesh = strategy.mesh
     m = (machine or TPUMachineModel()).for_mesh(mesh)
     mem_s = flops_s = coll_s = 0.0
@@ -1348,7 +1372,9 @@ def estimate_decode_step_time(
             if ws is not None:
                 wd = max(1, ws.total_degree(mesh))
             elems = math.prod(w.shape)
-            lmem += elems * _dtype_nbytes(w.dtype) / wd
+            lmem += elems * (
+                w_nb if w_nb is not None else _dtype_nbytes(w.dtype)
+            ) / wd
             lflops += 2.0 * elems / wd * local_slots
         if layer.op_type == OperatorType.MULTIHEAD_ATTENTION:
             e = layer.attrs.get("embed_dim", 0)
@@ -1356,8 +1382,15 @@ def estimate_decode_step_time(
             ws = os_.weights.get("wq")
             if ws is not None:
                 tp = max(1, ws.total_degree(mesh))
-            nb = _dtype_nbytes(layer.outputs[0].dtype)
+            nb = (
+                kv_nb if kv_nb is not None
+                else _dtype_nbytes(layer.outputs[0].dtype)
+            )
             kv_bytes = 2.0 * local_slots * kv_len * e * nb / tp
+            if kv_nb is not None and kv_dtype in ("int8", "fp8"):
+                # the per-position float32 scale stream (2 pools x
+                # kv_len positions per slot, scales shared over heads)
+                kv_bytes += 2.0 * local_slots * kv_len * 4.0 / tp
             lmem += kv_bytes
             if attn_kernel == "gather":
                 # dense gather materialization: pool pages read once

@@ -114,6 +114,8 @@ class DisaggregatedCluster:
         prefix_sharing: bool = True,
         slo_ms: float = 50.0,
         attn: str = "auto",
+        kv_dtype: str = "fp32",
+        weight_dtype: str = "fp32",
         spans_out: Optional[str] = None,
         metrics_max_mb: float = 0.0,
         slo=None,
@@ -140,6 +142,8 @@ class DisaggregatedCluster:
             prefix_sharing=prefix_sharing,
             slo_ms=slo_ms,
             attn=attn,
+            kv_dtype=kv_dtype,
+            weight_dtype=weight_dtype,
             phase="prefill",
             span_recorder=self.spans,
             metrics_max_mb=metrics_max_mb,
@@ -157,6 +161,8 @@ class DisaggregatedCluster:
             prefix_sharing=prefix_sharing,
             slo_ms=slo_ms,
             attn=attn,
+            kv_dtype=kv_dtype,
+            weight_dtype=weight_dtype,
             phase="decode",
             span_recorder=self.spans,
             metrics_max_mb=metrics_max_mb,

@@ -236,6 +236,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             prefix_sharing=cfg.serve_prefix_sharing,
             slo_ms=cfg.serve_slo_ms,
             attn=cfg.serve_attn,
+            kv_dtype=cfg.serve_kv_dtype,
+            weight_dtype=cfg.serve_weight_dtype,
             machine=machine,
             metrics_max_mb=cfg.metrics_max_mb,
             slo=slo,
@@ -263,6 +265,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             prefix_sharing=cfg.serve_prefix_sharing,
             slo_ms=cfg.serve_slo_ms,
             attn=cfg.serve_attn,
+            kv_dtype=cfg.serve_kv_dtype,
+            weight_dtype=cfg.serve_weight_dtype,
             machine=machine,
             spans_out=cfg.serve_spans_out,
             metrics_max_mb=cfg.metrics_max_mb,
@@ -279,6 +283,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             metrics_out=cfg.metrics_out,
             prefix_sharing=cfg.serve_prefix_sharing,
             attn=cfg.serve_attn,
+            kv_dtype=cfg.serve_kv_dtype,
+            weight_dtype=cfg.serve_weight_dtype,
             spec_k=cfg.serve_spec_k,
             spec_draft_layers=cfg.serve_spec_draft_layers,
             watchdog_s=cfg.serve_watchdog_s,
@@ -369,6 +375,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         "num_blocks": geo.kv.num_blocks,
         "sync_every": geo.sync_every,
         "attn_kernel": geo.attn_kernel,
+        "kv_dtype": geo.kv.kv_dtype,
+        "weight_dtype": geo.weight_dtype,
+        "kv_bytes_per_token": geo.kv.bytes_per_token,
         **report.to_dict(),
     }
     sp = getattr(model.strategy, "serve_price", None)

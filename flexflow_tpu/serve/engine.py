@@ -39,7 +39,13 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from flexflow_tpu.dataloader import DevicePrefetcher
-from flexflow_tpu.models.gpt_decode import GPTSpec, layer_norm, make_cast
+from flexflow_tpu.models.gpt_decode import (
+    GPTSpec,
+    dequantize_weights_int8,
+    layer_norm,
+    make_cast,
+    quantize_weights_int8,
+)
 from flexflow_tpu.obs import (
     MetricsStream,
     SpanRecorder,
@@ -47,7 +53,7 @@ from flexflow_tpu.obs import (
     step_record,
 )
 from flexflow_tpu.runtime.faults import get_fault_plan
-from flexflow_tpu.serve.kvcache import PagedKVCache
+from flexflow_tpu.serve.kvcache import PagedKVCache, quantize_kv
 from flexflow_tpu.serve.scheduler import (
     ContinuousBatchingScheduler,
     Request,
@@ -191,6 +197,8 @@ class ServeEngine:
         prefetch_depth: int = 2,
         prefix_sharing: bool = True,
         attn: str = "auto",
+        kv_dtype: str = "fp32",
+        weight_dtype: str = "fp32",
         spec_k: int = 0,
         spec_draft_layers: int = 0,
         watchdog_s: float = 0.0,
@@ -242,11 +250,22 @@ class ServeEngine:
 
         self.attn_kernel = _pattn.resolve_serve_attn(attn)
         dt = model.executor.compute_dtype
+        # quantized serving arms (docs/SERVING.md "Quantized KV cache
+        # and weight-only decode"): kv_dtype picks the pool element
+        # format (fp32 = the engine's compute dtype — the legacy pool),
+        # weight_dtype="int8" streams per-channel-scaled int8 decode
+        # weights dequantized at the matmul edge
+        self.kv_dtype = str(kv_dtype)
+        self.weight_dtype = str(weight_dtype)
+        if self.weight_dtype not in ("fp32", "int8"):
+            raise ValueError(
+                f"weight_dtype {self.weight_dtype!r}: expected fp32 | int8"
+            )
         self.kv = PagedKVCache(
             self.spec.num_layers, self.spec.heads, self.spec.head_dim,
             slots=self.slots, block_size=block_size,
             num_blocks=num_blocks, max_seq_len=self.spec.seq, dtype=dt,
-            prefix_sharing=prefix_sharing,
+            kv_dtype=self.kv_dtype, prefix_sharing=prefix_sharing,
         )
         self.sched = ContinuousBatchingScheduler(self.slots, self.kv)
         self.metrics = MetricsStream(metrics_out, max_mb=metrics_max_mb)
@@ -310,6 +329,30 @@ class ServeEngine:
         scale = 1.0 / math.sqrt(D)
         cast = make_cast(jnp, dt)
         P = self.prefill_chunk
+        # quantized-pool trace-time switches: with ``quant`` the four
+        # programs take/donate/return the two scale pools beside the
+        # K/V pools (``*rest`` unpack below) and every scatter runs the
+        # shared quantize_kv rule; with fp32 arms the traced graphs are
+        # the pre-r19 programs bit for bit
+        quant = self.kv.quantized
+        kvdt = self.kv_dtype
+        # weight-only int8: the params ARGUMENT becomes the (qparams,
+        # scales) pair and every program folds the scales back first
+        # thing — the jitted signature changes, the math after the
+        # dequant edge does not
+        wq = self.weight_dtype == "int8"
+        if wq:
+            self._params_arg = quantize_weights_int8(
+                jnp, model.executor.params
+            )
+        else:
+            self._params_arg = model.executor.params
+
+        def prep_params(params):
+            if wq:
+                qp, qs = params
+                params = dequantize_weights_int8(jax, jnp, qp, qs)
+            return jax.tree.map(cast, params)
 
         def ln(p, x):
             return layer_norm(jax, jnp, p, x, eps)
@@ -336,9 +379,16 @@ class ServeEngine:
                 paged_decode_attention,
             )
 
-        def decode(params, ck, cv, tok, pos, bt):
-            # tok/pos (B,) int32; bt (B, MB) int32 block tables
-            params = jax.tree.map(cast, params)
+        def decode(params, ck, cv, *rest):
+            # tok/pos (B,) int32; bt (B, MB) int32 block tables; a
+            # quantized pool threads its two scale pools right after
+            # the K/V pools (same donation discipline)
+            if quant:
+                sk, sv, tok, pos, bt = rest
+            else:
+                sk = sv = None
+                tok, pos, bt = rest
+            params = prep_params(params)
             x = params["tok_embed"]["kernel"][tok]  # (B, hidden)
             x = x + params["pos_embed"]["value"][
                 jnp.clip(pos, 0, S_pos - 1)
@@ -359,6 +409,12 @@ class ServeEngine:
                 k = k.reshape(B, H, D)
                 v = v.reshape(B, H, D)
                 # scatter this position's k/v into each lane's block
+                # (quantized pools store ints + a per-position scale)
+                if quant:
+                    k, ksc = quantize_kv(jnp, k, kvdt)
+                    v, vsc = quantize_kv(jnp, v, kvdt)
+                    sk = sk.at[i, blk, off].set(ksc)
+                    sv = sv.at[i, blk, off].set(vsc)
                 ck = ck.at[i, blk, :, off, :].set(k)
                 cv = cv.at[i, blk, :, off, :].set(v)
                 if paged:
@@ -366,14 +422,26 @@ class ServeEngine:
                     # in the lowered program (ffcheck ``paged_attn``)
                     o = paged_decode_attention(
                         q[:, None], ck[i], cv[i], pos, bt, scale=scale,
+                        scale_k=sk[i] if quant else None,
+                        scale_v=sv[i] if quant else None,
                     )[:, 0]
                 else:
                     # gather each lane's pages: (B, MB, H, BS, D) ->
                     # (B, H, SV, D) in logical position order
-                    keys = ck[i][bt].transpose(
+                    keys = ck[i][bt]
+                    vals = cv[i][bt]
+                    if quant:
+                        # the kernel's exact dequant rule, pre-gather
+                        keys = keys.astype(jnp.float32) * (
+                            sk[i][bt][:, :, None, :, None]
+                        )
+                        vals = vals.astype(jnp.float32) * (
+                            sv[i][bt][:, :, None, :, None]
+                        )
+                    keys = keys.transpose(
                         0, 2, 1, 3, 4
                     ).reshape(B, H, SV, D)
-                    vals = cv[i][bt].transpose(
+                    vals = vals.transpose(
                         0, 2, 1, 3, 4
                     ).reshape(B, H, SV, D)
                     o = attend(q, keys, vals, mask)
@@ -391,11 +459,18 @@ class ServeEngine:
             logits = x @ params["lm_head"]["kernel"]
             probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
             nxt = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+            if quant:
+                return nxt, probs, ck, cv, sk, sv
             return nxt, probs, ck, cv
 
-        def prefill(params, ck, cv, toks, start, n_valid, bt):
+        def prefill(params, ck, cv, *rest):
             # ONE slot's chunk: toks (P,), start/n_valid (), bt (MB,)
-            params = jax.tree.map(cast, params)
+            if quant:
+                sk, sv, toks, start, n_valid, bt = rest
+            else:
+                sk = sv = None
+                toks, start, n_valid, bt = rest
+            params = prep_params(params)
             pos = start + jnp.arange(P)  # (P,)
             valid = jnp.arange(P) < n_valid
             x = params["tok_embed"]["kernel"][toks]  # (P, hidden)
@@ -415,10 +490,24 @@ class ServeEngine:
                 q = q.reshape(P, H, D)
                 k = k.reshape(P, H, D)
                 v = v.reshape(P, H, D)
+                if quant:
+                    k, ksc = quantize_kv(jnp, k, kvdt)
+                    v, vsc = quantize_kv(jnp, v, kvdt)
+                    sk = sk.at[i, blk, off].set(ksc)
+                    sv = sv.at[i, blk, off].set(vsc)
                 ck = ck.at[i, blk, :, off, :].set(k)
                 cv = cv.at[i, blk, :, off, :].set(v)
-                keys = ck[i][bt].transpose(1, 0, 2, 3).reshape(H, SV, D)
-                vals = cv[i][bt].transpose(1, 0, 2, 3).reshape(H, SV, D)
+                keys = ck[i][bt]
+                vals = cv[i][bt]
+                if quant:
+                    keys = keys.astype(jnp.float32) * (
+                        sk[i][bt][:, None, :, None]
+                    )
+                    vals = vals.astype(jnp.float32) * (
+                        sv[i][bt][:, None, :, None]
+                    )
+                keys = keys.transpose(1, 0, 2, 3).reshape(H, SV, D)
+                vals = vals.transpose(1, 0, 2, 3).reshape(H, SV, D)
                 # q rows attend the slot's whole visible prefix:
                 # (P, H, SV) scores via the shared mul+reduce form
                 o = attend(q, keys[None], vals[None], mask)
@@ -437,6 +526,8 @@ class ServeEngine:
             logits = x @ params["lm_head"]["kernel"]
             probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
             nxt = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+            if quant:
+                return nxt, probs, ck, cv, sk, sv
             return nxt, probs, ck, cv
 
         # --- speculative decoding programs (docs/SERVING.md) --------------
@@ -453,12 +544,17 @@ class ServeEngine:
         # decode — the zero-per-step-sync ledger is unchanged.
         Ld, W = self.spec_draft_layers, self.spec_k + 1
 
-        def draft(params, ck, cv, tok, pos, bt):
+        def draft(params, ck, cv, *rest):
             # identical to decode through the first Ld layers; the
             # rejected-position K/V this writes is rewritten by whichever
             # program next processes those positions before any row's
             # causal mask can expose it (see SERVING.md)
-            params = jax.tree.map(cast, params)
+            if quant:
+                sk, sv, tok, pos, bt = rest
+            else:
+                sk = sv = None
+                tok, pos, bt = rest
+            params = prep_params(params)
             x = params["tok_embed"]["kernel"][tok]
             x = x + params["pos_embed"]["value"][
                 jnp.clip(pos, 0, S_pos - 1)
@@ -478,17 +574,33 @@ class ServeEngine:
                 q = q.reshape(B, H, D)
                 k = k.reshape(B, H, D)
                 v = v.reshape(B, H, D)
+                if quant:
+                    k, ksc = quantize_kv(jnp, k, kvdt)
+                    v, vsc = quantize_kv(jnp, v, kvdt)
+                    sk = sk.at[i, blk, off].set(ksc)
+                    sv = sv.at[i, blk, off].set(vsc)
                 ck = ck.at[i, blk, :, off, :].set(k)
                 cv = cv.at[i, blk, :, off, :].set(v)
                 if paged:
                     o = paged_decode_attention(
                         q[:, None], ck[i], cv[i], pos, bt, scale=scale,
+                        scale_k=sk[i] if quant else None,
+                        scale_v=sv[i] if quant else None,
                     )[:, 0]
                 else:
-                    keys = ck[i][bt].transpose(
+                    keys = ck[i][bt]
+                    vals = cv[i][bt]
+                    if quant:
+                        keys = keys.astype(jnp.float32) * (
+                            sk[i][bt][:, :, None, :, None]
+                        )
+                        vals = vals.astype(jnp.float32) * (
+                            sv[i][bt][:, :, None, :, None]
+                        )
+                    keys = keys.transpose(
                         0, 2, 1, 3, 4
                     ).reshape(B, H, SV, D)
-                    vals = cv[i][bt].transpose(
+                    vals = vals.transpose(
                         0, 2, 1, 3, 4
                     ).reshape(B, H, SV, D)
                     o = attend(q, keys, vals, mask)
@@ -506,16 +618,23 @@ class ServeEngine:
             logits = x @ params["lm_head"]["kernel"]
             probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
             nxt = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+            if quant:
+                return nxt, ck, cv, sk, sv
             return nxt, ck, cv
 
-        def verify(params, ck, cv, toks, pos0, bt):
+        def verify(params, ck, cv, *rest):
             # toks (B, W): [current, draft_1..draft_k]; row j of slot b
             # sits at position pos0[b] + j.  Every matmul flattens to
             # (B*W, ...) 2-D and attention keeps the shared mul+reduce
             # contraction, so each row's arithmetic is the decode
             # step's — the full model's argmax, bit for bit (the
             # bit-identity tests pin this)
-            params = jax.tree.map(cast, params)
+            if quant:
+                sk, sv, toks, pos0, bt = rest
+            else:
+                sk = sv = None
+                toks, pos0, bt = rest
+            params = prep_params(params)
             lane = jnp.arange(B)
             pos = pos0[:, None] + jnp.arange(W)[None, :]  # (B, W)
             x = params["tok_embed"]["kernel"][toks]  # (B, W, hidden)
@@ -540,6 +659,11 @@ class ServeEngine:
                 # scatter all W rows, THEN attend: row j's mask reaches
                 # rows 0..j of this same program, freshly written (the
                 # prefill-chunk discipline, batched over slots)
+                if quant:
+                    k, ksc = quantize_kv(jnp, k, kvdt)  # scale (B, W)
+                    v, vsc = quantize_kv(jnp, v, kvdt)
+                    sk = sk.at[i, blk, off].set(ksc)
+                    sv = sv.at[i, blk, off].set(vsc)
                 ck = ck.at[i, blk, :, off, :].set(k)
                 cv = cv.at[i, blk, :, off, :].set(v)
                 if paged:
@@ -547,12 +671,23 @@ class ServeEngine:
                     # reaches position pos0 + j (G = W generalization)
                     o = paged_decode_attention(
                         q, ck[i], cv[i], pos0, bt, scale=scale,
+                        scale_k=sk[i] if quant else None,
+                        scale_v=sv[i] if quant else None,
                     )
                 else:
-                    keys = ck[i][bt].transpose(
+                    keys = ck[i][bt]
+                    vals = cv[i][bt]
+                    if quant:
+                        keys = keys.astype(jnp.float32) * (
+                            sk[i][bt][:, :, None, :, None]
+                        )
+                        vals = vals.astype(jnp.float32) * (
+                            sv[i][bt][:, :, None, :, None]
+                        )
+                    keys = keys.transpose(
                         0, 2, 1, 3, 4
                     ).reshape(B, H, SV, D)
-                    vals = cv[i][bt].transpose(
+                    vals = vals.transpose(
                         0, 2, 1, 3, 4
                     ).reshape(B, H, SV, D)
                     o = attend(q, keys[:, None], vals[:, None], mask)
@@ -577,56 +712,58 @@ class ServeEngine:
             acc = jnp.cumprod(agree, axis=1).sum(axis=1)  # (B,) in [0, k]
             next_cur = n[lane, acc]  # the first token NOT yet fed
             next_pos = pos0 + acc + 1
+            if quant:
+                return n, acc, next_cur, next_pos, ck, cv, sk, sv
             return n, acc, next_cur, next_pos, ck, cv
 
-        self._decode = jax.jit(decode, donate_argnums=(1, 2))
-        self._prefill = jax.jit(prefill, donate_argnums=(1, 2))
+        donate = (1, 2, 3, 4) if quant else (1, 2)
+        self._decode = jax.jit(decode, donate_argnums=donate)
+        self._prefill = jax.jit(prefill, donate_argnums=donate)
         self._draft = self._verify = None
         if self.spec_k:
-            self._draft = jax.jit(draft, donate_argnums=(1, 2))
-            self._verify = jax.jit(verify, donate_argnums=(1, 2))
+            self._draft = jax.jit(draft, donate_argnums=donate)
+            self._verify = jax.jit(verify, donate_argnums=donate)
 
         # warmup both programs once so the cache layout/sharding
         # stabilizes (same rationale as GPTDecodeSession) and steady
         # state replays compiled code only
         z = jnp.zeros((B,), jnp.int32)
         bt0 = jnp.zeros((B, MB), jnp.int32)
-        nt, _, ck, cv = self._decode(
-            model.executor.params, self.kv.cache_k, self.kv.cache_v,
-            z, z, bt0,
+        res = self._decode(
+            self._params_arg, *self._kvs(), z, z, bt0,
         )
-        _, _, ck, cv = self._prefill(
-            model.executor.params, ck, cv,
+        bufs = res[2:]
+        res = self._prefill(
+            self._params_arg, *bufs,
             jnp.zeros((P,), jnp.int32), jnp.asarray(0, jnp.int32),
             jnp.asarray(1, jnp.int32), bt0[0],
         )
+        bufs = res[2:]
         # chain one more decode on the prefill's outputs so BOTH
         # programs have seen the other's cache layout — steady state
         # then replays compiled code regardless of phase interleaving
-        _, _, ck, cv = self._decode(
-            model.executor.params, ck, cv, z, z, bt0,
-        )
+        res = self._decode(self._params_arg, *bufs, z, z, bt0)
+        bufs = res[2:]
         if self.spec_k:
             # the speculative programs join the same warmup chain so
             # all four agree on ONE buffer layout (a second layout
             # would recompile every donated program once per layout)
-            _, ck, cv = self._draft(
-                model.executor.params, ck, cv, z, z, bt0,
-            )
-            _, _, _, _, ck, cv = self._verify(
-                model.executor.params, ck, cv,
+            res = self._draft(self._params_arg, *bufs, z, z, bt0)
+            bufs = res[1:]
+            res = self._verify(
+                self._params_arg, *bufs,
                 jnp.zeros((B, W), jnp.int32), z, bt0,
             )
-            _, _, ck, cv = self._decode(
-                model.executor.params, ck, cv, z, z, bt0,
-            )
-        self._cache_sharding = (ck.sharding, cv.sharding)
+            bufs = res[4:]
+            res = self._decode(self._params_arg, *bufs, z, z, bt0)
+            bufs = res[2:]
+        self._cache_sharding = (bufs[0].sharding, bufs[1].sharding)
         # keep the CHAINED warmup buffers as the live pool: the warmup
         # only ever wrote the trash block (all tables were zero), so
         # every real block still holds zeros — and replacing them with
         # fresh device_put arrays would introduce a second buffer
         # layout, recompiling both donated programs once per layout
-        self.kv.cache_k, self.kv.cache_v = ck, cv
+        self._store_kvs(bufs)
 
         # --verify-compiled (docs/ANALYSIS.md): the executor's post-
         # compile ffcheck pass, applied to the serve programs — the
@@ -702,6 +839,25 @@ class ServeEngine:
 
     def _now(self) -> float:
         return time.perf_counter()
+
+    # --- pool-buffer threading ---------------------------------------------
+    def _kvs(self):
+        """The live pool buffers in program-argument order: (ck, cv)
+        for a full-precision pool, (ck, cv, sk, sv) for a quantized one
+        — every program donates and returns exactly this tuple."""
+        kv = self.kv
+        if kv.quantized:
+            return (kv.cache_k, kv.cache_v, kv.scale_k, kv.scale_v)
+        return (kv.cache_k, kv.cache_v)
+
+    def _store_kvs(self, bufs) -> None:
+        """Write a program's returned pool buffers back as the live
+        pool (the counterpart of :meth:`_kvs`)."""
+        kv = self.kv
+        if kv.quantized:
+            kv.cache_k, kv.cache_v, kv.scale_k, kv.scale_v = bufs
+        else:
+            kv.cache_k, kv.cache_v = bufs
 
     # --- the serve loop ----------------------------------------------------
     def run(self, requests: Optional[Sequence[Request]] = None) -> ServeReport:
@@ -959,11 +1115,11 @@ class ServeEngine:
             chunks, place, depth=self.prefetch_depth
         ):
             t_c0 = spans.now() if spans is not None else 0.0
-            nxt, probs, ck, cv = self._prefill(
-                ex.params, self.kv.cache_k, self.kv.cache_v,
-                toks_d, lo_d, n_d, row_d,
+            res = self._prefill(
+                self._params_arg, *self._kvs(), toks_d, lo_d, n_d, row_d,
             )
-            self.kv.cache_k, self.kv.cache_v = ck, cv
+            nxt, probs = res[0], res[1]
+            self._store_kvs(res[2:])
             self.prefill_chunks += 1
             lo_h = req.prefill_pos
             req.prefill_pos = min(
@@ -1033,29 +1189,32 @@ class ServeEngine:
                     cur_j, pos_j = cur_d, pos_d
                     drafts = []
                     for _j in range(k):
-                        dn, ck, cv = self._draft(
-                            ex.params, self.kv.cache_k, self.kv.cache_v,
+                        res = self._draft(
+                            self._params_arg, *self._kvs(),
                             cur_j, pos_j, bt_d,
                         )
-                        self.kv.cache_k, self.kv.cache_v = ck, cv
+                        dn = res[0]
+                        self._store_kvs(res[1:])
                         drafts.append(dn)
                         cur_j, pos_j = dn, pos_j + 1
                     toks = jnp.stack([cur_d] + drafts, axis=1)  # (B, W)
-                    n, acc, cur_d, pos_d, ck, cv = self._verify(
-                        ex.params, self.kv.cache_k, self.kv.cache_v,
+                    res = self._verify(
+                        self._params_arg, *self._kvs(),
                         toks, pos_d, bt_d,
                     )
-                    self.kv.cache_k, self.kv.cache_v = ck, cv
+                    n, acc, cur_d, pos_d = res[:4]
+                    self._store_kvs(res[4:])
                     spec_buf.append((n, acc))
                 steps = macros * W  # program invocations this window
             else:
                 steps = max(1, min(self.sync_every, min(remaining)))
                 for _ in range(steps):
-                    nxt, probs_last, ck, cv = self._decode(
-                        ex.params, self.kv.cache_k, self.kv.cache_v,
+                    res = self._decode(
+                        self._params_arg, *self._kvs(),
                         cur_d, jnp.asarray(pos), bt_d,
                     )
-                    self.kv.cache_k, self.kv.cache_v = ck, cv
+                    nxt, probs_last = res[0], res[1]
+                    self._store_kvs(res[2:])
                     buffered.append(nxt)
                     cur_d = nxt  # device-to-device chain: NO host fetch
                     for s in dec_slots:
@@ -1239,6 +1398,11 @@ class ServeEngine:
                 # (ADDITIVE ffmetrics/1 vocabulary — r14, old readers
                 # ignore it, old streams simply lack it)
                 "attn_kernel": self.attn_kernel,
+                # quantized-serving vocabulary (ADDITIVE — r19): the
+                # pool/weight formats and the per-position HBM cost
+                "kv_dtype": self.kv_dtype,
+                "weight_dtype": self.weight_dtype,
+                "kv_bytes_per_token": self.kv.bytes_per_token,
             }
             # disaggregated-pool vocabulary (ADDITIVE — absent on
             # colocated engines, so pre-r13 streams are unchanged)
@@ -1309,6 +1473,8 @@ class ServeEngine:
             "drained": self.drained,
             "watchdog_fires": self.watchdog_fires,
             "attn_kernel": self.attn_kernel,
+            "kv_dtype": self.kv_dtype,
+            "weight_dtype": self.weight_dtype,
         }
 
     def _finish_if_done(self, req: Request, tok: int) -> None:

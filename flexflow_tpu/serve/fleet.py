@@ -270,6 +270,8 @@ class FleetRouter:
         prefix_sharing: bool = True,
         slo_ms: float = 50.0,
         attn: str = "auto",
+        kv_dtype: str = "fp32",
+        weight_dtype: str = "fp32",
         metrics_max_mb: float = 0.0,
         slo=None,
         policy: Optional[SLOPolicy] = None,
@@ -303,7 +305,8 @@ class FleetRouter:
             slots=slots, block_size=block_size, num_blocks=num_blocks,
             prefill_chunk=prefill_chunk, sync_every=sync_every,
             eos_id=eos_id, prefix_sharing=prefix_sharing, slo_ms=slo_ms,
-            attn=attn, metrics_max_mb=metrics_max_mb,
+            attn=attn, kv_dtype=kv_dtype, weight_dtype=weight_dtype,
+            metrics_max_mb=metrics_max_mb,
         )
         self._metrics_base = metrics_out
         self._transport_capacity = int(transport_capacity)

@@ -54,6 +54,21 @@ bytes, a preempted request resumes the exact token stream.
 Physical block 0 is the TRASH block: never allocated, never registered,
 it absorbs the writes of inactive decode lanes and padded prefill rows
 (their block tables are all-zero), so the jitted step needs no masking.
+
+**Quantized pools (PR 19, docs/SERVING.md "Quantized KV cache").**
+``kv_dtype="int8" | "fp8"`` stores the pools in 1-byte elements with
+per-block symmetric scale arrays ``scale_k``/``scale_v`` of shape
+``(L, num_blocks, block_size)`` float32 living BESIDE the pools — one
+scale per written position (shared across heads and head_dim), rows
+addressed by the same physical block ids the tables hold.  The
+allocator never looks at the scales: alloc/free/refcount/CoW/prefix
+indexing are byte-for-byte the fp32 code paths (only
+:meth:`ensure_private` additionally copies the scale row with the
+block's device contents, and spill/restore carry the quantized ints +
+scales so frames shrink by the element-size ratio).  The quantize rule
+(:func:`quantize_kv`) and the dequant rule (``int.astype(f32) *
+scale``) are module functions so the engine's scatter, the Pallas
+kernel, and the gather fallback provably share ONE contract.
 """
 
 from __future__ import annotations
@@ -64,7 +79,74 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["PagedKVCache", "KVCacheOOM"]
+__all__ = [
+    "PagedKVCache",
+    "KVCacheOOM",
+    "KV_DTYPES",
+    "kv_pool_dtype",
+    "kv_qmax",
+    "quantize_kv",
+    "dequantize_kv",
+]
+
+# the --serve-kv-dtype vocabulary; "fp32" means "full precision in the
+# engine's compute dtype" (the legacy pool — possibly bf16 on a bf16
+# model), so fp32 arms stay byte-identical to pre-r19 builds
+KV_DTYPES = ("fp32", "bf16", "int8", "fp8")
+
+# symmetric quantization range per storage format: int8 clips at +-127
+# (the -128 code is unused so the grid is symmetric); fp8 e4m3fn's max
+# finite value is 448
+_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+
+def kv_qmax(kv_dtype: str) -> Optional[float]:
+    """Symmetric quantization ceiling for ``kv_dtype`` (None when the
+    format is full-precision and no scales exist)."""
+    return _QMAX.get(kv_dtype)
+
+
+def kv_pool_dtype(jnp, kv_dtype: str, fallback=None):
+    """Resolve a ``kv_dtype`` name to the pool element dtype."""
+    if kv_dtype not in KV_DTYPES:
+        raise ValueError(
+            f"kv_dtype {kv_dtype!r}: expected one of {KV_DTYPES}"
+        )
+    if kv_dtype == "fp32":
+        return fallback if fallback is not None else jnp.float32
+    if kv_dtype == "bf16":
+        return jnp.bfloat16
+    if kv_dtype == "int8":
+        return jnp.int8
+    return jnp.float8_e4m3fn
+
+
+def quantize_kv(jnp, x, kv_dtype: str):
+    """THE write-side quantization rule: symmetric per-position scales
+    over the trailing ``(H, D)`` axes.  ``x`` is ``(..., H, D)`` float;
+    returns ``(q, scale)`` with ``q`` in the pool dtype and ``scale``
+    float32 of shape ``x.shape[:-2]``.  An all-zero position gets scale
+    1.0 (its ints are zeros; dequant reproduces the zeros exactly) —
+    never a divide-by-zero."""
+    qmax = _QMAX[kv_dtype]
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=(-2, -1))
+    scale = jnp.where(amax > 0, amax / qmax, 1.0).astype(jnp.float32)
+    q = xf / scale[..., None, None]
+    if kv_dtype == "int8":
+        q = jnp.clip(jnp.round(q), -qmax, qmax).astype(jnp.int8)
+    else:
+        q = q.astype(jnp.float8_e4m3fn)
+    return q, scale
+
+
+def dequantize_kv(jnp, q, scale):
+    """THE read-side rule every consumer shares (engine gather fallback,
+    Pallas in-register dequant, spill parity tests): cast the stored
+    elements to f32 and multiply by the per-position scale.  ``q`` is
+    ``(..., S, D)`` (positions on the second-to-last axis), ``scale``
+    broadcasts over that axis: shape ``(..., S)``."""
+    return q.astype(jnp.float32) * scale[..., None]
 
 
 class KVCacheOOM(RuntimeError):
@@ -99,6 +181,7 @@ class PagedKVCache:
         max_blocks_per_seq: Optional[int] = None,
         max_seq_len: Optional[int] = None,
         dtype=None,
+        kv_dtype: str = "fp32",
         prefix_sharing: bool = True,
     ) -> None:
         import jax.numpy as jnp
@@ -129,7 +212,12 @@ class PagedKVCache:
             num_blocks = slots * self.max_blocks_per_seq + 1
         assert num_blocks >= 2, "need at least the trash block + one real"
         self.num_blocks = int(num_blocks)
-        self.dtype = dtype if dtype is not None else jnp.float32
+        self.kv_dtype = str(kv_dtype)
+        self.dtype = kv_pool_dtype(
+            jnp, self.kv_dtype, fallback=dtype
+        )
+        self.quantized = self.kv_dtype in ("int8", "fp8")
+        self.qmax = kv_qmax(self.kv_dtype)
 
         # block 0 is the trash block — never enters the free list
         self._free: deque = deque(range(1, self.num_blocks))
@@ -157,6 +245,16 @@ class PagedKVCache:
         )
         self.cache_k = jnp.zeros(shape, self.dtype)
         self.cache_v = jnp.zeros(shape, self.dtype)
+        # per-position symmetric scales, rows addressed by physical
+        # block id exactly like the pools; zero scale dequantizes the
+        # never-written trash/pad positions to exact zeros
+        if self.quantized:
+            sshape = (num_layers, self.num_blocks, block_size)
+            self.scale_k = jnp.zeros(sshape, jnp.float32)
+            self.scale_v = jnp.zeros(sshape, jnp.float32)
+        else:
+            self.scale_k = None
+            self.scale_v = None
 
     # --- capacity queries --------------------------------------------------
     @property
@@ -401,6 +499,9 @@ class PagedKVCache:
         new = self._acquire(1, protect=blocks)[0]
         self.cache_k = self.cache_k.at[:, new].set(self.cache_k[:, blk])
         self.cache_v = self.cache_v.at[:, new].set(self.cache_v[:, blk])
+        if self.quantized:  # the scale row travels with its block
+            self.scale_k = self.scale_k.at[:, new].set(self.scale_k[:, blk])
+            self.scale_v = self.scale_v.at[:, new].set(self.scale_v[:, blk])
         self.cow_copies += 1
         self._refcount[blk] -= 1
         self._refcount[new] = 1
@@ -445,6 +546,15 @@ class PagedKVCache:
                 for i in range(self.num_layers)
             },
         }
+        if self.quantized:
+            # quantized frames carry the raw pool ints (above — dtype
+            # preserved by gather_dense) plus their per-position scales;
+            # fp32/bf16 payloads stay byte-identical to pre-r19 frames
+            payload["kv_dtype"] = self.kv_dtype
+            sk, sv = self.gather_scales(slot, length)
+            for i in range(self.num_layers):
+                payload["layers"][f"layer{i}"]["sk"] = np.asarray(sk[i])
+                payload["layers"][f"layer{i}"]["sv"] = np.asarray(sv[i])
         self.release(slot)
         return payload
 
@@ -456,6 +566,16 @@ class PagedKVCache:
         remainder of the payload back into the fresh blocks.  Returns
         the re-attached shared length in positions.
 
+        A QUANTIZED payload (``payload["kv_dtype"]`` in int8/fp8) may
+        only restore into a pool of the SAME ``kv_dtype`` — and a
+        full-precision payload may not restore into a quantized pool:
+        re-quantizing someone else's ints would silently change the
+        stream, so the mismatch is refused (reservation released first,
+        like the model-shape refusal below).  Within a matching dtype
+        the quantized ints and their scales scatter back verbatim — the
+        spill→restore→spill round trip is bit-exact with no
+        re-quantization step anywhere.
+
         The payload may come from a pool with a DIFFERENT
         ``block_size``/``num_blocks`` geometry (it is dense per layer —
         see :meth:`spill`): re-chunking happens here against THIS
@@ -466,6 +586,19 @@ class PagedKVCache:
         import jax.numpy as jnp
 
         self.reserve(slot, seq_len, prompt=prompt)
+        payload_dtype = str(payload.get("kv_dtype", "fp32"))
+        pool_q = self.quantized
+        frame_q = payload_dtype in ("int8", "fp8")
+        if (pool_q or frame_q) and payload_dtype != (
+            self.kv_dtype if pool_q else "fp32"
+        ):
+            self.release(slot)
+            raise ValueError(
+                f"KV payload kv_dtype {payload_dtype!r} cannot restore "
+                f"into a kv_dtype {self.kv_dtype!r} pool — re-quantizing "
+                f"a handoff frame would silently change the stream; "
+                f"spill and restore pools must agree on kv_dtype"
+            )
         shared_pos = self.shared_len(slot)
         length = int(payload["length"])
         if length <= shared_pos:
@@ -509,6 +642,25 @@ class PagedKVCache:
         ), "restore would write a shared block (CoW discipline breached)"
         self.cache_k = self.cache_k.at[:, ids].set(jnp.asarray(k, self.dtype))
         self.cache_v = self.cache_v.at[:, ids].set(jnp.asarray(v, self.dtype))
+        if self.quantized:
+            sk = np.stack([
+                np.asarray(payload["layers"][f"layer{i}"]["sk"],
+                           np.float32)
+                for i in range(L)
+            ])
+            sv = np.stack([
+                np.asarray(payload["layers"][f"layer{i}"]["sv"],
+                           np.float32)
+                for i in range(L)
+            ])
+            if pad:
+                zpad = np.zeros((L, pad), np.float32)
+                sk = np.concatenate([sk, zpad], axis=1)
+                sv = np.concatenate([sv, zpad], axis=1)
+            sk = sk[:, lo_blk * BS:].reshape(L, nb, BS)
+            sv = sv[:, lo_blk * BS:].reshape(L, nb, BS)
+            self.scale_k = self.scale_k.at[:, ids].set(jnp.asarray(sk))
+            self.scale_v = self.scale_v.at[:, ids].set(jnp.asarray(sv))
         return shared_pos
 
     # --- invariants ---------------------------------------------------------
@@ -563,6 +715,53 @@ class PagedKVCache:
         v = v.transpose(0, 2, 1, 3, 4).reshape(L, H, n * BS, D)[:, :, :seq_len]
         return k, v
 
+    def gather_scales(self, slot: int, seq_len: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Host-side re-assembly of ``slot``'s per-position scales into
+        dense ``(L, seq_len)`` float32 arrays (quantized pools only) —
+        the companion of :meth:`gather_dense` for spill frames and
+        parity tests."""
+        assert self.quantized, "full-precision pools have no scales"
+        sk = np.asarray(self.scale_k)
+        sv = np.asarray(self.scale_v)
+        row = self.tables[slot]
+        L, BS = self.num_layers, self.block_size
+        n = self.blocks_for(seq_len)
+        sk = sk[:, row[:n]].reshape(L, n * BS)[:, :seq_len]
+        sv = sv[:, row[:n]].reshape(L, n * BS)[:, :seq_len]
+        return sk, sv
+
+    def gather_dense_dequant(self, slot: int, seq_len: int) -> Tuple[np.ndarray, np.ndarray]:
+        """:meth:`gather_dense`, dequantized to float32 via the shared
+        :func:`dequantize_kv` rule when the pool is quantized (identity
+        cast otherwise) — what parity tests compare against a
+        full-precision session."""
+        import jax.numpy as jnp
+
+        k, v = self.gather_dense(slot, seq_len)
+        if not self.quantized:
+            return np.asarray(k, np.float32), np.asarray(v, np.float32)
+        sk, sv = self.gather_scales(slot, seq_len)
+        # k is (L, H, S, D); scales (L, S) broadcast over the S axis
+        k = np.asarray(dequantize_kv(jnp, jnp.asarray(k),
+                                     jnp.asarray(sk)[:, None, :]))
+        v = np.asarray(dequantize_kv(jnp, jnp.asarray(v),
+                                     jnp.asarray(sv)[:, None, :]))
+        return k, v
+
+    @property
+    def bytes_per_token(self) -> int:
+        """HBM bytes one cached position costs across all layers (k+v
+        elements, plus the 2 float32 scales per layer when quantized) —
+        the ffmetrics/1 ``kv_bytes_per_token`` field."""
+        elems = 2 * self.num_layers * self.heads * self.head_dim
+        n = elems * self.cache_k.dtype.itemsize
+        if self.quantized:
+            n += 2 * self.num_layers * 4
+        return n
+
     def hbm_bytes(self) -> int:
-        """Physical pool footprint (both caches)."""
-        return 2 * self.cache_k.size * self.cache_k.dtype.itemsize
+        """Physical pool footprint (both caches + scales)."""
+        n = 2 * self.cache_k.size * self.cache_k.dtype.itemsize
+        if self.quantized:
+            n += 2 * self.scale_k.size * 4
+        return n

@@ -79,6 +79,15 @@ class ServeSpec:
     # to pre-fleet records.
     replicas: int = 1
     routing: str = "prefix"  # prefix | round_robin | least_loaded
+    # quantized serving arms (r19, docs/SERVING.md "Quantized KV cache
+    # and weight-only decode"): storage formats priced as bytes axes in
+    # estimate_decode_step_time — int8/fp8 KV quarters the K/V stream
+    # (plus a small f32 scale stream), int8 weights quarter the
+    # weight-streaming term that dominates decode.  The "fp32" defaults
+    # mean "the model's own dtypes" and keep every fp32 serve golden
+    # byte-identical.
+    kv_dtype: str = "fp32"  # fp32 | bf16 | int8 | fp8
+    weight_dtype: str = "fp32"  # fp32 | int8
 
 
 class ServeObjective:
@@ -111,6 +120,8 @@ class ServeObjective:
             slots=self.spec.slots, kv_len=self.spec.kv_len,
             train_tokens=self.train_tokens,
             attn_kernel=self.spec.attn,
+            kv_dtype=self.spec.kv_dtype,
+            weight_dtype=self.spec.weight_dtype,
         )
         step_s_raw = max(d["step_s"], 1e-12)
         step_s = step_s_raw
@@ -197,4 +208,11 @@ class ServeObjective:
         }
         if fleet_price is not None:
             out["fleet"] = fleet_price
+        # quantized arms appear in the price dict ONLY when enabled
+        # (the fleet-key pattern): fp32 arms keep every existing serve
+        # golden byte-identical
+        if self.spec.kv_dtype != "fp32":
+            out["kv_dtype"] = self.spec.kv_dtype
+        if self.spec.weight_dtype != "fp32":
+            out["weight_dtype"] = self.spec.weight_dtype
         return out

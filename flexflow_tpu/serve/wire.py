@@ -85,10 +85,31 @@ def flatten_requests(
         flat[f"r{i}/prompt"] = np.asarray(r["prompt"], np.int32)
         flat[f"r{i}/tokens"] = np.asarray(r.get("tokens", ()), np.int64)
         kv = r.get("kv_spill")
+        kv_dtype = None
         if kv is not None:
+            # quantized spills (r19): a kv_dtype tag plus per-layer
+            # per-position scale arrays ride as EXTRA named arrays, so
+            # the frame digest covers them (the PR-16 trace pattern) —
+            # a tampered scale fails verify exactly like tampered KV.
+            # fp32/bf16 spills carry neither, keeping those frames
+            # byte-identical to pre-r19 builds.  fp8 element arrays are
+            # stored as uint8 VIEWS: np.savez round-trips ml_dtypes
+            # float8 as raw void bytes, losing the dtype — the
+            # kv_dtype meta key is what views them back on decode.
+            kv_dtype = kv.get("kv_dtype")
             for lname, d in kv["layers"].items():
-                flat[f"r{i}/kv/{lname}/k"] = np.asarray(d["k"])
-                flat[f"r{i}/kv/{lname}/v"] = np.asarray(d["v"])
+                k, v = np.asarray(d["k"]), np.asarray(d["v"])
+                if kv_dtype == "fp8":
+                    k, v = k.view(np.uint8), v.view(np.uint8)
+                flat[f"r{i}/kv/{lname}/k"] = k
+                flat[f"r{i}/kv/{lname}/v"] = v
+                if "sk" in d:
+                    flat[f"r{i}/kv/{lname}/sk"] = np.asarray(
+                        d["sk"], np.float32
+                    )
+                    flat[f"r{i}/kv/{lname}/sv"] = np.asarray(
+                        d["sv"], np.float32
+                    )
         meta: Dict[str, Any] = {
             "id": int(r["id"]),
             "max_new_tokens": int(r["max_new_tokens"]),
@@ -102,6 +123,8 @@ def flatten_requests(
             "session": r.get("session"),
             "kv_length": int(kv["length"]) if kv is not None else None,
         }
+        if kv_dtype is not None:
+            meta["kv_dtype"] = str(kv_dtype)
         for key in _TIMING_KEYS:
             if r.get(key) is not None:
                 meta[key] = float(r[key])
@@ -129,15 +152,29 @@ def unflatten_requests(
     for i, meta in enumerate(metas):
         kv = None
         if meta.get("kv_length") is not None:
+            kv_dtype = meta.get("kv_dtype")
             layers: Dict[str, Any] = {}
             j = 0
             while f"r{i}/kv/layer{j}/k" in flat:
-                layers[f"layer{j}"] = {
-                    "k": flat[f"r{i}/kv/layer{j}/k"],
-                    "v": flat[f"r{i}/kv/layer{j}/v"],
-                }
+                k = flat[f"r{i}/kv/layer{j}/k"]
+                v = flat[f"r{i}/kv/layer{j}/v"]
+                if kv_dtype == "fp8":
+                    # undo the uint8 storage view (see flatten)
+                    import ml_dtypes
+
+                    k = k.view(ml_dtypes.float8_e4m3fn)
+                    v = v.view(ml_dtypes.float8_e4m3fn)
+                layers[f"layer{j}"] = {"k": k, "v": v}
+                sk = flat.get(f"r{i}/kv/layer{j}/sk")
+                if sk is not None:
+                    layers[f"layer{j}"]["sk"] = sk
+                    layers[f"layer{j}"]["sv"] = flat[
+                        f"r{i}/kv/layer{j}/sv"
+                    ]
                 j += 1
             kv = {"length": int(meta["kv_length"]), "layers": layers}
+            if kv_dtype is not None:
+                kv["kv_dtype"] = str(kv_dtype)
         d: Dict[str, Any] = {
             key: meta.get(key) for key in _META_KEYS + _TIMING_KEYS
             if key in meta or key in _META_KEYS
@@ -237,5 +274,7 @@ def kv_payload_nbytes(kv: Optional[Dict[str, Any]]) -> int:
     if kv is None:
         return 0
     return int(sum(
-        d["k"].nbytes + d["v"].nbytes for d in kv["layers"].values()
+        d["k"].nbytes + d["v"].nbytes
+        + (d["sk"].nbytes + d["sv"].nbytes if "sk" in d else 0)
+        for d in kv["layers"].values()
     ))

@@ -150,39 +150,62 @@ def test_disagg_handoff_audit_clean(ab):
 
 
 # ------------------------------------------- cross-geometry spill/restore
-def test_kv_spill_restore_cross_geometry_property():
+def _dense_payload(rng, L, H, D, length, kv_dtype="fp32"):
+    """A restore-shaped payload in the pool's storage dtype: fp32
+    carries raw floats; int8/fp8 carry elements quantized with the
+    pool's own contract (per-position scales, quantize_kv) so the
+    round trip has no re-quantization step anywhere."""
+    payload = {"length": length, "layers": {}}
+    if kv_dtype in ("int8", "fp8"):
+        import jax.numpy as jnp
+
+        from flexflow_tpu.serve.kvcache import quantize_kv
+
+        payload["kv_dtype"] = kv_dtype
+    for i in range(L):
+        d = {}
+        for part in ("k", "v"):
+            x = rng.normal(size=(H, length, D)).astype(np.float32)
+            if kv_dtype in ("int8", "fp8"):
+                # (length, H, D) layout yields per-position scales
+                q, s = quantize_kv(
+                    jnp, jnp.asarray(x.transpose(1, 0, 2)), kv_dtype
+                )
+                d[part] = np.asarray(q).transpose(1, 0, 2)
+                d["s" + part] = np.asarray(s)
+            else:
+                d[part] = x
+        payload["layers"][f"layer{i}"] = d
+    return payload
+
+
+@pytest.mark.parametrize("kv_dtype", ["fp32", "int8", "fp8"])
+def test_kv_spill_restore_cross_geometry_property(kv_dtype):
     """Property test: a dense KV payload restores bit-exactly into a
     pool with a DIFFERENT block_size/num_blocks geometry (the
     prefill→decode handoff), for random lengths including non-multiples
-    of either block size."""
+    of either block size — at every storage dtype.  For quantized
+    pools the elements AND their per-position scales must survive the
+    src→dst hop verbatim (spill→restore→spill is re-quantization-free
+    by contract)."""
     L, H, D = 2, 3, 5
     rng = np.random.default_rng(42)
     geoms = [(8, 16), (16, 8), (4, 20), (20, 4), (8, 12), (12, 8)]
+    parts = ("k", "v") + (
+        ("sk", "sv") if kv_dtype in ("int8", "fp8") else ()
+    )
     for bs_src, bs_dst in geoms:
         for _ in range(2):
             length = int(rng.integers(1, 60))
             kv_src = PagedKVCache(
                 L, H, D, slots=2, block_size=bs_src, max_seq_len=64,
-                prefix_sharing=False,
+                prefix_sharing=False, kv_dtype=kv_dtype,
             )
             kv_dst = PagedKVCache(
                 L, H, D, slots=3, block_size=bs_dst, max_seq_len=64,
-                prefix_sharing=False,
+                prefix_sharing=False, kv_dtype=kv_dtype,
             )
-            payload = {
-                "length": length,
-                "layers": {
-                    f"layer{i}": {
-                        "k": rng.normal(size=(H, length, D)).astype(
-                            np.float32
-                        ),
-                        "v": rng.normal(size=(H, length, D)).astype(
-                            np.float32
-                        ),
-                    }
-                    for i in range(L)
-                },
-            }
+            payload = _dense_payload(rng, L, H, D, length, kv_dtype)
             # write via restore into the source geometry, spill the
             # dense bytes back out, restore THAT into the destination
             kv_src.restore(0, payload, length)
@@ -190,13 +213,14 @@ def test_kv_spill_restore_cross_geometry_property():
             kv_dst.restore(1, hop, length)
             back = kv_dst.spill(1, length)
             for i in range(L):
-                for part in ("k", "v"):
+                for part in parts:
                     np.testing.assert_array_equal(
                         back["layers"][f"layer{i}"][part],
                         payload["layers"][f"layer{i}"][part],
                         err_msg=f"bs {bs_src}->{bs_dst} len {length} "
-                                f"layer{i}/{part}",
+                                f"layer{i}/{part} ({kv_dtype})",
                     )
+            assert back.get("kv_dtype") == payload.get("kv_dtype")
             kv_src.check_invariants()
             kv_dst.check_invariants()
 

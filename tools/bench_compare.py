@@ -125,6 +125,13 @@ COMPARABLE_METADATA = (
     # p99 for configuration (not regression) reasons
     "fleet_replicas",
     "fleet_routing",
+    # kv_dtype / weight_dtype (r19, docs/SERVING.md "Quantized KV cache
+    # and weight-only decode"): the quantized A/B arm's storage formats
+    # — runs at different quantization arms are the same experiment,
+    # but the gate surfaces the change because serve_kv_bytes_per_tok
+    # moves with the format, not with code quality
+    "kv_dtype",
+    "weight_dtype",
 )
 
 # (label, path into the record, higher_is_better) — the gated metrics.
@@ -188,6 +195,14 @@ GATED = (
     # fleet's p99 per-token latency under the bursty multi-tenant
     # shape — routing quality must not buy hit rate with tail latency
     ("serve_fleet_p99_tpot_ms", ("serve_fleet_p99_tpot_ms",), False),
+    # serve_kv_bytes_per_tok (r19, docs/SERVING.md "Quantized KV cache
+    # and weight-only decode") gates LOWER-is-better: the int8 arm's
+    # per-token pool bytes (element pools + per-position scale stream,
+    # PagedKVCache.bytes_per_token) — it growing means the quantized
+    # pool silently fattened (a full-precision pool or a scale-layout
+    # regression sneaking back), which halves admissible concurrency
+    # before any throughput gate notices
+    ("serve_kv_bytes_per_tok", ("serve_kv_bytes_per_tok",), False),
     ("dlrm", ("secondary", "dlrm", "samples_per_sec"), True),
     ("bert_large", ("secondary", "bert_large", "samples_per_sec"), True),
     ("gpt_decode_cached", ("secondary", "gpt_decode", "cached_tok_per_s"), True),

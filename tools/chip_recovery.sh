@@ -41,6 +41,19 @@ spec.loader.exec_module(b)
 print(json.dumps(b._serve_paged_attn_ab(True)))
 PY
 
+echo "== serve KV-quant A/B (r19: int8 paged pool + weight-only int8 decode — CPU had interpret/tiny-shape numbers only) =="
+# on-chip the int8 arm's win moves from admission (4x sessions per pool,
+# dtype math) to bandwidth: decode is weight/KV-streaming bound, so the
+# quartered streams should show up in tok/s, and divergent_streams
+# reports the real greedy divergence at bf16 compute
+timeout 900 python - <<'PY' 2>&1 | grep -v WARNING | tee .bench_logs/serve_kv_quant_ab.json
+import importlib.util, json
+spec = importlib.util.spec_from_file_location("bench", "bench.py")
+b = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(b)
+print(json.dumps(b._serve_kv_quant_ab(True)))
+PY
+
 echo "== fit overlap A/B (r15: grad-sync ring on real ICI — CPU had virtual-device numbers only) =="
 timeout 900 python - <<'PY' 2>&1 | grep -v WARNING | tee .bench_logs/fit_overlap_ab.json
 import importlib.util, json

@@ -133,6 +133,19 @@ def render(records: List[Dict], max_windows: int = 30) -> str:
     # carries the run totals; absent keys mean a pre-PR-11 stream
     last = serve[-1][1]
     facts = []
+    # quantized serving (r19, docs/SERVING.md "Quantized KV cache and
+    # weight-only decode"): additive vocabulary — pre-r19 streams carry
+    # none of these keys and the line stays absent
+    if last.get("kv_dtype") is not None:
+        quant = f"quantization: kv_dtype {last['kv_dtype']}"
+        if last.get("weight_dtype") is not None:
+            quant += f", weight_dtype {last['weight_dtype']}"
+        if last.get("kv_bytes_per_token") is not None:
+            quant += (
+                f", {last['kv_bytes_per_token']} KV pool bytes/token "
+                "(scales included)"
+            )
+        facts.append(quant)
     if last.get("prefix_hit_rate") is not None:
         facts.append(
             f"prefix cache: hit rate {last['prefix_hit_rate']:.3f}, "

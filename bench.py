@@ -1586,6 +1586,178 @@ def _serve_paged_attn_ab(on_tpu: bool) -> dict:
     }
 
 
+def _serve_prefill_paged_ab(on_tpu: bool) -> dict:
+    """Chunked-prefill A/B (ISSUE 20 acceptance, docs/SERVING.md
+    "Chunked prefill on the paged pool"): the SAME model serves the
+    SAME long-prompt workload (>= 2k prompt tokens per request, smoke
+    scale) through the dense-gather prefill path vs the paged prefill
+    kernel, per KV pool dtype (fp32 / int8 / fp8).  Facts gated: (1)
+    every request's token stream is bit-identical across arms within
+    each kv_dtype, and (2) the PREFILL program's peak live temp bytes
+    (XLA ``memory_analysis()``) are <= 0.6x the gather arm's
+    (``serve_prefill_peak_mb``, the fp32 paged peak, lower-is-better).
+
+    The pool is undersized relative to the compiled position range:
+    the gather path materializes its per-layer K/V gather at the FULL
+    virtual length ``SV = MB * BS`` on EVERY chunk — the O(S^2)
+    long-context tax — while the paged kernel DMAs only the visible
+    pages behind each row group.  TTFT p99 is reported per arm but
+    ungated off-TPU (interpret emulation speed is not kernel speed;
+    real-chip numbers ride tools/chip_recovery.sh)."""
+    import time as _time
+
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.transformer import gpt_decoder
+    from flexflow_tpu.ops.pallas import paged_attention as pa
+    from flexflow_tpu.serve import Request, ServeEngine
+
+    slots = 4
+    # virtual range deliberately > working set (undersized-pool story)
+    seq = 4096 if on_tpu else 3072
+    shape = (
+        dict(hidden=512, heads=8, ff_dim=2048, num_layers=6)
+        if on_tpu
+        else dict(hidden=32, heads=4, ff_dim=64, num_layers=2)
+    )
+    vocab = 32000 if on_tpu else 256
+    block_size = 64  # big pages keep the interpret-mode grid small
+    prefill_chunk = 512 if on_tpu else 256
+    n_requests, max_new = 4, 4
+    prompt_lo, prompt_hi = 2048, 2113  # >= 2k tokens, always
+    blocks_per_req = -(-(prompt_hi - 1 + max_new) // block_size)
+    num_blocks = slots * blocks_per_req + 3  # << slots * MB
+
+    def build():
+        cfg = FFConfig(
+            batch_size=slots,
+            compute_dtype="bfloat16" if on_tpu else "float32",
+        )
+        model = FFModel(cfg)
+        gpt_decoder(
+            model, slots, seq, vocab=vocab, use_flash=False, **shape
+        )
+        model.compile(seed=0)
+        return model
+
+    def workload():
+        rng = np.random.default_rng(0)
+        reqs = []
+        for i in range(n_requests):
+            plen = int(rng.integers(prompt_lo, prompt_hi))
+            reqs.append(Request(
+                prompt=rng.integers(0, vocab, size=(plen,)).astype(
+                    np.int32
+                ),
+                max_new_tokens=max_new, id=i,
+            ))
+        return reqs
+
+    def prefill_peak_bytes(engine) -> int:
+        import jax.numpy as jnp
+
+        kv = engine.kv
+        B, P, MB = engine.slots, engine.prefill_chunk, (
+            kv.max_blocks_per_seq
+        )
+        z = jnp.zeros((B,), jnp.int32)
+        bt0 = jnp.zeros((B, MB), jnp.int32)
+        pool_args = (kv.cache_k, kv.cache_v) + (
+            (kv.scale_k, kv.scale_v) if kv.quantized else ()
+        )
+        params_arg = getattr(
+            engine, "_params_arg", engine.model.executor.params
+        )
+        compiled = engine._prefill.lower(
+            params_arg, *pool_args,
+            jnp.zeros((B, P), jnp.int32), z,
+            jnp.ones((B,), jnp.int32), bt0,
+        ).compile()
+        return int(compiled.memory_analysis().temp_size_in_bytes)
+
+    old_interpret = pa.INTERPRET
+    if not on_tpu:
+        pa.INTERPRET = True  # the only way the kernel runs off-TPU
+    try:
+        results = {}
+        for kv_dtype in ("fp32", "int8", "fp8"):
+            for label in ("gather", "paged"):
+                engine = ServeEngine(
+                    build(), slots=slots, block_size=block_size,
+                    num_blocks=num_blocks,
+                    prefill_chunk=prefill_chunk, sync_every=4,
+                    attn=label, kv_dtype=kv_dtype,
+                )
+                t0 = _time.perf_counter()
+                rep = engine.run(workload())
+                wall = _time.perf_counter() - t0
+                streams = {
+                    r.id: np.asarray(r.tokens, np.int32)
+                    for r in engine.sched.finished
+                }
+                results[(kv_dtype, label)] = (
+                    rep, streams, prefill_peak_bytes(engine), wall
+                )
+    finally:
+        pa.INTERPRET = old_interpret
+
+    def match(dt: str) -> bool:
+        _, g, _, _ = results[(dt, "gather")]
+        _, p, _, _ = results[(dt, "paged")]
+        return (
+            set(g) == set(p) == set(range(n_requests))
+            and all(np.array_equal(g[i], p[i]) for i in g)
+        )
+
+    rep_g, _, peak_g, wall_g = results[("fp32", "gather")]
+    rep_p, _, peak_p, wall_p = results[("fp32", "paged")]
+    ratios = {
+        dt: (
+            round(
+                results[(dt, "paged")][2] / results[(dt, "gather")][2],
+                4,
+            )
+            if results[(dt, "gather")][2]
+            else None
+        )
+        for dt in ("fp32", "int8", "fp8")
+    }
+    return {
+        "config": (
+            f"{'mid' if on_tpu else 'tiny'} gpt sv={seq} "
+            f"prompts {prompt_lo}..{prompt_hi - 1} "
+            f"chunk={prefill_chunk} pool={num_blocks - 1}blk "
+            f"bs={block_size} {n_requests} reqs "
+            f"{'native' if on_tpu else 'interpret'}"
+        ),
+        "serve_attn": "paged",
+        "serve_prefill_peak_mb": round(peak_p / 1e6, 4),
+        "gather_prefill_peak_mb": round(peak_g / 1e6, 4),
+        "prefill_peak_ratio_fp32": ratios["fp32"],
+        "prefill_peak_ratio_int8": ratios["int8"],
+        "prefill_peak_ratio_fp8": ratios["fp8"],
+        "outputs_match": bool(all(match(d) for d in
+                                  ("fp32", "int8", "fp8"))),
+        "outputs_match_fp32": bool(match("fp32")),
+        "outputs_match_int8": bool(match("int8")),
+        "outputs_match_fp8": bool(match("fp8")),
+        "ttft_p99_ms_paged": rep_p.ttft_p99_ms,
+        "ttft_p99_ms_gather": rep_g.ttft_p99_ms,
+        "serve_tok_s_paged": (
+            round(rep_p.new_tokens / wall_p, 2) if wall_p else None
+        ),
+        "serve_tok_s_gather": (
+            round(rep_g.new_tokens / wall_g, 2) if wall_g else None
+        ),
+        "windows": rep_p.windows,
+        "host_syncs": rep_p.host_syncs,
+        "prefill_chunks": rep_p.prefill_chunks,
+        "prefill_dispatches": rep_p.prefill_dispatches,
+        "prefill_attn_kernel": rep_p.prefill_attn_kernel,
+    }
+
+
 def _serve_kv_quant_ab(on_tpu: bool) -> dict:
     """Quantized-KV serving A/B (ISSUE 19 acceptance, docs/SERVING.md
     "Quantized KV cache and weight-only decode"): the SAME model serves
@@ -1873,6 +2045,7 @@ def _bench_secondary(on_tpu: bool) -> dict:
         ("serve_disagg_ab", _serve_disagg_ab),
         ("serve_fleet_ab", _serve_fleet_ab),
         ("serve_paged_attn_ab", _serve_paged_attn_ab),
+        ("serve_prefill_paged_ab", _serve_prefill_paged_ab),
         ("serve_kv_quant_ab", _serve_kv_quant_ab),
         ("recovery_ab", _recovery_ab),
     ):
@@ -2134,6 +2307,13 @@ def run_bench(backend: str) -> None:
         # comparable metadata
         "serve_paged_attn_peak_mb": None,
         "serve_attn": None,
+        # chunked prefill on the paged pool (ISSUE 20, docs/SERVING.md
+        # "Chunked prefill on the paged pool"): the fp32 paged PREFILL
+        # program's peak live temp bytes (LOWER-is-better gate — the
+        # full-virtual-length gather coming back to the prefill phase
+        # shows up here first); per-dtype ratios and TTFT ride in the
+        # secondary record as comparable metadata
+        "serve_prefill_peak_mb": None,
         # quantized KV serving (ISSUE 19, docs/SERVING.md "Quantized KV
         # cache and weight-only decode"): the int8 arm's per-token pool
         # bytes (LOWER-is-better gate — a full-precision pool sneaking
@@ -2245,6 +2425,8 @@ def run_bench(backend: str) -> None:
     qab = record["secondary"].get("serve_paged_attn_ab") or {}
     record["serve_paged_attn_peak_mb"] = qab.get("serve_paged_attn_peak_mb")
     record["serve_attn"] = qab.get("serve_attn")
+    pfab = record["secondary"].get("serve_prefill_paged_ab") or {}
+    record["serve_prefill_peak_mb"] = pfab.get("serve_prefill_peak_mb")
     kvab = record["secondary"].get("serve_kv_quant_ab") or {}
     record["serve_kv_bytes_per_tok"] = kvab.get("serve_kv_bytes_per_tok")
     record["kv_dtype"] = kvab.get("kv_dtype")

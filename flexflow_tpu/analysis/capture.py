@@ -317,9 +317,8 @@ def analyze_serve_engine(
             "serve.prefill",
             engine._prefill,
             (params_arg,) + pool_args + (
-                jnp.zeros((engine.prefill_chunk,), jnp.int32),
-                jnp.asarray(0, jnp.int32), jnp.asarray(1, jnp.int32),
-                bt0[0],
+                jnp.zeros((B, engine.prefill_chunk), jnp.int32),
+                z, jnp.ones((B,), jnp.int32), bt0,
             ),
             ("params",) + pool_names + ("toks", "start", "n_valid",
              "block_tables"),
@@ -349,6 +348,7 @@ def analyze_serve_engine(
         "serve_attn": getattr(engine, "attn_kernel", "gather"),
         "max_blocks_per_seq": MB,
         "block_size": kv.block_size,
+        "slots": B,
         # quantization claims (r19): the kv_quant check cross-examines
         # these against the captured pool avals — a config that CLAIMS
         # int8/fp8 KV while lowering a full-precision cache_k is lying

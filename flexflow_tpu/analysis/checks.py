@@ -232,16 +232,25 @@ def check_paged_attn(artifact: ProgramArtifact) -> List[Violation]:
     virtual-length K/V bytes (``MB * BS * H * D * itemsize``) means the
     gather is still in the program.
 
+    Prefill is audited too (r20, "Chunked prefill on the paged pool"):
+    the batched chunk program claiming ``paged`` must not lower the
+    dense fallback's per-layer ``pool[tables]`` either — its output is
+    ``slots`` lanes of virtual-length K/V, the exact O(S^2) hazard the
+    prefill kernel extension deletes.  Because a legitimate batched
+    prefill gathers (slots, chunk, hidden) token embeddings that can
+    exceed one LANE's K/V bytes at smoke scale, the prefill role
+    additionally requires the gather's operand to be pool-shaped
+    (ndim >= 4) — embedding tables are 2-D and never match.
+
     Total: artifacts without a ``serve_attn: "paged"`` detail (gather
     engines, non-serve programs), without a jaxpr, or without a K/V
-    pool input all skip.  Prefill keeps the dense gather by design
-    (compute-bound, one slot at a time) and is skipped by role.  Small
-    gathers (embedding lookups, per-page dynamic slices from the
-    kernel's own lowering) sit far below the threshold and pass."""
+    pool input all skip.  Small gathers (embedding lookups, per-page
+    dynamic slices from the kernel's own lowering) sit far below the
+    threshold and pass."""
     det = artifact.details or {}
     if det.get("serve_attn") != "paged":
         return []
-    if artifact.role not in ("decode", "draft", "verify"):
+    if artifact.role not in ("decode", "draft", "verify", "prefill"):
         return []
     if artifact.jaxpr is None:
         return []
@@ -264,6 +273,14 @@ def check_paged_attn(artifact: ProgramArtifact) -> List[Violation]:
     for eqn in walk_jaxpr_eqns(artifact.jaxpr):
         if eqn.primitive.name not in ("gather", "take"):
             continue
+        if artifact.role == "prefill":
+            # pool-shaped operand only (see docstring): the batched
+            # token-embedding gather is big but 2-D-sourced and benign
+            aval0 = getattr(
+                eqn.invars[0] if eqn.invars else None, "aval", None
+            )
+            if aval0 is None or len(getattr(aval0, "shape", ())) < 4:
+                continue
         for var in eqn.outvars:
             aval = getattr(var, "aval", None)
             if aval is None or not hasattr(aval, "shape"):

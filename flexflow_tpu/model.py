@@ -951,6 +951,9 @@ class FFModel:
                             "gather" if cfg.serve_attn == "gather"
                             else "paged"
                         ),
+                        # the chunked-prefill arm (r20) prices the
+                        # same chunk shape the engine will run
+                        prefill_chunk=cfg.serve_prefill_chunk,
                         spec_k=cfg.serve_spec_k,
                         spec_accept=cfg.serve_spec_accept,
                         spec_draft_frac=(

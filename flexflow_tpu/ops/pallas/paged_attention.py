@@ -33,8 +33,15 @@ exists.  Three structural guarantees:
 
 Query rows generalize to ``G`` consecutive positions per lane (``q``
 is (B, G, H, D), row ``g`` of lane ``b`` sits at ``positions[b] + g``)
-so ONE kernel serves plain decode / draft (G=1) and the speculative
-verify program (G = k+1).
+so ONE kernel serves plain decode / draft (G=1), the speculative
+verify program (G = k+1), and prefill-sized chunks
+(:func:`paged_prefill_attention`, G = the prefill chunk P).  The
+clamp is what makes the prefill case cheap: a chunk starting at
+position ``s`` visits only ``ceil((s + G) / BS)`` live pages — the
+grid still spans MB steps, but every step past ``last`` repeats the
+clamped index (no DMA) and skips the compute, so per-layer traffic is
+O(chunk x visible) instead of the dense gather's O(chunk x SV), and
+the O(S^2)-in-SV prefill materialization never exists.
 
 Off-TPU the kernel runs in interpreter mode only (``INTERPRET``,
 default from ``FFTPU_PALLAS_INTERPRET`` — see ``__init__.py``);
@@ -57,6 +64,7 @@ from flexflow_tpu.ops.pallas import env_interpret
 __all__ = [
     "INTERPRET",
     "paged_decode_attention",
+    "paged_prefill_attention",
     "supported",
     "resolve_serve_attn",
 ]
@@ -288,5 +296,53 @@ def paged_decode_attention(
     block_tables = jnp.asarray(block_tables, jnp.int32)
     return _paged_call(
         q, pool_k, pool_v, positions, block_tables, float(scale),
+        scale_k=scale_k, scale_v=scale_v,
+    )
+
+
+def paged_prefill_attention(
+    q, pool_k, pool_v, start, block_tables, scale=None,
+    scale_k=None, scale_v=None,
+):
+    """Fused paged CHUNKED-PREFILL attention over one layer's K/V pool.
+
+    The prefill-sized row group: ``q`` is (B, P, H, D) — P consecutive
+    prompt positions per lane, row ``g`` of lane ``b`` at position
+    ``start[b] + g``.  The caller scatters the chunk's K/V into the
+    pool FIRST (padded rows to the trash block), then attends: row
+    ``g``'s causal mask reaches positions ``0 .. start[b] + g``, which
+    includes the chunk's own freshly written rows — the same
+    scatter-then-attend discipline as the speculative verify program,
+    at chunk width.
+
+    What makes this the O(S^2) fix (docs/PERF.md): the kernel's
+    visible-page DMA clamp.  The grid walks MB logical pages but the
+    page index is clamped to ``last = (start[b] + P - 1) // BS``, so a
+    chunk at start ``s`` fetches only ``ceil((s + P) / BS)`` pages —
+    a repeated (clamped) index is a skipped DMA and ``pl.when`` skips
+    the compute.  The dense gather fallback materializes (H, SV, D) at
+    the FULL virtual length for every chunk of every slot; here no
+    virtual-length buffer ever exists and traffic is proportional to
+    the visible prefix only.
+
+    Padded lanes (an idle slot in the batched prefill dispatch) ride
+    with ``start = 0`` and an all-zero table row: every page index
+    clamps/maps to the allocator's trash block 0, the per-lane DMAs
+    degenerate to one repeated page, and the garbage output rows are
+    discarded by the caller.
+
+    ``scale_k``/``scale_v`` are the quantized pool's per-position
+    dequant scale rows ((num_blocks, BS) float32), riding the same
+    block-table scalar-prefetch as the pages with in-register dequant
+    — paged and gather prefill stay bit-identical per kv_dtype, the
+    decode contract at chunk width (tests pin fp32/int8/fp8).
+
+    Returns (B, P, H, D) in ``q.dtype``.
+    """
+    # the decode entry point already generalizes to G consecutive rows;
+    # prefill IS that kernel at G = P — one shared lowering, one parity
+    # contract, no second code path to drift
+    return paged_decode_attention(
+        q, pool_k, pool_v, start, block_tables, scale=scale,
         scale_k=scale_k, scale_v=scale_v,
     )

@@ -1428,6 +1428,233 @@ def estimate_decode_step_time(
     }
 
 
+def estimate_prefill_chunk_time(
+    layers: List[Layer],
+    strategy: Strategy,
+    machine: Optional[TPUMachineModel] = None,
+    *,
+    chunk: int,
+    kv_len: int,
+    train_tokens: int,
+    slots: int = 1,
+    mxu_util: float = 0.5,
+    attn_kernel: str = "paged",
+    kv_dtype: str = "fp32",
+    weight_dtype: str = "fp32",
+) -> Dict[str, float]:
+    """Analytic ONE-chunk batched prefill dispatch time under a
+    strategy — the prefill analog of :func:`estimate_decode_step_time`
+    (docs/SERVING.md "Chunked prefill on the paged pool").
+
+    One dispatch ingests ``chunk`` prompt positions for each of
+    ``slots`` lanes (the engine's batched prefill program, r20): the
+    decode weights stream from HBM ONCE per chunk-batch while
+    ``slots * chunk`` activation rows flow through them — which is the
+    whole point of batching prefill across slots; the per-slot loop
+    paid that stream once per slot.
+
+    ``attn_kernel`` prices the chunk-attention path, and this is where
+    the O(S^2) asymmetry lives:
+
+    * ``"paged"`` — the block-table-native kernel's visible-page DMA
+      clamp reads only the chunk's visible prefix, ``kv_len / 2 +
+      chunk`` positions for the MEAN chunk of a ``kv_len``-long prompt
+      (chunk i sees ``i * chunk + chunk``; the average over a prompt's
+      chunks is half the final depth).
+    * ``"gather"`` — the dense fallback materializes the FULL virtual
+      length every chunk regardless of start: pool pages read once
+      more + the (H, SV, D) buffer written and re-read, i.e. 3x
+      ``kv_len`` positions of K/V bytes per layer per chunk.
+
+    ``kv_dtype``/``weight_dtype`` reuse the decode estimator's storage
+    axes (quantized pools add the float32 per-position scale stream,
+    scaled 3x on the gather arm like the pages it rides with).  The
+    attention FLOPs term is identical across kernels — the win is
+    traffic, not arithmetic.
+
+    The collective term charges BOTH partial-sum resolution (the decode
+    estimator's term, at chunk-row bytes) AND the strategy's implied
+    activation reshard collectives (:func:`reshard_cost` over the same
+    edge walk :func:`implied_collectives` audits), INCLUDING edges into
+    view ops — a reshape that demands a replicated input from a
+    batch-sharded producer lowers a real all-gather every dispatch.
+    Pricing nodes only would make such shardings look collective-free:
+    the per-chip row count shrinks while the ~1us-latency-floor
+    all-gather they owe per dispatch vanishes from the bill, and the
+    prefill pool flips to an activation-sharded hybrid that is slower
+    end-to-end.  At serving-sized activations these collectives are
+    latency-dominated — exactly why the prefill pool wants the
+    collective-free layout and the disagg 2-slice golden pins that the
+    pricing knows it.
+
+    Per-prompt-position feed cost (what the disagg split pricing
+    amortizes) is ``chunk_s / (slots * chunk)``.  Pure host math —
+    deterministic, golden-testable, no TPU required.
+
+    Returns ``{"chunk_s", "mem_s", "flops_s", "coll_s"}``.
+    """
+    _QBYTES = {"fp32": None, "bf16": 2, "int8": 1, "fp8": 1}
+    if kv_dtype not in _QBYTES:
+        raise ValueError(
+            f"kv_dtype {kv_dtype!r}: expected one of {tuple(_QBYTES)}"
+        )
+    if weight_dtype not in ("fp32", "int8"):
+        raise ValueError(
+            f"weight_dtype {weight_dtype!r}: expected fp32 | int8"
+        )
+    from flexflow_tpu.ops.parallel_ops import resolve_parallel_sharding
+    from flexflow_tpu.parallel.spec import TensorSharding
+
+    kv_nb = _QBYTES[kv_dtype]
+    w_nb = 1 if weight_dtype == "int8" else None
+    chunk = max(1, int(chunk))
+    slots = max(1, int(slots))
+    # mean visible depth of a chunk while prefilling a kv_len prompt
+    # (paged); the gather arm always touches the full virtual length
+    visible = kv_len / 2.0 + chunk
+    mesh = strategy.mesh
+    m = (machine or TPUMachineModel()).for_mesh(mesh)
+    mem_s = flops_s = coll_s = 0.0
+    # activation bytes scale from the graph's training shapes to one
+    # chunk dispatch's slots x chunk rows (the latency floor inside the
+    # machine model's collective pricing is byte-independent, so tiny
+    # reshards still pay their ~1us — the term that makes a DCN- or
+    # even ICI-crossing model axis lose at serving scale)
+    act_scale = (slots * chunk) / max(1, train_tokens)
+    # lane parallelism: the batched prefill program lane-shards its OWN
+    # (slots, chunk) batch over the mesh's non-model axes — the serve
+    # batch is ``slots``, not the training graph's batch, so a mesh
+    # whose data axis the TRAINING batch cannot divide (forcing the
+    # strategy fully replicated) still spreads the serve lanes.  The
+    # strategy-derived dim-0 sharding is honored per layer when wider.
+    lane_cap = 1
+    for _a in mesh.axis_names:
+        if _a != "model":
+            lane_cap *= mesh.axis_size(_a)
+    lane_cap = min(lane_cap, slots)
+    pop_out: Dict[int, "TensorSharding"] = {}
+
+    def _producer_sharding(t):
+        if t.guid in pop_out:
+            return pop_out[t.guid]
+        if t.owner_layer is None:
+            return None
+        prod = strategy.op_sharding(t.owner_layer)
+        if prod is None or t.owner_idx >= len(prod.output):
+            return None
+        return prod.output[t.owner_idx]
+
+    for layer in layers:
+        if layer.op_type.is_parallel_op:
+            # explicit reshard: the implied collective runs once per
+            # chunk dispatch at chunk-row bytes
+            t = layer.inputs[0]
+            src = _producer_sharding(t) or TensorSharding.replicated(
+                t.ndim
+            )
+            dst = resolve_parallel_sharding(layer, src, mesh)
+            coll_s += reshard_cost(
+                t.shape, _dtype_nbytes(t.dtype) * act_scale,
+                src, dst, mesh, m, with_backward=False,
+            )
+            pop_out[layer.outputs[0].guid] = dst
+            continue
+        opdef = get_op_def(layer.op_type)
+        os_ = strategy.op_sharding(layer) or default_op_sharding(layer)
+        out0 = os_.output[0] if os_.output else None
+        # edge reshards the dispatch pays (same skip rule as the
+        # training estimator: batch-compatible layouts pass through
+        # free) — walked for VIEW ops too: a reshape that demands a
+        # replicated input from a sharded producer lowers a real
+        # all-gather even though the view itself computes nothing
+        for i, t in enumerate(layer.inputs):
+            src = _producer_sharding(t)
+            if src is None:
+                continue
+            explicit = i < len(os_.inputs) and os_.inputs[i] is not None
+            dst = (
+                os_.inputs[i] if explicit
+                else TensorSharding.replicated(t.ndim)
+            )
+            if not explicit and not src.partial_axes and not any(
+                "model" in src.axes_of(d) for d in range(len(src.spec))
+            ):
+                continue
+            coll_s += reshard_cost(
+                t.shape, _dtype_nbytes(t.dtype) * act_scale,
+                src, dst, mesh, m, with_backward=False,
+            )
+        if layer.op_type in _VIEW_OPS:
+            continue
+        slot_deg = 1
+        if out0 is not None and len(out0.spec):
+            for a in out0.axes_of(0):
+                slot_deg *= mesh.axis_size(a)
+        slot_deg = max(slot_deg, lane_cap)
+        local_slots = max(1.0, slots / max(1, slot_deg))
+        local_rows = local_slots * chunk
+        lmem = lflops = 0.0
+        for w in opdef.weights(layer):
+            wd = 1
+            ws = os_.weights.get(w.name)
+            if ws is not None:
+                wd = max(1, ws.total_degree(mesh))
+            elems = math.prod(w.shape)
+            lmem += elems * (
+                w_nb if w_nb is not None else _dtype_nbytes(w.dtype)
+            ) / wd
+            lflops += 2.0 * elems / wd * local_rows
+        if layer.op_type == OperatorType.MULTIHEAD_ATTENTION:
+            e = layer.attrs.get("embed_dim", 0)
+            tp = 1
+            ws = os_.weights.get("wq")
+            if ws is not None:
+                tp = max(1, ws.total_degree(mesh))
+            nb = (
+                kv_nb if kv_nb is not None
+                else _dtype_nbytes(layer.outputs[0].dtype)
+            )
+            if attn_kernel == "gather":
+                # full-SV materialization every chunk: pool read +
+                # dense buffer write + attention re-read
+                kv_bytes = 3.0 * 2.0 * local_slots * kv_len * e * nb / tp
+                if kv_nb is not None and kv_dtype in ("int8", "fp8"):
+                    kv_bytes += (
+                        3.0 * 2.0 * local_slots * kv_len * 4.0 / tp
+                    )
+            else:
+                # visible pages only — the kernel's DMA clamp
+                kv_bytes = 2.0 * local_slots * visible * e * nb / tp
+                if kv_nb is not None and kv_dtype in ("int8", "fp8"):
+                    kv_bytes += 2.0 * local_slots * visible * 4.0 / tp
+            lmem += kv_bytes
+            # chunk rows x visible keys, QK^T + PV (kernel-independent)
+            lflops += 2.0 * 2.0 * local_rows * visible * e / tp
+        mem_s += lmem / m.hbm_bw
+        flops_s += lflops / (m.peak_flops * mxu_util)
+        if out0 is not None and out0.partial_axes:
+            out_b = sum(
+                math.prod(s) * _dtype_nbytes(dt)
+                for s, dt in opdef.infer(layer)
+            )
+            per_tok = out_b / max(1, train_tokens)
+            shard_deg = max(1, out0.total_degree(mesh))
+            for a in out0.partial_axes:
+                n = mesh.axis_size(a)
+                if n > 1:
+                    coll_s += m.all_reduce(
+                        per_tok * local_rows / shard_deg, n, axis=a
+                    )
+    if hasattr(m, "flush_decisions"):
+        m.flush_decisions()
+    return {
+        "chunk_s": max(mem_s, flops_s) + coll_s,
+        "mem_s": mem_s,
+        "flops_s": flops_s,
+        "coll_s": coll_s,
+    }
+
+
 def estimate_speculative_decode(
     step_s: float,
     *,

@@ -180,10 +180,14 @@ def _disagg_arm(
     generated token the cluster pays ``max(decode objective cost,
     prefill feed cost)`` (whichever pool is the bottleneck; the other
     overlaps) plus the per-request handoff amortized over ~``kv_len``
-    generated tokens.  The prefill feed cost charges one forward pass
-    per ``train_tokens`` prompt positions — the steady-state assumption
-    that generation and prompt lengths are comparable; bench A/Bs
-    measure the real ratio.
+    generated tokens.  The prefill feed cost is the batched chunked-
+    prefill dispatch priced for real (r20,
+    :func:`flexflow_tpu.search.cost.estimate_prefill_chunk_time` —
+    paged visible-page traffic vs the gather arm's full-SV
+    materialization, ``--serve-attn`` governing both phases) amortized
+    per prompt position, under the steady-state assumption that
+    generation and prompt lengths are comparable; bench A/Bs measure
+    the real ratio.
 
     Returns the best split as a JSON-able dict (what lands in
     ``serve_price["disagg"]``) plus the two pool strategies, or None
@@ -237,15 +241,43 @@ def _disagg_arm(
                 best = (cost, st, price)
         return best
 
-    def prefill_price(res, st):
-        # chunked prefill IS the forward pass: the DP's forward-only
-        # step time over train_tokens prompt positions
-        return res.cost, {"step_s": res.cost}
-
     best = None
     for p in range(1, n):
         d = n - p
         pm, dm = machine.subset(p), machine.subset(d)
+
+        def prefill_price(res, st, _pm=pm):
+            # chunked prefill priced for real (r20): the batched paged
+            # chunk dispatch on this pool's submesh
+            # (estimate_prefill_chunk_time) instead of the old
+            # compute-bound forward-pass guess — the attn/kv/weight
+            # arms follow the spec, so ``--serve-attn`` governs the
+            # prefill pool's pricing too.  Cost is per prompt position
+            # (chunk_s amortized over the dispatch's slots x chunk
+            # rows), directly comparable to the per-generated-token
+            # decode cost at the steady-state prompt~generation
+            # assumption below.
+            from flexflow_tpu.search.cost import (
+                estimate_prefill_chunk_time,
+            )
+
+            pf = estimate_prefill_chunk_time(
+                res.layers if res.layers is not layers else layers,
+                st, _pm, chunk=spec.prefill_chunk, kv_len=spec.kv_len,
+                train_tokens=serve_obj.train_tokens, slots=spec.slots,
+                attn_kernel=spec.attn, kv_dtype=spec.kv_dtype,
+                weight_dtype=spec.weight_dtype,
+            )
+            per_pos = pf["chunk_s"] / max(
+                1, spec.slots * spec.prefill_chunk
+            )
+            return per_pos, {
+                "step_s": per_pos,
+                "chunk_s": pf["chunk_s"],
+                "chunk": spec.prefill_chunk,
+                "attn_kernel": spec.attn,
+            }
+
         with get_tracer().span(
             "search_disagg_split", cat="search", split=f"{p}+{d}",
         ):
@@ -271,8 +303,12 @@ def _disagg_arm(
         d_cost, d_st, d_price = dw
         handoff_s = estimate_kv_handoff_time(kv_bytes, machine)
         # per-generated-token: pools overlap (max), handoff amortizes
-        # over one request's ~kv_len generated tokens
-        feed_cost = p_cost / max(1, serve_obj.train_tokens)
+        # over one request's ~kv_len generated tokens.  p_cost is
+        # already per prompt position (prefill_price above), and the
+        # steady-state assumption that generation and prompt lengths
+        # are comparable makes it the per-generated-token feed cost
+        # directly; bench A/Bs measure the real ratio.
+        feed_cost = p_cost
         split_cost = (
             max(d_cost, feed_cost) + handoff_s / max(1, spec.kv_len)
         )
@@ -286,6 +322,9 @@ def _disagg_arm(
                 "mesh": list(p_st.mesh.shape),
                 "axes": list(p_st.mesh.axis_names),
                 "step_s": p_price["step_s"],
+                "chunk_s": p_price.get("chunk_s"),
+                "chunk": p_price.get("chunk"),
+                "attn_kernel": p_price.get("attn_kernel"),
             },
             "decode": {
                 "slices": d,

@@ -8,11 +8,16 @@ Execution model (docs/SERVING.md):
   block tables.  Inactive lanes carry an all-zero table row, so their
   writes land in the trash block (kvcache.py) — no masking, no
   recompile when the active set changes.
-* A **chunked prefill program** ingests one request's prompt ``P``
-  positions at a time (static chunk size — ONE compile serves every
-  prompt length; the final chunk is padded and padded rows write to the
-  trash block).  Chunks are scheduled between decode windows so a long
-  prompt never stalls running decodes for its whole length.
+* A **batched chunked prefill program** ingests ``P`` prompt positions
+  per mid-prefill slot, ALL slots in ONE dispatch per window (static
+  chunk size — ONE compile serves every prompt length and every
+  mid-prefill slot count; padded rows and idle lanes write to the
+  trash block).  The weights stream once per chunk-batch instead of
+  once per slot, and on a paged engine the chunk attends through the
+  block-table-native Pallas kernel (visible pages only — no
+  virtual-length gather; docs/PERF.md).  Chunks are scheduled between
+  decode windows so a long prompt never stalls running decodes for its
+  whole length.
 * The loop runs in **flush windows** (the async-fit discipline of
   ``FFModel.fit`` applied to serving): within a window, decode steps
   chain the next-token array device-to-device — greedy argmax happens
@@ -164,6 +169,12 @@ class ServeReport:
     drained: bool = False  # run ended via SIGTERM drain, not queue-empty
     shed: int = 0  # batch requests shed under sustained SLO pressure
     watchdog_fires: int = 0  # windows slower than --serve-watchdog-s
+    # --- batched paged prefill (r20) ---
+    # ONE jitted prefill dispatch serves every mid-prefill slot per
+    # window, so dispatches == windows-with-prefill-work regardless of
+    # slot count (prefill_chunks keeps counting per-slot logical chunks)
+    prefill_dispatches: int = 0
+    prefill_attn_kernel: Optional[str] = None  # kernel prefill ran on
 
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -377,6 +388,7 @@ class ServeEngine:
         if paged:
             from flexflow_tpu.ops.pallas.paged_attention import (
                 paged_decode_attention,
+                paged_prefill_attention,
             )
 
         def decode(params, ck, cv, *rest):
@@ -464,68 +476,107 @@ class ServeEngine:
             return nxt, probs, ck, cv
 
         def prefill(params, ck, cv, *rest):
-            # ONE slot's chunk: toks (P,), start/n_valid (), bt (MB,)
+            # ALL mid-prefill slots' chunks in ONE dispatch (r20): toks
+            # (B, P), start/n_valid (B,), bt (B, MB).  Row g of lane b
+            # sits at position start[b] + g; lanes with n_valid == 0
+            # (no mid-prefill request in that slot) ride with an
+            # all-zero table row and write the trash block — the
+            # decode/verify idle-lane discipline at chunk width.  The
+            # weight-streaming win: the window streams the decode
+            # weights ONCE per chunk-batch instead of once per slot.
             if quant:
                 sk, sv, toks, start, n_valid, bt = rest
             else:
                 sk = sv = None
                 toks, start, n_valid, bt = rest
             params = prep_params(params)
-            pos = start + jnp.arange(P)  # (P,)
-            valid = jnp.arange(P) < n_valid
-            x = params["tok_embed"]["kernel"][toks]  # (P, hidden)
+            lane = jnp.arange(B)
+            pos = start[:, None] + jnp.arange(P)[None, :]  # (B, P)
+            valid = jnp.arange(P)[None, :] < n_valid[:, None]
+            x = params["tok_embed"]["kernel"][toks]  # (B, P, hidden)
             x = x + params["pos_embed"]["value"][jnp.clip(pos, 0, S_pos - 1)]
-            # padded rows write to the trash block
-            blk = jnp.where(valid, bt[jnp.clip(pos // BS, 0, MB - 1)], 0)
+            # padded rows (and whole padded lanes) write the trash block
+            blk = jnp.where(
+                valid,
+                bt[lane[:, None], jnp.clip(pos // BS, 0, MB - 1)],
+                0,
+            )  # (B, P)
             off = jnp.where(valid, pos % BS, 0)
-            mask = (jnp.arange(SV)[None, :] <= pos[:, None])[:, None, :]
+            mask = (
+                jnp.arange(SV)[None, None, :] <= pos[..., None]
+            )[:, :, None, :]  # (B, P, 1, SV)
+            hid = x.shape[-1]
             for i in range(L):
                 p_at = params[f"dec{i}_attn"]
-                h = ln(params[f"dec{i}_ln0"], x)
+                # every matmul flattens to (B*P, ...) 2-D — each row's
+                # arithmetic is the per-slot prefill's, bit for bit
+                # (the verify-program contract at chunk width)
+                h = ln(params[f"dec{i}_ln0"], x).reshape(B * P, hid)
                 q = h @ p_at["wq"]
                 k = h @ p_at["wk"]
                 v = h @ p_at["wv"]
                 if has_bias:
                     q, k, v = q + p_at["bq"], k + p_at["bk"], v + p_at["bv"]
-                q = q.reshape(P, H, D)
-                k = k.reshape(P, H, D)
-                v = v.reshape(P, H, D)
+                q = q.reshape(B, P, H, D)
+                k = k.reshape(B, P, H, D)
+                v = v.reshape(B, P, H, D)
+                # scatter the whole chunk, THEN attend: row g's mask
+                # reaches rows 0..g of this same program (the verify
+                # discipline) — and under prefix sharing a chunk never
+                # writes a still-shared block (commit happens post-
+                # chunk, CoW-audited by serve_cow)
                 if quant:
-                    k, ksc = quantize_kv(jnp, k, kvdt)
+                    k, ksc = quantize_kv(jnp, k, kvdt)  # scale (B, P)
                     v, vsc = quantize_kv(jnp, v, kvdt)
                     sk = sk.at[i, blk, off].set(ksc)
                     sv = sv.at[i, blk, off].set(vsc)
                 ck = ck.at[i, blk, :, off, :].set(k)
                 cv = cv.at[i, blk, :, off, :].set(v)
-                keys = ck[i][bt]
-                vals = cv[i][bt]
-                if quant:
-                    keys = keys.astype(jnp.float32) * (
-                        sk[i][bt][:, None, :, None]
+                if paged:
+                    # block-table-native chunk attention: the kernel's
+                    # visible-page clamp reads ceil((start + P) / BS)
+                    # pages per lane — no (H, SV, D) buffer, no
+                    # O(S^2)-in-SV traffic (ffcheck ``paged_attn`` now
+                    # audits prefill too)
+                    o = paged_prefill_attention(
+                        q, ck[i], cv[i], start, bt, scale=scale,
+                        scale_k=sk[i] if quant else None,
+                        scale_v=sv[i] if quant else None,
                     )
-                    vals = vals.astype(jnp.float32) * (
-                        sv[i][bt][:, None, :, None]
-                    )
-                keys = keys.transpose(1, 0, 2, 3).reshape(H, SV, D)
-                vals = vals.transpose(1, 0, 2, 3).reshape(H, SV, D)
-                # q rows attend the slot's whole visible prefix:
-                # (P, H, SV) scores via the shared mul+reduce form
-                o = attend(q, keys[None], vals[None], mask)
-                o = o.reshape(P, H * D) @ p_at["wo"]
+                else:
+                    keys = ck[i][bt]
+                    vals = cv[i][bt]
+                    if quant:
+                        keys = keys.astype(jnp.float32) * (
+                            sk[i][bt][:, :, None, :, None]
+                        )
+                        vals = vals.astype(jnp.float32) * (
+                            sv[i][bt][:, :, None, :, None]
+                        )
+                    keys = keys.transpose(
+                        0, 2, 1, 3, 4
+                    ).reshape(B, H, SV, D)
+                    vals = vals.transpose(
+                        0, 2, 1, 3, 4
+                    ).reshape(B, H, SV, D)
+                    o = attend(q, keys[:, None], vals[:, None], mask)
+                o = o.reshape(B * P, H * D) @ p_at["wo"]
                 if has_bias:
                     o = o + p_at["bo"]
-                x = x + o
-                h = ln(params[f"dec{i}_ln1"], x)
+                x = x + o.reshape(B, P, hid)
+                h = ln(params[f"dec{i}_ln1"], x).reshape(B * P, hid)
                 p0, p1 = params[f"dec{i}_ff0"], params[f"dec{i}_ff1"]
                 f = jax.nn.gelu(h @ p0["kernel"] + p0["bias"])
                 f = f @ p1["kernel"] + p1["bias"]
-                x = x + f
+                x = x + f.reshape(B, P, hid)
             x = jax.lax.optimization_barrier(x)
-            # distribution after the chunk's LAST VALID row
-            x = ln(params["final_ln"], jnp.take(x, n_valid - 1, axis=0))
-            logits = x @ params["lm_head"]["kernel"]
+            # distribution after each lane's LAST VALID row (layer norm
+            # is per-row, so select-then-ln == ln-then-select)
+            row = x[lane, jnp.clip(n_valid - 1, 0, P - 1)]  # (B, hid)
+            row = ln(params["final_ln"], row)
+            logits = row @ params["lm_head"]["kernel"]
             probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-            nxt = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+            nxt = jnp.argmax(probs, axis=-1).astype(jnp.int32)  # (B,)
             if quant:
                 return nxt, probs, ck, cv, sk, sv
             return nxt, probs, ck, cv
@@ -735,8 +786,8 @@ class ServeEngine:
         bufs = res[2:]
         res = self._prefill(
             self._params_arg, *bufs,
-            jnp.zeros((P,), jnp.int32), jnp.asarray(0, jnp.int32),
-            jnp.asarray(1, jnp.int32), bt0[0],
+            jnp.zeros((B, P), jnp.int32), z,
+            jnp.ones((B,), jnp.int32), bt0,
         )
         bufs = res[2:]
         # chain one more decode on the prefill's outputs so BOTH
@@ -795,6 +846,15 @@ class ServeEngine:
         self.windows = 0
         self.decode_steps = 0
         self.prefill_chunks = 0
+        # batched-prefill ledger (r20): dispatches, not chunks — one
+        # per window with any mid-prefill slot, pinned by tests
+        self.prefill_dispatches = 0
+        # persistent host staging buffers for the batched prefill
+        # chunk arrays — refilled per window, never reallocated
+        self._pf_toks = np.zeros((B, P), np.int32)
+        self._pf_start = np.zeros((B,), np.int32)
+        self._pf_n = np.zeros((B,), np.int32)
+        self._pf_bt = np.zeros((B, MB), np.int32)
         self.spec_drafted = 0  # draft tokens proposed (spec mode)
         self.spec_accepted = 0  # draft tokens the full model confirmed
         self.peak_active = 0
@@ -876,6 +936,7 @@ class ServeEngine:
         # the engine is reusable across runs; counters and the report
         # are per-run (the compiled programs and the pool persist)
         self.windows = self.decode_steps = self.prefill_chunks = 0
+        self.prefill_dispatches = 0
         self.spec_drafted = self.spec_accepted = 0
         self.peak_active = 0
         self._occ_sum = 0.0
@@ -1089,57 +1150,76 @@ class ServeEngine:
         # water mark now, before any in-window finishes release slots
         self.peak_active = max(self.peak_active, len(self.sched.active))
 
-        # 1) prefill: ONE chunk per mid-prefill slot, chunk arrays staged
-        #    H2D ahead of compute through the shared DevicePrefetcher
-        prefill_done: List[Any] = []  # (req, next0_device, probs_device)
-        chunks = []
+        # 1) prefill: ONE batched dispatch covers every mid-prefill
+        #    slot (r20) — per-lane block tables/start/n_valid, idle
+        #    lanes ride with zero rows and write the trash block, so
+        #    the window streams the decode weights once per chunk-batch
+        #    instead of once per slot.  Chunk arrays are assembled into
+        #    the engine's persistent host buffers (no per-slot np.zeros
+        #    churn) and staged H2D once per window through the shared
+        #    DevicePrefetcher.
+        prefill_done: List[Any] = []  # (req, slot) — lanes read at flush
+        chunks = []  # (slot, lo, hi) — per-slot logical chunks
+        pf_nxt = pf_probs = None
         for slot in self.sched.prefill_slots():
             req = self.sched.active[slot]
             lo = req.prefill_pos
             hi = min(lo + self.prefill_chunk, req.prompt_len)
-            toks = np.zeros((self.prefill_chunk,), np.int32)
-            toks[: hi - lo] = req.prompt[lo:hi]
-            chunks.append((req, toks, lo, hi - lo, self.kv.table_row(slot)))
+            chunks.append((slot, lo, hi))
+        if chunks:
+            toks, start, n_valid, bt_pf = (
+                self._pf_toks, self._pf_start, self._pf_n, self._pf_bt,
+            )
+            toks.fill(0)
+            start.fill(0)
+            n_valid.fill(0)
+            bt_pf.fill(0)
+            for slot, lo, hi in chunks:
+                req = self.sched.active[slot]
+                toks[slot, : hi - lo] = req.prompt[lo:hi]
+                start[slot] = lo
+                n_valid[slot] = hi - lo
+                bt_pf[slot] = self.kv.table_row(slot)
 
-        def place(c):
-            req, toks, lo, n, row = c
-            return (
-                req,
-                self._jax.device_put(jnp.asarray(toks)),
-                jnp.asarray(lo, jnp.int32),
-                jnp.asarray(n, jnp.int32),
-                self._jax.device_put(jnp.asarray(row)),
-            )
-
-        for req, toks_d, lo_d, n_d, row_d in DevicePrefetcher(
-            chunks, place, depth=self.prefetch_depth
-        ):
-            t_c0 = spans.now() if spans is not None else 0.0
-            res = self._prefill(
-                self._params_arg, *self._kvs(), toks_d, lo_d, n_d, row_d,
-            )
-            nxt, probs = res[0], res[1]
-            self._store_kvs(res[2:])
-            self.prefill_chunks += 1
-            lo_h = req.prefill_pos
-            req.prefill_pos = min(
-                req.prefill_pos + self.prefill_chunk, req.prompt_len
-            )
-            if spans is not None:
-                # host dispatch wall of this chunk (device completion is
-                # async by design — no fetch, no added sync); buffered
-                spans.span(
-                    "prefill", req, t_c0, spans.now(), pool=self.phase,
-                    slot=req.slot, lo=lo_h, n=req.prefill_pos - lo_h,
+            def place(arrs):
+                # jnp.asarray copies out of the persistent buffers, so
+                # next window's refill never races the H2D transfer
+                return tuple(
+                    self._jax.device_put(jnp.asarray(a)) for a in arrs
                 )
-            # register the chunk's fully-written prompt blocks in the
-            # prefix index NOW (not at prefill end): a request arriving
-            # in the next admit round with the same system prompt
-            # re-attaches them instead of allocating — concurrent
-            # sharing, not just warm-cache sharing
-            self.kv.commit_prefix(req.slot, req.prompt, req.prefill_pos)
-            if req.prefill_pos >= req.prompt_len:
-                prefill_done.append((req, nxt, probs))
+
+            (staged,) = list(DevicePrefetcher(
+                [(toks, start, n_valid, bt_pf)], place,
+                depth=self.prefetch_depth,
+            ))
+            t_c0 = spans.now() if spans is not None else 0.0
+            res = self._prefill(self._params_arg, *self._kvs(), *staged)
+            pf_nxt, pf_probs = res[0], res[1]
+            self._store_kvs(res[2:])
+            self.prefill_chunks += len(chunks)
+            self.prefill_dispatches += 1
+            t_c1 = spans.now() if spans is not None else 0.0
+            for slot, lo, hi in chunks:
+                req = self.sched.active[slot]
+                req.prefill_pos = hi
+                if spans is not None:
+                    # host dispatch wall of the batched chunk (device
+                    # completion is async by design — no fetch, no
+                    # added sync); buffered
+                    spans.span(
+                        "prefill", req, t_c0, t_c1, pool=self.phase,
+                        slot=slot, lo=lo, n=hi - lo,
+                    )
+                # register the chunk's fully-written prompt blocks in
+                # the prefix index NOW (not at prefill end): a request
+                # arriving in the next admit round with the same system
+                # prompt re-attaches them instead of allocating —
+                # concurrent sharing, not just warm-cache sharing
+                self.kv.commit_prefix(
+                    req.slot, req.prompt, req.prefill_pos
+                )
+                if req.prefill_pos >= req.prompt_len:
+                    prefill_done.append((req, slot))
 
         # 2) decode: chain device tokens for an adaptive window
         dec_slots = self.sched.decode_slots()
@@ -1227,10 +1307,17 @@ class ServeEngine:
         host_spec = [
             (np.asarray(n), np.asarray(a)) for n, a in spec_buf
         ]
-        host_pre = [
-            (req, int(np.asarray(nxt)), np.asarray(probs))
-            for req, nxt, probs in prefill_done
-        ]
+        if prefill_done:
+            # ONE fetch of the batched dispatch's lanes, inside the
+            # window's single sync — indexed per finishing slot
+            pf_nxt_h = np.asarray(pf_nxt)
+            pf_probs_h = np.asarray(pf_probs)
+            host_pre = [
+                (req, int(pf_nxt_h[slot]), pf_probs_h[slot])
+                for req, slot in prefill_done
+            ]
+        else:
+            host_pre = []
         stall = self._now() - t_sync
         ex.count_host_sync(1, stall)
         flushed_tokens = 0
@@ -1398,6 +1485,12 @@ class ServeEngine:
                 # (ADDITIVE ffmetrics/1 vocabulary — r14, old readers
                 # ignore it, old streams simply lack it)
                 "attn_kernel": self.attn_kernel,
+                # which kernel CHUNKED PREFILL ran on + how many
+                # batched dispatches this window issued (ADDITIVE —
+                # r20; pre-r20 streams simply lack both and
+                # tools/serve_report.py stays silent)
+                "prefill_attn_kernel": self.attn_kernel,
+                "prefill_dispatches": 1 if chunks else 0,
                 # quantized-serving vocabulary (ADDITIVE — r19): the
                 # pool/weight formats and the per-position HBM cost
                 "kv_dtype": self.kv_dtype,
@@ -1563,6 +1656,8 @@ class ServeEngine:
             drained=self.drained,
             shed=self.sched.shed if shed is None else shed,
             watchdog_fires=self.watchdog_fires,
+            prefill_dispatches=self.prefill_dispatches,
+            prefill_attn_kernel=self.attn_kernel,
         )
         self.metrics.close()
         if self.spans is not None and self._owns_spans:

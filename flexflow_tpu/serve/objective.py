@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional
 from flexflow_tpu.search.cost import (
     TPUMachineModel,
     estimate_decode_step_time,
+    estimate_prefill_chunk_time,
     estimate_speculative_decode,
 )
 from flexflow_tpu.tensor import Layer
@@ -52,8 +53,16 @@ class ServeSpec:
     # decode-attention kernel the engine will run (docs/PERF.md "Paged
     # decode attention"): "paged" reads each K/V page once; "gather"
     # pays the dense per-layer gather materialization (3x KV bytes).
-    # Default "paged" — the engine's auto resolution on TPU.
+    # Default "paged" — the engine's auto resolution on TPU.  Since
+    # r20 the knob governs BOTH phases: chunked prefill runs the same
+    # kernel family the decode step does, and the prefill pricing
+    # below follows it.
     attn: str = "paged"  # paged | gather
+    # batched chunked-prefill shape (r20): prompt positions per lane
+    # per prefill dispatch — prices the prefill arm
+    # (estimate_prefill_chunk_time) that serve_price["prefill"] and
+    # the disagg split's feed cost carry
+    prefill_chunk: int = 32
     # speculative decoding arm (0 = plain decode only).  When k > 0 the
     # objective prices BOTH arms (plain vs accept-rate-weighted macro
     # steps, estimate_speculative_decode) and takes the better one, so
@@ -208,6 +217,33 @@ class ServeObjective:
         }
         if fleet_price is not None:
             out["fleet"] = fleet_price
+        # chunked-prefill pricing (ADDITIVE — r20): the batched prefill
+        # dispatch under the SAME attn/kv/weight arms the decode price
+        # uses, so ``--serve-attn`` governs both phases.  TTFT estimate
+        # = chunks-to-ingest-a-kv_len-prompt x chunk_s (dispatches
+        # serialize on the weight stream).  Steady-state decode cost is
+        # untouched — the key rides beside it, existing fp32 decode
+        # goldens keep their numbers.
+        pf = estimate_prefill_chunk_time(
+            layers, strategy, self.machine,
+            chunk=self.spec.prefill_chunk, kv_len=self.spec.kv_len,
+            train_tokens=self.train_tokens, slots=self.spec.slots,
+            attn_kernel=self.spec.attn, kv_dtype=self.spec.kv_dtype,
+            weight_dtype=self.spec.weight_dtype,
+        )
+        n_chunks = -(-max(1, self.spec.kv_len) // self.spec.prefill_chunk)
+        out["prefill"] = {
+            "chunk": self.spec.prefill_chunk,
+            "attn_kernel": self.spec.attn,
+            "chunk_s": pf["chunk_s"],
+            "per_pos_s": pf["chunk_s"] / (
+                self.spec.slots * self.spec.prefill_chunk
+            ),
+            "ttft_est_ms": pf["chunk_s"] * n_chunks * 1e3,
+            "breakdown": {
+                k: pf[k] for k in ("mem_s", "flops_s", "coll_s")
+            },
+        }
         # quantized arms appear in the price dict ONLY when enabled
         # (the fleet-key pattern): fp32 arms keep every existing serve
         # golden byte-identical

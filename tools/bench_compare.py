@@ -175,6 +175,14 @@ GATED = (
     # step (the ffcheck ``paged_attn`` audit is the structural twin of
     # this measured gate)
     ("serve_paged_attn_peak_mb", ("serve_paged_attn_peak_mb",), False),
+    # serve_prefill_peak_mb (r20, docs/SERVING.md "Chunked prefill on
+    # the paged pool") gates LOWER-is-better: the fp32 paged PREFILL
+    # program's peak live temp bytes on the long-prompt undersized-pool
+    # A/B — the number chunked paged prefill exists to shrink; it
+    # growing means the full-virtual-length K/V gather crept back into
+    # the prefill phase (the O(S^2) long-context TTFT tax), which the
+    # decode-side gate above cannot see
+    ("serve_prefill_peak_mb", ("serve_prefill_peak_mb",), False),
     # exposed_comm_frac (r15, docs/PERF.md "Overlapped gradient sync")
     # gates LOWER-is-better: the share of the fused grad sync the ring
     # decomposition could NOT hide under backward compute on the priced

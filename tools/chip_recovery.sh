@@ -54,6 +54,19 @@ spec.loader.exec_module(b)
 print(json.dumps(b._serve_kv_quant_ab(True)))
 PY
 
+echo "== serve chunked-prefill A/B (r20: paged prefill kernel on real HBM — CPU had interpret-mode numbers only) =="
+# on-chip the story is TTFT, not just peak temps: the gather arm streams
+# the FULL virtual-length K/V per layer per chunk (HBM-bound at long
+# context), the paged arm only the visible pages — ttft_p99_ms_* and the
+# per-dtype peak ratios are the rows for BASELINE.md
+timeout 1200 python - <<'PY' 2>&1 | grep -v WARNING | tee .bench_logs/serve_prefill_paged_ab.json
+import importlib.util, json
+spec = importlib.util.spec_from_file_location("bench", "bench.py")
+b = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(b)
+print(json.dumps(b._serve_prefill_paged_ab(True)))
+PY
+
 echo "== fit overlap A/B (r15: grad-sync ring on real ICI — CPU had virtual-device numbers only) =="
 timeout 900 python - <<'PY' 2>&1 | grep -v WARNING | tee .bench_logs/fit_overlap_ab.json
 import importlib.util, json

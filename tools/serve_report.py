@@ -146,6 +146,16 @@ def render(records: List[Dict], max_windows: int = 30) -> str:
                 "(scales included)"
             )
         facts.append(quant)
+    # chunked prefill (r20, docs/SERVING.md "Chunked prefill on the
+    # paged pool"): additive prefill_attn_kernel field — a pre-r20
+    # stream carries no key and the line stays absent
+    if last.get("prefill_attn_kernel") is not None:
+        pc = sum(s.get("prefill_chunks", 0) for _, s in serve)
+        pd = sum(s.get("prefill_dispatches", 0) for _, s in serve)
+        facts.append(
+            f"chunked prefill: {last['prefill_attn_kernel']} kernel, "
+            f"{pc} chunk(s) in {pd} batched dispatch(es)"
+        )
     if last.get("prefix_hit_rate") is not None:
         facts.append(
             f"prefix cache: hit rate {last['prefix_hit_rate']:.3f}, "
